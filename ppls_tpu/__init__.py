@@ -1,0 +1,54 @@
+"""ppls_tpu — a TPU-native adaptive-quadrature framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+``taithenguyen/ppls`` (``aquadPartA.c``): an MPI farmer/worker bag-of-tasks
+adaptive integrator. The design maps the reference's roles onto TPU hardware:
+
+* the farmer's LIFO task bag (``aquadPartA.c:125-173``) becomes a wavefront
+  frontier — a fixed-capacity device array of intervals processed one
+  *round* (breadth-first generation) at a time;
+* the worker's evaluate-or-split step (``aquadPartA.c:175-208``) becomes a
+  vmapped / Pallas kernel scoring the whole frontier per launch;
+* ``MPI_Send``/``MPI_Recv`` point-to-point accumulation becomes
+  ``lax.psum`` over the ICI mesh, and distributed termination detection
+  (``aquadPartA.c:166``) becomes a psum of per-chip pending counts.
+
+Public API (stable):
+    integrate           — one-call adaptive integration (host- or device-driven)
+    device_integrate    — fully-on-device lax.while_loop integrator
+    sharded_integrate   — multi-chip shard_map integrator
+    QuadConfig          — runtime configuration
+    get_integrand       — integrand registry lookup
+"""
+
+import jax as _jax
+
+# f64 is core to a quadrature framework: deep adaptive refinement produces
+# interval widths far below the f32 ulp of their endpoints (SURVEY.md §7,
+# "hard parts"). Enable x64 before any tracing happens.
+_jax.config.update("jax_enable_x64", True)
+
+from ppls_tpu.config import QuadConfig, Rule, Backend  # noqa: E402
+from ppls_tpu.models.integrands import get_integrand, register_integrand, INTEGRANDS  # noqa: E402
+from ppls_tpu.ops.rules import eval_batch, eval_interval  # noqa: E402
+from ppls_tpu.runtime.host_frontier import integrate, IntegrationResult  # noqa: E402
+from ppls_tpu.parallel.device_engine import device_integrate  # noqa: E402
+from ppls_tpu.parallel.sharded import sharded_integrate  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "QuadConfig",
+    "Rule",
+    "Backend",
+    "get_integrand",
+    "register_integrand",
+    "INTEGRANDS",
+    "eval_batch",
+    "eval_interval",
+    "integrate",
+    "IntegrationResult",
+    "device_integrate",
+    "sharded_integrate",
+    "__version__",
+]

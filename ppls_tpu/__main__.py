@@ -1,0 +1,122 @@
+"""CLI: ``python -m ppls_tpu [options]``.
+
+The runtime replacement for the reference's compile-time configuration
+(``EPSILON``/``F``/``A``/``B`` macros, ``aquadPartA.c:45-48``, and
+``mpirun -c N`` process-count selection, ``:31``). Prints the area and the
+tasks-per-chip table in the same spirit as ``aquadPartA.c:107-118``, plus
+the observability the reference lacks (global error, rounds, throughput).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ppls_tpu",
+        description="TPU-native adaptive quadrature (ppls_tpu)",
+    )
+    p.add_argument("--integrand", default="cosh4",
+                   help="registered integrand name (default: cosh4, the "
+                        "reference problem)")
+    p.add_argument("-a", type=float, default=0.0, help="lower bound")
+    p.add_argument("-b", type=float, default=5.0, help="upper bound")
+    p.add_argument("--eps", type=float, default=1e-3,
+                   help="per-interval split tolerance (reference EPSILON)")
+    p.add_argument("--rule", choices=["trapezoid", "simpson"],
+                   default="trapezoid")
+    p.add_argument("--engine", choices=["host", "device", "sharded"],
+                   default="host",
+                   help="host: unbounded frontier, host loop; device: one "
+                        "jitted while_loop; sharded: multi-chip shard_map")
+    p.add_argument("--backend", choices=["jax", "mpi"], default="jax",
+                   help="jax: TPU-native path; mpi: the C farmer/worker "
+                        "binary (requires an MPI toolchain)")
+    p.add_argument("--capacity", type=int, default=1 << 16)
+    p.add_argument("--max-rounds", type=int, default=4096)
+    p.add_argument("--n-devices", type=int, default=None)
+    p.add_argument("--n-workers", type=int, default=4,
+                   help="MPI backend only: worker process count")
+    p.add_argument("--checkpoint", default=None,
+                   help="snapshot path; resumes from it if it exists "
+                        "(host engine only)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print one JSON line instead of the table")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ppls_tpu.config import Backend, QuadConfig, Rule
+
+    cfg = QuadConfig(
+        integrand=args.integrand, a=args.a, b=args.b, eps=args.eps,
+        rule=Rule(args.rule), capacity=args.capacity,
+        max_rounds=args.max_rounds, n_devices=args.n_devices,
+        backend=Backend(args.backend),
+    )
+
+    if cfg.backend == Backend.MPI:
+        from ppls_tpu.backends import run_mpi
+        res = run_mpi(cfg, n_workers=args.n_workers)
+    elif args.engine == "host":
+        from ppls_tpu.runtime.host_frontier import integrate
+
+        if args.checkpoint:
+            import os
+
+            from ppls_tpu.runtime.checkpoint import Checkpointer, resume
+            ckpt = Checkpointer(args.checkpoint)
+            if os.path.exists(args.checkpoint):
+                res = resume(args.checkpoint, cfg, on_round=ckpt.hook)
+            else:
+                res = integrate(cfg, on_round=ckpt.hook)
+        else:
+            res = integrate(cfg)
+    elif args.engine == "device":
+        from ppls_tpu.parallel.device_engine import device_integrate
+        res = device_integrate(cfg)
+    else:
+        from ppls_tpu.parallel.sharded import sharded_integrate
+        res = sharded_integrate(cfg)
+
+    m = res.metrics
+    if args.as_json:
+        out = {
+            "area": res.area,
+            "exact": res.exact,
+            "global_error": res.global_error,
+            "tasks": m.tasks,
+            "splits": m.splits,
+            "leaves": m.leaves,
+            "rounds": m.rounds,
+            "max_depth": m.max_depth,
+            "integrand_evals": m.integrand_evals,
+            "wall_time_s": m.wall_time_s,
+            "evals_per_sec_per_chip": m.evals_per_sec_per_chip,
+            "tasks_per_chip": m.tasks_per_chip,
+        }
+        print(json.dumps(out))
+    else:
+        # The reference's report (aquadPartA.c:108-118), plus what it lacks.
+        print(f"Area={res.area:.6f}")
+        print()
+        print(m.histogram_str())
+        print()
+        if res.global_error is not None:
+            print(f"Global error: {res.global_error:.6e} "
+                  f"(exact {res.exact:.6f})")
+        print(f"Tasks: {m.tasks} ({m.splits} splits, {m.leaves} leaves) "
+              f"in {m.rounds} rounds, depth {m.max_depth}")
+        print(f"Integrand evals: {m.integrand_evals} "
+              f"({m.evals_per_sec_per_chip:.0f}/s/chip over "
+              f"{m.wall_time_s:.3f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Backend dispatch: ``backend={jax, mpi}`` (SURVEY.md §7 step 6).
+
+The JAX backend is this package. The MPI backend runs our C farmer/worker
+program (an original implementation of the reference's design,
+``aquadPartA.c:125-208``) for behavioral parity — gated on an MPI
+toolchain being present.
+"""
+
+from ppls_tpu.backends.mpi_backend import (
+    build_mpi,
+    build_seq,
+    mpi_available,
+    run_mpi,
+    run_seq,
+)
+
+__all__ = ["build_mpi", "build_seq", "mpi_available", "run_mpi", "run_seq"]

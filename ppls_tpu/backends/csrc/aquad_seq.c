@@ -1,0 +1,50 @@
+/* Sequential adaptive-quadrature driver: the single-process CPU baseline
+ * (BASELINE.json config "single-process CPU ref"; throughput denominator
+ * for bench.py's vs_baseline ratio).
+ *
+ * Usage: aquad_seq <integrand_id> <a> <b> <eps>
+ * Output: one JSON line with area, counters, timing.
+ */
+#include "aquad_common.h"
+
+int main(int argc, char **argv) {
+    if (argc != 5) {
+        fprintf(stderr, "usage: %s <integrand_id> <a> <b> <eps>\n", argv[0]);
+        return 2;
+    }
+    int fid = atoi(argv[1]);
+    double a = strtod(argv[2], NULL);
+    double b = strtod(argv[3], NULL);
+    double eps = strtod(argv[4], NULL);
+
+    aq_bag bag;
+    bag_init(&bag);
+    bag_push(&bag, a, b, 0);
+
+    acc_t area = {0.0, 0.0};
+    long tasks = 0, splits = 0;
+    int max_depth = 0;
+    aq_task t;
+
+    double t0 = now_sec();
+    while (bag_pop(&bag, &t)) {
+        double v;
+        tasks++;
+        if (t.depth > max_depth) max_depth = t.depth;
+        if (aq_eval(fid, eps, t.l, t.r, &v)) {
+            double m = 0.5 * (t.l + t.r);
+            bag_push(&bag, t.l, m, t.depth + 1);
+            bag_push(&bag, m, t.r, t.depth + 1);
+            splits++;
+        } else {
+            acc_add(&area, v);
+        }
+    }
+    double wall = now_sec() - t0;
+    bag_free(&bag);
+
+    printf("{\"area\": %.17g, \"tasks\": %ld, \"splits\": %ld, "
+           "\"evals\": %ld, \"max_depth\": %d, \"wall_time_s\": %.9f}\n",
+           acc_value(&area), tasks, splits, 3 * tasks, max_depth, wall);
+    return 0;
+}

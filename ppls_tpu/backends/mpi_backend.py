@@ -1,0 +1,134 @@
+"""MPI backend: runs our C farmer/worker binary for design parity.
+
+This is an *original implementation* of the reference's architecture
+(farmer with a LIFO bag + demand-driven dispatch, workers doing the
+trapezoid evaluate-or-split step — ``aquadPartA.c:125-208``), not a copy:
+see ``csrc/aquad_mpi.c``. It exists so the two backends can be compared
+head-to-head (area, task counts, throughput) per SURVEY.md §7 step 6 and
+BASELINE.json's north star ("≥100× the MPI/CPU subinterval throughput").
+
+Build is gated on an MPI toolchain (``mpicc``); the *sequential* C driver
+(``csrc/aquad_seq.c``) builds with plain cc everywhere and provides the
+CPU baseline for ``bench.py`` even without MPI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+from ppls_tpu.config import QuadConfig, Rule
+from ppls_tpu.runtime.host_frontier import IntegrationResult
+from ppls_tpu.utils.metrics import RunMetrics
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+_BUILD = os.path.join(_CSRC, "build")
+
+# Integrands the C backends implement (must match aquad_common.h).
+_C_INTEGRANDS = {"cosh4": 0, "sin": 1, "sin_recip": 2}
+
+
+def mpi_available() -> bool:
+    return shutil.which("mpicc") is not None and shutil.which("mpirun") is not None
+
+
+def _cc() -> Optional[str]:
+    for cc in ("cc", "gcc", "clang"):
+        if shutil.which(cc):
+            return cc
+    return None
+
+
+def build_seq(force: bool = False) -> Optional[str]:
+    """Build the sequential C driver; returns binary path or None."""
+    cc = _cc()
+    if cc is None:
+        return None
+    out = os.path.join(_BUILD, "aquad_seq")
+    src = os.path.join(_CSRC, "aquad_seq.c")
+    if os.path.exists(out) and not force and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    subprocess.run([cc, "-O2", "-o", out, src, "-lm"], check=True,
+                   cwd=_CSRC, capture_output=True)
+    return out
+
+
+def build_mpi(force: bool = False) -> Optional[str]:
+    """Build the MPI farmer/worker binary; None when no MPI toolchain."""
+    if not mpi_available():
+        return None
+    out = os.path.join(_BUILD, "aquad_mpi")
+    src = os.path.join(_CSRC, "aquad_mpi.c")
+    if os.path.exists(out) and not force and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    subprocess.run(["mpicc", "-O2", "-o", out, src, "-lm"], check=True,
+                   cwd=_CSRC, capture_output=True)
+    return out
+
+
+def _check_config(config: QuadConfig) -> int:
+    if Rule(config.rule) != Rule.TRAPEZOID:
+        raise ValueError("the C backends implement the reference's "
+                         "trapezoid rule only")
+    if config.integrand not in _C_INTEGRANDS:
+        raise ValueError(
+            f"C backends support integrands {sorted(_C_INTEGRANDS)}; "
+            f"got {config.integrand!r}")
+    return _C_INTEGRANDS[config.integrand]
+
+
+def _parse_result(stdout: str, config: QuadConfig,
+                  n_chips: int) -> IntegrationResult:
+    from ppls_tpu.models.integrands import get_integrand
+
+    d = json.loads(stdout.strip().splitlines()[-1])
+    metrics = RunMetrics(
+        tasks=d["tasks"],
+        splits=d["splits"],
+        leaves=d["tasks"] - d["splits"],
+        rounds=0,  # bag order, not wavefront rounds
+        max_depth=d.get("max_depth", 0),
+        integrand_evals=d["evals"],
+        wall_time_s=d["wall_time_s"],
+        n_chips=n_chips,
+        tasks_per_chip=d.get("tasks_per_rank"),
+    )
+    return IntegrationResult(
+        area=d["area"], config=config, metrics=metrics,
+        exact=get_integrand(config.integrand).exact(config.a, config.b),
+    )
+
+
+def run_seq(config: QuadConfig) -> IntegrationResult:
+    """Run the sequential C driver (the CPU baseline)."""
+    fid = _check_config(config)
+    binary = build_seq()
+    if binary is None:
+        raise RuntimeError("no C compiler available for the seq backend")
+    proc = subprocess.run(
+        [binary, str(fid), repr(config.a), repr(config.b),
+         repr(config.eps)],
+        capture_output=True, text=True, check=True)
+    return _parse_result(proc.stdout, config, n_chips=1)
+
+
+def run_mpi(config: QuadConfig, n_workers: int = 4) -> IntegrationResult:
+    """Run the MPI farmer/worker binary with ``n_workers`` workers."""
+    fid = _check_config(config)
+    binary = build_mpi()
+    if binary is None:
+        raise RuntimeError(
+            "MPI backend requested but no mpicc/mpirun on PATH; install an "
+            "MPI toolchain or use backend='jax'")
+    proc = subprocess.run(
+        ["mpirun", "--oversubscribe", "-n", str(n_workers + 1), binary,
+         str(fid), repr(config.a), repr(config.b), repr(config.eps)],
+        capture_output=True, text=True, check=True)
+    return _parse_result(proc.stdout, config, n_chips=n_workers)

@@ -1,0 +1,82 @@
+"""Runtime configuration for ppls_tpu.
+
+The reference hard-codes its entire configuration as compile-time macros
+(``EPSILON``, ``F``, ``A``, ``B`` at ``aquadPartA.c:45-48``) — changing the
+problem means recompiling. Here configuration is a runtime dataclass usable
+from Python or the CLI (``python -m ppls_tpu ...``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class Rule(str, enum.Enum):
+    """Quadrature refinement rule.
+
+    TRAPEZOID reproduces the reference's semantics exactly: accept
+    ``larea + rarea`` when ``|larea + rarea - lrarea| <= EPSILON`` (strict
+    ``>`` split test, no Richardson correction) — ``aquadPartA.c:185-202``.
+    SIMPSON is the quality default: composite Simpson with Richardson
+    extrapolation on accept (error O(h^6) per interval vs O(h^3)).
+    """
+
+    TRAPEZOID = "trapezoid"
+    SIMPSON = "simpson"
+
+
+class Backend(str, enum.Enum):
+    """Execution backend selector.
+
+    JAX is the TPU-native path. MPI shells out to the compiled C
+    farmer/worker binary (our own implementation, built only when an MPI
+    toolchain exists) for parity runs against the reference design.
+    """
+
+    JAX = "jax"
+    MPI = "mpi"
+
+
+@dataclasses.dataclass(frozen=True)
+class QuadConfig:
+    """Configuration for one adaptive-quadrature run.
+
+    Defaults replicate the reference problem: F(x)=cosh^4(x) on [0, 5] with
+    per-interval tolerance 1e-3 (``aquadPartA.c:45-48``). ``eps`` is a
+    *local split tolerance*, not a global error bound — the reference's
+    global error at these settings is ~0.44 (SURVEY.md §0).
+    """
+
+    integrand: str = "cosh4"
+    a: float = 0.0
+    b: float = 5.0
+    eps: float = 1e-3
+    rule: Rule = Rule.TRAPEZOID
+    # Fixed per-round frontier capacity (number of interval slots). The
+    # frontier at most doubles each round; the reference workload peaks at
+    # 1642 (SURVEY.md §0), deep configs (sin(1/x) @ 1e-10) need much more.
+    capacity: int = 1 << 16
+    # Maximum rounds before aborting (the reference workload needs 15).
+    max_rounds: int = 256
+    # Bucketed batch widths bound recompilation: frontiers are padded up to
+    # the next power of two >= min_batch when host-driven.
+    min_batch: int = 256
+    dtype: str = "float64"
+    backend: Backend = Backend.JAX
+    # Multi-chip: number of mesh devices (None = all available).
+    n_devices: Optional[int] = None
+
+    def replace(self, **kw) -> "QuadConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# The reference problem, verbatim semantics (aquadPartA.c:45-48).
+REFERENCE_CONFIG = QuadConfig()
+
+# Extended benchmark configs from BASELINE.json.
+SIN_CONFIG = QuadConfig(integrand="sin", a=0.0, b=1.0, eps=1e-6)
+OSC_CONFIG = QuadConfig(integrand="sin_recip", a=1e-4, b=1.0, eps=1e-8,
+                        capacity=1 << 20, max_rounds=2048)
+OSC_DEEP_CONFIG = OSC_CONFIG.replace(eps=1e-10)

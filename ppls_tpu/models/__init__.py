@@ -1,0 +1,8 @@
+from ppls_tpu.models.integrands import (
+    get_integrand,
+    register_integrand,
+    INTEGRANDS,
+    Integrand,
+)
+
+__all__ = ["get_integrand", "register_integrand", "INTEGRANDS", "Integrand"]

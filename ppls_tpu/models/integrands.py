@@ -1,0 +1,127 @@
+"""Integrand registry — the "model zoo" of the quadrature framework.
+
+The reference hard-codes a single integrand as a C preprocessor macro,
+``F(arg) = cosh(arg)^4`` (``aquadPartA.c:46``), expanded 4x per call site.
+Here integrands are first-class registered JAX functions: traceable,
+vmappable, differentiable, and inlinable into Pallas kernels.
+
+Each entry carries an optional closed-form antiderivative so tests and
+benchmarks can report *achieved global error* — something the reference
+cannot do (its global error at the published settings is ~0.44, SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import math
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Integrand:
+    name: str
+    fn: Callable  # f(x) -> y, elementwise, jax-traceable
+    # Scalar host-math antiderivative F (F' = f), if known. Evaluated with
+    # the `math` module on host, NOT on device: TPU f64 is emulated and
+    # must not pollute the ground-truth value tests compare against.
+    antiderivative: Optional[Callable] = None
+    doc: str = ""
+
+    def exact(self, a: float, b: float) -> Optional[float]:
+        """Closed-form integral over [a, b], or None if unknown."""
+        if self.antiderivative is None:
+            return None
+        return float(self.antiderivative(float(b)) - self.antiderivative(float(a)))
+
+
+INTEGRANDS: Dict[str, Integrand] = {}
+
+
+def register_integrand(name: str, fn: Callable,
+                       antiderivative: Optional[Callable] = None,
+                       doc: str = "") -> Integrand:
+    entry = Integrand(name=name, fn=fn, antiderivative=antiderivative, doc=doc)
+    INTEGRANDS[name] = entry
+    return entry
+
+
+def get_integrand(name: str) -> Integrand:
+    try:
+        return INTEGRANDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown integrand {name!r}; registered: {sorted(INTEGRANDS)}"
+        ) from None
+
+
+# --- built-ins ---------------------------------------------------------------
+
+def _cosh4(x):
+    c = jnp.cosh(x)
+    c2 = c * c
+    return c2 * c2
+
+
+def _cosh4_anti(x):
+    # ∫cosh⁴x dx = 3x/8 + sinh(2x)/4 + sinh(4x)/32  (SURVEY.md §0)
+    return 3.0 * x / 8.0 + math.sinh(2.0 * x) / 4.0 + math.sinh(4.0 * x) / 32.0
+
+
+register_integrand(
+    "cosh4", _cosh4, _cosh4_anti,
+    doc="The reference problem: F(x)=cosh^4(x) (aquadPartA.c:46). "
+        "Exact integral over [0,5] = 7583461.361497.",
+)
+
+register_integrand(
+    "sin", jnp.sin, lambda x: -math.cos(x),
+    doc="BASELINE.json config: sin(x) on [0,1], eps=1e-6.",
+)
+
+
+def _sin_recip(x):
+    return jnp.sin(1.0 / x)
+
+
+register_integrand(
+    "sin_recip", _sin_recip, None,
+    doc="BASELINE.json oscillatory config: sin(1/x) on [1e-4, 1]; forces "
+        "deep adaptive splitting near the left endpoint.",
+)
+
+
+def _gauss_peak(x):
+    # Sharply peaked Gaussian at x=0.5: stresses spatially-clustered
+    # refinement (the load-balance hard case, SURVEY.md §7).
+    s = 1e-3
+    return jnp.exp(-0.5 * ((x - 0.5) / s) ** 2)
+
+
+def _gauss_peak_anti(x):
+    s = 1e-3
+    return s * math.sqrt(math.pi / 2.0) * math.erf((x - 0.5) / (s * math.sqrt(2.0)))
+
+
+register_integrand(
+    "gauss_peak", _gauss_peak, _gauss_peak_anti,
+    doc="Peaked Gaussian (sigma=1e-3) at 0.5: clustered-refinement stress.",
+)
+
+register_integrand(
+    "poly3", lambda x: x * x * x, lambda x: 0.25 * x ** 4,
+    doc="Cubic: exactly integrated by Simpson — rule sanity checks.",
+)
+
+register_integrand(
+    "exp", jnp.exp, math.exp,
+    doc="exp(x): smooth benign integrand for convergence tests.",
+)
+
+register_integrand(
+    "runge", lambda x: 1.0 / (1.0 + 25.0 * x * x),
+    lambda x: math.atan(5.0 * x) / 5.0,
+    doc="Runge function on [-1,1]: classic adaptive-refinement test.",
+)

@@ -1,0 +1,12 @@
+from ppls_tpu.ops.rules import eval_batch, eval_interval, EVALS_PER_TASK
+from ppls_tpu.ops.reduction import kahan_init, kahan_add, kahan_sum, masked_sum
+
+__all__ = [
+    "eval_batch",
+    "eval_interval",
+    "EVALS_PER_TASK",
+    "kahan_init",
+    "kahan_add",
+    "kahan_sum",
+    "masked_sum",
+]

@@ -1,0 +1,59 @@
+"""Deterministic accumulation primitives.
+
+The reference's area sum is accumulated in MPI arrival order
+(``result += buff[0]`` at ``aquadPartA.c:149``) — nondeterministic across
+runs and process counts. Here all reductions are deterministic: masked sums
+over fixed-layout arrays (XLA reduces in a fixed tree order for a given
+shape), and a Kahan compensated accumulator carries the running total across
+rounds so results are bit-stable for a given (capacity, mesh) shape.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def masked_sum(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Sum of ``values`` where ``mask``; deterministic for fixed shape."""
+    return jnp.sum(jnp.where(mask, values, jnp.zeros_like(values)))
+
+
+def kahan_init(dtype=jnp.float64) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum, compensation) carried across wavefront rounds."""
+    zero = jnp.zeros((), dtype=dtype)
+    return zero, zero
+
+
+def kahan_add(acc: Tuple[jnp.ndarray, jnp.ndarray],
+              x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Neumaier-variant compensated add: acc + x with error carry.
+
+    Replaces the reference's bare ``result += buff[0]``
+    (``aquadPartA.c:149``) with a compensated update so deep runs
+    (millions of leaf contributions at eps=1e-10) don't lose low bits.
+    """
+    s, c = acc
+    t = s + x
+    # Neumaier: pick the larger-magnitude operand to compute the error term.
+    big_first = jnp.abs(s) >= jnp.abs(x)
+    err = jnp.where(big_first, (s - t) + x, (x - t) + s)
+    return t, c + err
+
+
+def kahan_sum(acc: Tuple[jnp.ndarray, jnp.ndarray]) -> jnp.ndarray:
+    """Final compensated value."""
+    s, c = acc
+    return s + c
+
+
+def neumaier_add_host(s: float, c: float, x: float) -> Tuple[float, float]:
+    """Host-float variant of :func:`kahan_add` (same algorithm, Python
+    floats) for accumulation across rounds in the host-driven engine."""
+    t = s + x
+    if abs(s) >= abs(x):
+        c += (s - t) + x
+    else:
+        c += (x - t) + s
+    return t, c

@@ -1,0 +1,5 @@
+from ppls_tpu.parallel.device_engine import device_integrate, DeviceState
+from ppls_tpu.parallel.sharded import sharded_integrate
+from ppls_tpu.parallel.mesh import make_mesh
+
+__all__ = ["device_integrate", "DeviceState", "sharded_integrate", "make_mesh"]

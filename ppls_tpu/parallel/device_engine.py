@@ -1,0 +1,201 @@
+"""Fully-on-device wavefront integrator: one jit, zero host round-trips.
+
+The reference pays 4 MPI messages per split round-trip (SURVEY.md §3);
+the host-driven engine (``runtime.host_frontier``) pays one host↔device
+transfer per round. This engine eliminates even that: the entire adaptive
+loop — evaluate, accumulate, compact, terminate — runs as a single
+``lax.while_loop`` inside one jitted computation. The task bag
+(``aquadPartA.c:52-70``) becomes a fixed-capacity pair of coordinate
+arrays; the bag's push/pop becomes a cumsum scatter-compaction; the
+farmer's termination test (bag empty ∧ all idle, ``aquadPartA.c:166``)
+becomes "no active lanes".
+
+Fixed capacity is the XLA static-shape contract: if a round would produce
+more children than ``capacity``, the engine sets an overflow flag and the
+caller falls back to the host-driven engine (which has an unbounded bag).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ppls_tpu.config import QuadConfig, Rule
+from ppls_tpu.models.integrands import get_integrand
+from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
+from ppls_tpu.ops.reduction import kahan_add
+from ppls_tpu.utils.metrics import RunMetrics
+
+
+class DeviceState(NamedTuple):
+    """Loop carry: the whole integrator state lives on device."""
+
+    l: jnp.ndarray          # (capacity,) left endpoints
+    r: jnp.ndarray          # (capacity,) right endpoints
+    active: jnp.ndarray     # (capacity,) bool — lane holds a pending interval
+    acc_s: jnp.ndarray      # Kahan sum of accepted areas
+    acc_c: jnp.ndarray      # Kahan compensation
+    tasks: jnp.ndarray      # intervals evaluated (parity counter, cf. aquadPartA.c:162)
+    splits: jnp.ndarray     # intervals refined
+    rounds: jnp.ndarray     # wavefront rounds completed
+    overflow: jnp.ndarray   # bool — a round needed > capacity child slots
+
+
+def compact_children(l: jnp.ndarray, r: jnp.ndarray, split: jnp.ndarray,
+                     capacity: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Scatter the two halves of every split interval into a dense prefix.
+
+    The in-jit replacement for the bag's push (``aquadPartA.c:224-238``):
+    split interval #k (0-based, in lane order) writes [l, mid] to slot 2k
+    and [mid, r] to slot 2k+1 — deterministic breadth-first ordering, left
+    child first like the worker's two tag-0 sends (``aquadPartA.c:192-197``).
+
+    Returns (new_l, new_r, new_active, n_children). Lanes whose slot would
+    exceed ``capacity`` are dropped (caller checks n_children > capacity).
+    """
+    idx = jnp.cumsum(split.astype(jnp.int32)) - 1
+    n_children = 2 * jnp.sum(split.astype(jnp.int32))
+    mid = (l + r) * 0.5
+    oob = jnp.asarray(capacity, dtype=jnp.int32)
+    left_slot = jnp.where(split, 2 * idx, oob)
+    right_slot = jnp.where(split, 2 * idx + 1, oob)
+    new_l = jnp.zeros(capacity, dtype=l.dtype)
+    new_r = jnp.zeros(capacity, dtype=r.dtype)
+    new_l = new_l.at[left_slot].set(l, mode="drop")
+    new_r = new_r.at[left_slot].set(mid, mode="drop")
+    new_l = new_l.at[right_slot].set(mid, mode="drop")
+    new_r = new_r.at[right_slot].set(r, mode="drop")
+    new_active = jnp.arange(capacity, dtype=jnp.int32) < n_children
+    return new_l, new_r, new_active, n_children
+
+
+def initial_state(a: float, b: float, capacity: int,
+                  dtype=jnp.float64) -> DeviceState:
+    """Seed the frontier with [a, b] (the farmer's initial push,
+    ``aquadPartA.c:135-137``)."""
+    l = jnp.zeros(capacity, dtype=dtype).at[0].set(a)
+    r = jnp.zeros(capacity, dtype=dtype).at[0].set(b)
+    active = jnp.zeros(capacity, dtype=bool).at[0].set(True)
+    zero = jnp.zeros((), dtype=dtype)
+    i0 = jnp.zeros((), dtype=jnp.int64)
+    return DeviceState(l=l, r=r, active=active, acc_s=zero, acc_c=zero,
+                       tasks=i0, splits=i0, rounds=i0,
+                       overflow=jnp.zeros((), dtype=bool))
+
+
+def round_body(state: DeviceState, f, eps: float, rule: Rule,
+               capacity: int) -> DeviceState:
+    """One wavefront round: evaluate → accumulate → compact."""
+    value, _err, split = eval_batch(state.l, state.r, f, eps, rule)
+    split = jnp.logical_and(split, state.active)
+    accept = jnp.logical_and(state.active, jnp.logical_not(split))
+    leaf_sum = jnp.sum(jnp.where(accept, value, 0.0))
+    acc_s, acc_c = kahan_add((state.acc_s, state.acc_c), leaf_sum)
+
+    n_active = jnp.sum(state.active.astype(jnp.int64))
+    n_split = jnp.sum(split.astype(jnp.int64))
+
+    new_l, new_r, new_active, n_children = compact_children(
+        state.l, state.r, split, capacity)
+    overflow = jnp.logical_or(state.overflow,
+                              n_children > jnp.asarray(capacity, jnp.int32))
+
+    return DeviceState(
+        l=new_l, r=new_r, active=new_active,
+        acc_s=acc_s, acc_c=acc_c,
+        tasks=state.tasks + n_active,
+        splits=state.splits + n_split,
+        rounds=state.rounds + 1,
+        overflow=overflow,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("f", "eps", "rule",
+                                             "capacity", "max_rounds"))
+def _run(state: DeviceState, *, f, eps: float, rule: Rule,
+         capacity: int, max_rounds: int) -> DeviceState:
+    # ``f`` (the integrand function object, hashable) is the static key —
+    # not a registry name — so re-registration never hits a stale compile.
+
+    def cond(s: DeviceState):
+        return jnp.logical_and(
+            jnp.logical_and(jnp.any(s.active), jnp.logical_not(s.overflow)),
+            s.rounds < max_rounds,
+        )
+
+    def body(s: DeviceState):
+        return round_body(s, f, eps, rule, capacity)
+
+    return lax.while_loop(cond, body, state)
+
+
+@dataclasses.dataclass
+class DeviceResult:
+    area: float
+    state: DeviceState
+    metrics: RunMetrics
+    exact: Optional[float] = None
+
+    @property
+    def global_error(self) -> Optional[float]:
+        return None if self.exact is None else abs(self.area - self.exact)
+
+
+def device_integrate(config: QuadConfig = QuadConfig(),
+                     fallback: bool = True) -> DeviceResult:
+    """Run the whole adaptive integration in one device computation.
+
+    If the fixed-capacity frontier overflows and ``fallback`` is True, the
+    run transparently restarts on the host-driven engine (unbounded bag).
+    """
+    import time
+
+    entry = get_integrand(config.integrand)
+    state = initial_state(config.a, config.b, config.capacity,
+                          dtype=jnp.dtype(config.dtype))
+    t0 = time.perf_counter()
+    out = _run(state, f=entry.fn, eps=float(config.eps),
+               rule=Rule(config.rule), capacity=int(config.capacity),
+               max_rounds=int(config.max_rounds))
+    out = jax.tree.map(lambda x: x.block_until_ready(), out)
+    wall = time.perf_counter() - t0
+
+    if bool(out.overflow):
+        if not fallback:
+            raise RuntimeError(
+                f"device frontier overflowed capacity={config.capacity}; "
+                f"raise capacity or use the host engine"
+            )
+        from ppls_tpu.runtime.host_frontier import integrate
+        host = integrate(config)
+        metrics = host.metrics
+        return DeviceResult(area=host.area, state=out, metrics=metrics,
+                            exact=host.exact)
+
+    if bool(out.rounds >= config.max_rounds) and bool(jnp.any(out.active)):
+        raise RuntimeError(f"max_rounds={config.max_rounds} exceeded")
+
+    tasks = int(out.tasks)
+    metrics = RunMetrics(
+        tasks=tasks,
+        splits=int(out.splits),
+        leaves=tasks - int(out.splits),
+        rounds=int(out.rounds),
+        max_depth=max(int(out.rounds) - 1, 0),
+        integrand_evals=tasks * EVALS_PER_TASK[Rule(config.rule)],
+        wall_time_s=wall,
+        n_chips=1,
+        tasks_per_chip=[tasks],
+    )
+    return DeviceResult(
+        area=float(out.acc_s + out.acc_c),
+        state=out,
+        metrics=metrics,
+        exact=entry.exact(config.a, config.b),
+    )

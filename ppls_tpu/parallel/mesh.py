@@ -1,0 +1,33 @@
+"""Mesh construction helpers.
+
+The reference's process topology is ``mpirun -c N`` — a flat rank space
+with rank 0 as farmer (``aquadPartA.c:92-105``). The TPU-native topology is
+a 1-D ``jax.sharding.Mesh`` over the frontier axis; there is no dedicated
+coordinator chip (coordination is collectives, not a role).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+FRONTIER_AXIS = "d"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = FRONTIER_AXIS) -> Mesh:
+    """1-D device mesh over the frontier axis.
+
+    ``n_devices=None`` uses every visible device. Multi-host runs get the
+    same program: ``jax.devices()`` spans hosts and the collectives ride
+    ICI within a slice and DCN across slices.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} visible"
+            )
+        devs = devs[:n_devices]
+    return jax.make_mesh((len(devs),), (axis_name,), devices=devs)

@@ -1,0 +1,240 @@
+"""Multi-chip wavefront integrator: shard_map over a device mesh.
+
+This is the TPU-native replacement for the reference's entire MPI layer
+(``aquadPartA.c:82-84,145-206``), per SURVEY.md §5:
+
+* per-worker task dispatch (``MPI_Send(pop(bag))``, ``aquadPartA.c:159``)
+  → the frontier lives sharded across chips, one shard per chip;
+* result accumulation (``result += buff[0]``, ``aquadPartA.c:149``)
+  → per-chip Kahan partials, one ``lax.psum`` at the end;
+* distributed termination (bag empty ∧ all idle, ``aquadPartA.c:166``)
+  → ``lax.psum`` of per-chip pending counts inside the loop, exit on zero;
+* demand-driven load balancing (the farmer's idle scan,
+  ``aquadPartA.c:156-165``) → a deterministic all_gather + strided
+  re-shard of the children every round, so refinement clustered on one
+  chip's subdomain (sin(1/x) near 0) is spread evenly at batch granularity.
+
+Everything runs inside one ``lax.while_loop`` under ``shard_map`` — zero
+host round-trips, collectives on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ppls_tpu.config import QuadConfig, Rule
+from ppls_tpu.models.integrands import get_integrand
+from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
+from ppls_tpu.ops.reduction import kahan_add
+from ppls_tpu.parallel.device_engine import compact_children
+from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh
+from ppls_tpu.utils.metrics import RunMetrics
+
+
+class ShardState(NamedTuple):
+    """Per-chip loop carry (inside shard_map: local shard views)."""
+
+    l: jnp.ndarray          # (cap_per_chip,)
+    r: jnp.ndarray          # (cap_per_chip,)
+    active: jnp.ndarray     # (cap_per_chip,) bool
+    acc_s: jnp.ndarray      # per-chip Kahan partial sum
+    acc_c: jnp.ndarray
+    tasks: jnp.ndarray      # per-chip task counter (the parity histogram,
+                            # cf. tasks_per_process at aquadPartA.c:162)
+    splits: jnp.ndarray
+    rounds: jnp.ndarray     # replicated round counter
+    overflow: jnp.ndarray   # replicated overflow flag
+
+
+def _shard_round(state: ShardState, f, eps: float, rule: Rule,
+                 cap: int, axis: str) -> ShardState:
+    """One sharded wavefront round. ``cap`` is capacity per chip."""
+    n_dev = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+
+    # --- evaluate local shard (the worker step, aquadPartA.c:183-202) ---
+    value, _err, split = eval_batch(state.l, state.r, f, eps, rule)
+    split = jnp.logical_and(split, state.active)
+    accept = jnp.logical_and(state.active, jnp.logical_not(split))
+    leaf_sum = jnp.sum(jnp.where(accept, value, 0.0))
+    acc_s, acc_c = kahan_add((state.acc_s, state.acc_c), leaf_sum)
+
+    n_active = jnp.sum(state.active.astype(jnp.int64))
+    n_split_local = jnp.sum(split.astype(jnp.int32))
+
+    # --- children of local splits, compacted to a dense local prefix
+    # (same cumsum scatter as the single-chip engine) ---
+    ch_l, ch_r, _ch_active, n_children_local = compact_children(
+        state.l, state.r, split, 2 * cap)  # 2*cap slots: never drops
+
+    # --- global rebalance: the demand-driven farmer dispatch recreated at
+    # batch granularity (SURVEY.md §7 "load balance across chips").
+    # all_gather every chip's dense children prefix + counts, compact the
+    # concatenation globally, then chip d takes the strided slice d::n_dev
+    # (perfect balance within one interval, deterministic order). ---
+    all_l = lax.all_gather(ch_l, axis)        # (n_dev, 2*cap)
+    all_r = lax.all_gather(ch_r, axis)
+    counts = lax.all_gather(n_children_local, axis)   # (n_dev,)
+    offsets = jnp.cumsum(counts) - counts             # exclusive prefix
+    total = jnp.sum(counts)
+
+    # Scatter each chip's children into a global dense buffer of
+    # n_dev * 2*cap slots at offset[chip] + local position.
+    local_pos = jnp.arange(2 * cap, dtype=jnp.int32)
+    glob_size = n_dev * 2 * cap
+    valid = local_pos[None, :] < counts[:, None]
+    glob_slot = jnp.where(valid, offsets[:, None] + local_pos[None, :],
+                          jnp.asarray(glob_size, jnp.int32))
+    g_l = jnp.zeros(glob_size, dtype=state.l.dtype)
+    g_r = jnp.zeros(glob_size, dtype=state.r.dtype)
+    g_l = g_l.at[glob_slot.reshape(-1)].set(all_l.reshape(-1), mode="drop")
+    g_r = g_r.at[glob_slot.reshape(-1)].set(all_r.reshape(-1), mode="drop")
+
+    # Chip `my` takes global children my, my+n_dev, my+2*n_dev, ...
+    take = my + jnp.arange(cap, dtype=jnp.int32) * n_dev
+    new_l = g_l[take]
+    new_r = g_r[take]
+    new_active = take < total
+
+    overflow = jnp.logical_or(state.overflow, total > n_dev * cap)
+
+    return ShardState(
+        l=new_l, r=new_r, active=new_active,
+        acc_s=acc_s, acc_c=acc_c,
+        tasks=state.tasks + n_active,
+        splits=state.splits + jnp.asarray(n_split_local, jnp.int64),
+        rounds=state.rounds + 1,
+        overflow=overflow,
+    )
+
+
+def build_sharded_run(mesh: Mesh, integrand: str, eps: float, rule: Rule,
+                      cap_per_chip: int, max_rounds: int):
+    """Build the jitted sharded integrator for a mesh.
+
+    Returns ``run(state) -> state`` where state arrays are globally shaped
+    (n_dev * cap_per_chip,) sharded over the mesh axis, and scalar fields
+    are replicated.
+    """
+    f = get_integrand(integrand).fn
+    axis = FRONTIER_AXIS
+
+    def shard_body(l, r, active, acc_s, acc_c, tasks, splits, rounds, overflow):
+        # Inside shard_map: args are local shards with leading dim cap;
+        # scalar state travels as (n_dev,) per-chip arrays (local shape
+        # (1,)) so every carry component is device-varying — keeps the
+        # while_loop carry VMA-consistent without pcast gymnastics.
+        state = ShardState(l=l, r=r, active=active,
+                           acc_s=acc_s[0], acc_c=acc_c[0],
+                           tasks=tasks[0], splits=splits[0],
+                           rounds=rounds[0], overflow=overflow[0])
+
+        def cond(s: ShardState):
+            # Global termination: psum of per-chip pending counts — the
+            # collective analog of aquadPartA.c:166.
+            pending = lax.psum(jnp.sum(s.active.astype(jnp.int32)), axis)
+            return jnp.logical_and(
+                jnp.logical_and(pending > 0, jnp.logical_not(s.overflow)),
+                s.rounds < max_rounds,
+            )
+
+        def body(s: ShardState):
+            return _shard_round(s, f, eps, rule, cap_per_chip, axis)
+
+        out = lax.while_loop(cond, body, state)
+        return (out.l, out.r, out.active,
+                out.acc_s[None], out.acc_c[None],
+                out.tasks[None], out.splits[None],
+                out.rounds[None], out.overflow[None])
+
+    sharded = P(axis)
+    per_chip = P(axis)  # per-chip scalars stored as (n_dev,) arrays
+    fn = jax.jit(jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(sharded,) * 3 + (per_chip,) * 6,
+        out_specs=(sharded,) * 3 + (per_chip,) * 6,
+    ))
+    return fn
+
+
+@dataclasses.dataclass
+class ShardedResult:
+    area: float
+    metrics: RunMetrics
+    exact: Optional[float] = None
+
+    @property
+    def global_error(self) -> Optional[float]:
+        return None if self.exact is None else abs(self.area - self.exact)
+
+
+def sharded_integrate(config: QuadConfig = QuadConfig(),
+                      mesh: Optional[Mesh] = None) -> ShardedResult:
+    """Integrate across the mesh; see module docstring for the design."""
+    import time
+
+    if mesh is None:
+        mesh = make_mesh(config.n_devices)
+    n_dev = mesh.devices.size
+    cap = max(config.capacity // n_dev, 8)
+
+    run = build_sharded_run(mesh, config.integrand, float(config.eps),
+                            Rule(config.rule), cap, int(config.max_rounds))
+
+    glob = n_dev * cap
+    dtype = jnp.dtype(config.dtype)
+    l = jnp.zeros(glob, dtype=dtype).at[0].set(config.a)
+    r = jnp.zeros(glob, dtype=dtype).at[0].set(config.b)
+    active = jnp.zeros(glob, dtype=bool).at[0].set(True)
+    zeros_chip = jnp.zeros(n_dev, dtype=dtype)
+    i0_chip = jnp.zeros(n_dev, dtype=jnp.int64)
+    rounds0 = jnp.zeros(n_dev, dtype=jnp.int64)
+    overflow0 = jnp.zeros(n_dev, dtype=bool)
+
+    t0 = time.perf_counter()
+    out = run(l, r, active, zeros_chip, zeros_chip, i0_chip, i0_chip,
+              rounds0, overflow0)
+    out = jax.tree.map(lambda x: x.block_until_ready(), out)
+    wall = time.perf_counter() - t0
+    (_, _, out_active, acc_s, acc_c, tasks_chip, splits_chip,
+     rounds_chip, overflow_chip) = out
+    rounds = int(np.asarray(rounds_chip)[0])
+    overflow = bool(np.asarray(overflow_chip)[0])
+
+    if overflow:
+        raise RuntimeError(
+            f"sharded frontier overflowed global capacity {glob}; raise "
+            f"config.capacity")
+    if rounds >= config.max_rounds and np.asarray(out_active).any():
+        raise RuntimeError(f"max_rounds={config.max_rounds} exceeded")
+
+    # Deterministic cross-chip reduction on host: fixed chip order.
+    acc_s_np = np.asarray(acc_s, dtype=np.float64)
+    acc_c_np = np.asarray(acc_c, dtype=np.float64)
+    area = float(np.sum(acc_s_np + acc_c_np))
+
+    tasks_per_chip = [int(t) for t in np.asarray(tasks_chip)]
+    tasks = sum(tasks_per_chip)
+    splits = int(np.sum(np.asarray(splits_chip)))
+    entry = get_integrand(config.integrand)
+    metrics = RunMetrics(
+        tasks=tasks,
+        splits=splits,
+        leaves=tasks - splits,
+        rounds=rounds,
+        max_depth=max(rounds - 1, 0),
+        integrand_evals=tasks * EVALS_PER_TASK[Rule(config.rule)],
+        wall_time_s=wall,
+        n_chips=n_dev,
+        tasks_per_chip=tasks_per_chip,
+    )
+    return ShardedResult(area=area, metrics=metrics,
+                         exact=entry.exact(config.a, config.b))

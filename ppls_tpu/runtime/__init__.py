@@ -1,0 +1,3 @@
+from ppls_tpu.runtime.host_frontier import integrate, IntegrationResult
+
+__all__ = ["integrate", "IntegrationResult"]

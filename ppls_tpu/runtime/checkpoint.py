@@ -1,0 +1,88 @@
+"""Checkpoint / resume between wavefront rounds.
+
+The reference has no checkpointing: all state is in-memory (the bag at
+``aquadPartA.c:133``, the running ``result`` at ``:131``) and a dead worker
+hangs the farmer's blocking recv forever (``aquadPartA.c:145`` — SURVEY.md
+§5, failure detection). Here the host frontier engine owns all state, so
+the complete run state is (frontier intervals, compensated accumulator,
+metrics) — a few KB per round — and any round boundary is a resume point.
+
+Usage::
+
+    ckpt = Checkpointer(path, every=1)
+    result = integrate(cfg, on_round=ckpt.hook)           # run + snapshot
+    ...
+    result = resume(path, cfg)                            # pick up anywhere
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ppls_tpu.config import QuadConfig
+from ppls_tpu.utils.metrics import RoundStats, RunMetrics
+
+_META_KEYS = ("tasks", "splits", "leaves", "rounds", "max_depth",
+              "integrand_evals", "wall_time_s", "n_chips")
+
+
+def save_checkpoint(path: str, frontier: np.ndarray,
+                    area_acc: Tuple[float, float],
+                    metrics: RunMetrics) -> None:
+    """Atomically write (frontier, accumulator, metrics) to ``path``."""
+    meta = {k: getattr(metrics, k) for k in _META_KEYS}
+    meta["per_round"] = [dataclasses.asdict(s) for s in metrics.per_round]
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez(
+                fh,
+                frontier=np.asarray(frontier, dtype=np.float64).reshape(-1, 2),
+                acc=np.asarray(area_acc, dtype=np.float64),
+                meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+            )
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_checkpoint(path: str):
+    """Returns (frontier, (s, c), RunMetrics)."""
+    with np.load(path) as z:
+        frontier = z["frontier"]
+        s, c = (float(x) for x in z["acc"])
+        meta = json.loads(bytes(z["meta"]).decode())
+    per_round = [RoundStats(**d) for d in meta.pop("per_round")]
+    metrics = RunMetrics(**meta, per_round=per_round)
+    return frontier, (s, c), metrics
+
+
+class Checkpointer:
+    """``on_round`` hook that snapshots every N rounds."""
+
+    def __init__(self, path: str, every: int = 1):
+        self.path = path
+        self.every = max(int(every), 1)
+
+    def hook(self, round_index: int, frontier, area_acc, metrics) -> None:
+        if round_index % self.every == 0:
+            save_checkpoint(self.path, frontier, area_acc, metrics)
+
+
+def resume(path: str, config: QuadConfig,
+           on_round: Optional[callable] = None):
+    """Continue an interrupted run from its last snapshot."""
+    from ppls_tpu.runtime.host_frontier import integrate
+
+    frontier, acc, metrics = load_checkpoint(path)
+    return integrate(config, frontier=frontier, area_acc=acc,
+                     metrics=metrics, on_round=on_round)

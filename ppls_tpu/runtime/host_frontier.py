@@ -1,0 +1,181 @@
+"""Host-side frontier engine: the farmer, re-drawn for SPMD hardware.
+
+The reference farmer (``aquadPartA.c:125-173``) owns a LIFO bag of interval
+tasks and dispatches them one at a time to whichever worker is idle —
+demand-driven load balancing at single-task granularity, 4 MPI messages per
+split round-trip (SURVEY.md §3, hot-loop economics).
+
+On a TPU the same capabilities invert: the host owns a *wavefront frontier*
+(all pending intervals) and dispatches the entire generation as one padded,
+masked, fixed-width batch per round. A batched launch is intrinsically
+load-balanced across a chip's lanes; the bag's dynamic growth becomes
+host-side compaction of the split outputs between rounds; termination
+(``aquadPartA.c:166``: bag empty and all workers idle) becomes "frontier
+empty". The reference workload runs in 15 rounds with a peak frontier of
+1642 intervals (SURVEY.md §0) instead of 6567 message round-trips.
+
+This engine is the fully-general path: unbounded frontier growth (numpy
+arrays on host), bucketed batch widths to bound recompilation, per-round
+checkpointability. The fully-on-device variant lives in
+``ppls_tpu.parallel.device_engine``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ppls_tpu.config import QuadConfig, Rule
+from ppls_tpu.models.integrands import get_integrand
+from ppls_tpu.ops.reduction import neumaier_add_host
+from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
+from ppls_tpu.utils.metrics import RoundStats, RunMetrics
+
+
+@dataclasses.dataclass
+class IntegrationResult:
+    area: float
+    config: QuadConfig
+    metrics: RunMetrics
+    exact: Optional[float] = None
+
+    @property
+    def global_error(self) -> Optional[float]:
+        """Achieved |area - exact|; the reference cannot report this
+        (its eps is a per-interval split tolerance, not a global bound —
+        SURVEY.md §0)."""
+        if self.exact is None:
+            return None
+        return abs(self.area - self.exact)
+
+
+def _bucket_width(n: int, min_batch: int) -> int:
+    """Next power of two >= max(n, min_batch): bounds jit recompilations to
+    O(log(peak frontier)) distinct shapes."""
+    w = max(int(min_batch), 1)
+    while w < n:
+        w <<= 1
+    return w
+
+
+@functools.lru_cache(maxsize=64)
+def _round_step(f: Callable, eps: float, rule: Rule):
+    """Jitted one-round step, cached per (integrand fn, eps, rule).
+
+    Keyed on the function object itself — not the registry name — so
+    re-registering an integrand under the same name never serves a stale
+    compiled step.
+
+    (l, r, active) -> (leaf_sum, split_mask): evaluate every active
+    interval, sum the accepted values deterministically, and return which
+    intervals must split. The shape-polymorphic jit cache handles the
+    bucketed widths.
+    """
+
+    @jax.jit
+    def step(l, r, active):
+        value, _err, split = eval_batch(l, r, f, eps, rule)
+        split = jnp.logical_and(split, active)
+        accept = jnp.logical_and(active, jnp.logical_not(split))
+        leaf_sum = jnp.sum(jnp.where(accept, value, 0.0))
+        return leaf_sum, split
+
+    return step
+
+
+def integrate(config: QuadConfig = QuadConfig(),
+              frontier: Optional[np.ndarray] = None,
+              area_acc: Tuple[float, float] = (0.0, 0.0),
+              metrics: Optional[RunMetrics] = None,
+              on_round: Optional[Callable] = None) -> IntegrationResult:
+    """Adaptively integrate per ``config``; host-driven wavefront loop.
+
+    ``frontier``/``area_acc``/``metrics`` allow resuming a checkpointed run
+    (see ``ppls_tpu.runtime.checkpoint``): pass the saved frontier and
+    accumulator and the loop continues where it stopped.
+
+    ``on_round(round_index, frontier, area_acc, metrics)`` is invoked after
+    each wavefront round — the hook used for checkpointing and tracing.
+    """
+    entry = get_integrand(config.integrand)
+    step = _round_step(entry.fn, float(config.eps), Rule(config.rule))
+    dtype = np.dtype(config.dtype)
+
+    if frontier is None:
+        frontier = np.array([[config.a, config.b]], dtype=dtype)
+    else:
+        frontier = np.asarray(frontier, dtype=dtype).reshape(-1, 2)
+    s, c = area_acc
+    metrics = metrics or RunMetrics()
+    start_rounds = metrics.rounds
+
+    t0 = time.perf_counter()
+    while frontier.shape[0] > 0:
+        if metrics.rounds - start_rounds >= config.max_rounds:
+            raise RuntimeError(
+                f"max_rounds={config.max_rounds} exceeded with "
+                f"{frontier.shape[0]} intervals pending; raise max_rounds "
+                f"or loosen eps"
+            )
+        n = frontier.shape[0]
+        width = _bucket_width(n, config.min_batch)
+        # Pad with degenerate [0,0] intervals, masked inactive.
+        l = np.zeros(width, dtype=dtype)
+        r = np.zeros(width, dtype=dtype)
+        l[:n] = frontier[:, 0]
+        r[:n] = frontier[:, 1]
+        active = np.zeros(width, dtype=bool)
+        active[:n] = True
+
+        leaf_sum, split = step(jnp.asarray(l), jnp.asarray(r),
+                               jnp.asarray(active))
+        split_np = np.asarray(split)[:n]
+        n_split = int(split_np.sum())
+
+        s, c = neumaier_add_host(s, c, float(leaf_sum))
+
+        # Compact the split outputs into the next frontier: both halves of
+        # each split interval (the worker's two tag-0 sends,
+        # aquadPartA.c:192-197), left children first — a deterministic
+        # breadth-first ordering.
+        if n_split:
+            ls = frontier[split_np, 0]
+            rs = frontier[split_np, 1]
+            mid = (ls + rs) * 0.5
+            nxt = np.empty((2 * n_split, 2), dtype=dtype)
+            nxt[0::2, 0] = ls
+            nxt[0::2, 1] = mid
+            nxt[1::2, 0] = mid
+            nxt[1::2, 1] = rs
+            next_frontier = nxt
+        else:
+            next_frontier = np.empty((0, 2), dtype=dtype)
+
+        metrics.record_round(RoundStats(
+            round_index=metrics.rounds,
+            frontier_width=n,
+            splits=n_split,
+            leaves=n - n_split,
+            padded_width=width,
+        ))
+        frontier = next_frontier
+        if on_round is not None:
+            on_round(metrics.rounds, frontier, (s, c), metrics)
+
+    metrics.wall_time_s += time.perf_counter() - t0
+    metrics.max_depth = max(metrics.rounds - 1, 0)
+    metrics.integrand_evals = metrics.tasks * EVALS_PER_TASK[Rule(config.rule)]
+    metrics.tasks_per_chip = [metrics.tasks]
+
+    return IntegrationResult(
+        area=s + c,
+        config=config,
+        metrics=metrics,
+        exact=entry.exact(config.a, config.b),
+    )

@@ -1,0 +1,3 @@
+from ppls_tpu.utils.metrics import RoundStats, RunMetrics
+
+__all__ = ["RoundStats", "RunMetrics"]

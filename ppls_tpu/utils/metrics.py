@@ -1,0 +1,79 @@
+"""Metrics and observability.
+
+The reference's entire observability surface is one counter table —
+``tasks_per_process[]`` incremented per dispatch (``aquadPartA.c:162``) and
+printed at exit (``aquadPartA.c:109-118``) — plus the final area. Here
+every run produces per-round wavefront statistics (frontier width, accept
+rate, split rate), cumulative task/eval counts that reproduce the
+reference's histogram at chip granularity, achieved global error when the
+analytic integral is known, and throughput in subintervals/sec/chip (the
+BASELINE.json north-star metric).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class RoundStats:
+    """One wavefront round (one device launch generation)."""
+
+    round_index: int
+    frontier_width: int      # active intervals evaluated this round
+    splits: int              # intervals that refined
+    leaves: int              # intervals accepted into the area
+    padded_width: int = 0    # padded batch width actually launched
+
+    @property
+    def accept_rate(self) -> float:
+        return self.leaves / self.frontier_width if self.frontier_width else 0.0
+
+
+@dataclasses.dataclass
+class RunMetrics:
+    """Aggregate metrics for one integration run."""
+
+    tasks: int = 0           # total intervals evaluated (reference: 6567)
+    splits: int = 0          # reference: 3283
+    leaves: int = 0          # reference: 3284
+    rounds: int = 0          # wavefront rounds (reference workload: 15)
+    max_depth: int = 0       # refinement depth (reference: 14)
+    integrand_evals: int = 0  # distinct f(x) evaluations
+    wall_time_s: float = 0.0
+    n_chips: int = 1
+    tasks_per_chip: Optional[List[int]] = None  # parity histogram analog
+    per_round: List[RoundStats] = dataclasses.field(default_factory=list)
+
+    @property
+    def evals_per_sec_per_chip(self) -> float:
+        if self.wall_time_s <= 0:
+            return 0.0
+        return self.integrand_evals / self.wall_time_s / max(self.n_chips, 1)
+
+    @property
+    def tasks_per_sec_per_chip(self) -> float:
+        if self.wall_time_s <= 0:
+            return 0.0
+        return self.tasks / self.wall_time_s / max(self.n_chips, 1)
+
+    def record_round(self, stats: RoundStats) -> None:
+        self.per_round.append(stats)
+        self.rounds = len(self.per_round)
+        self.tasks += stats.frontier_width
+        self.splits += stats.splits
+        self.leaves += stats.leaves
+
+    def histogram_str(self) -> str:
+        """Tasks-per-chip table in the spirit of ``aquadPartA.c:109-118``."""
+        counts = self.tasks_per_chip or [self.tasks]
+        head = "\t".join(str(i) for i in range(len(counts)))
+        body = "\t".join(str(c) for c in counts)
+        return f"Tasks Per Chip\n{head}\n{body}"
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["evals_per_sec_per_chip"] = self.evals_per_sec_per_chip
+        return json.dumps(d)

@@ -1,0 +1,23 @@
+"""Test env: 8 virtual CPU devices, f64 enabled.
+
+Must run before the first ``import jax`` anywhere in the test process
+(SURVEY.md §4: multi-device tests on CPU via
+``--xla_force_host_platform_device_count`` so no TPU cluster is needed).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The sandbox pre-imports jax via a sitecustomize (PYTHONPATH points at an
+# axon site dir), so the env var alone can be too late; the config update
+# still wins as long as no backend has initialized.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

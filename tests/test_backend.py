@@ -1,0 +1,51 @@
+"""Backend shim tests: C sequential baseline + MPI parity harness
+(SURVEY.md §7 step 6). The MPI path is gated on an MPI toolchain."""
+
+import pytest
+
+from ppls_tpu.backends import build_seq, mpi_available, run_mpi, run_seq
+from ppls_tpu.config import REFERENCE_CONFIG, Rule
+from ppls_tpu.runtime.host_frontier import integrate
+
+needs_cc = pytest.mark.skipif(build_seq() is None,
+                              reason="no C compiler on PATH")
+
+
+@needs_cc
+def test_seq_backend_golden():
+    res = run_seq(REFERENCE_CONFIG)
+    assert f"{res.area:.6f}" == "7583461.801486"
+    assert res.metrics.tasks == 6567
+    assert res.metrics.splits == 3283
+    assert res.metrics.max_depth == 14
+
+
+@needs_cc
+def test_seq_matches_jax_backend():
+    c = run_seq(REFERENCE_CONFIG)
+    j = integrate(REFERENCE_CONFIG)
+    # Same task tree; printed-precision identical area (summation orders
+    # differ: LIFO vs breadth-first).
+    assert c.metrics.tasks == j.metrics.tasks
+    assert c.metrics.splits == j.metrics.splits
+    assert abs(c.area - j.area) < 1e-6
+
+
+def test_backend_rejects_simpson():
+    with pytest.raises(ValueError, match="trapezoid"):
+        run_seq(REFERENCE_CONFIG.replace(rule=Rule.SIMPSON))
+
+
+def test_backend_rejects_unknown_integrand():
+    with pytest.raises(ValueError, match="integrands"):
+        run_seq(REFERENCE_CONFIG.replace(integrand="runge"))
+
+
+def test_mpi_gated():
+    if not mpi_available():
+        with pytest.raises(RuntimeError, match="mpicc"):
+            run_mpi(REFERENCE_CONFIG)
+    else:
+        res = run_mpi(REFERENCE_CONFIG, n_workers=4)
+        assert f"{res.area:.6f}" == "7583461.801486"
+        assert res.metrics.tasks == 6567

@@ -1,0 +1,56 @@
+"""Checkpoint/resume round-trip tests (SURVEY.md §5: the reference has no
+checkpointing; here any round boundary is a resume point)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ppls_tpu.config import REFERENCE_CONFIG
+from ppls_tpu.runtime.checkpoint import (
+    Checkpointer,
+    load_checkpoint,
+    resume,
+    save_checkpoint,
+)
+from ppls_tpu.runtime.host_frontier import integrate
+
+
+def test_save_load_roundtrip(tmp_path):
+    path = str(tmp_path / "run.ckpt")
+    frontier = np.array([[0.0, 1.0], [1.0, 2.5]])
+    from ppls_tpu.utils.metrics import RoundStats, RunMetrics
+
+    m = RunMetrics()
+    m.record_round(RoundStats(round_index=0, frontier_width=1, splits=1,
+                              leaves=0, padded_width=256))
+    save_checkpoint(path, frontier, (1.5, -2e-17), m)
+    f2, (s, c), m2 = load_checkpoint(path)
+    np.testing.assert_array_equal(f2, frontier)
+    assert (s, c) == (1.5, -2e-17)
+    assert m2.tasks == m.tasks and m2.rounds == m.rounds
+    assert m2.per_round[0].frontier_width == 1
+
+
+def test_interrupt_and_resume_exact(tmp_path):
+    path = str(tmp_path / "run.ckpt")
+    full = integrate(REFERENCE_CONFIG)
+
+    class Interrupt(Exception):
+        pass
+
+    ckpt = Checkpointer(path)
+
+    def crashing_hook(round_index, frontier, acc, metrics):
+        ckpt.hook(round_index, frontier, acc, metrics)
+        if round_index == 7:
+            raise Interrupt  # simulated failure mid-run
+
+    with pytest.raises(Interrupt):
+        integrate(REFERENCE_CONFIG, on_round=crashing_hook)
+
+    assert os.path.exists(path)
+    res = resume(path, REFERENCE_CONFIG)
+    assert res.area == full.area  # bit-identical to the uninterrupted run
+    assert res.metrics.tasks == full.metrics.tasks == 6567
+    assert res.metrics.rounds == 15

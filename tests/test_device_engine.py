@@ -1,0 +1,63 @@
+"""Fully-on-device lax.while_loop integrator tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ppls_tpu import QuadConfig, device_integrate, integrate
+from ppls_tpu.config import REFERENCE_CONFIG, Rule
+from ppls_tpu.parallel.device_engine import compact_children
+
+
+def test_compact_children_dense_prefix():
+    l = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+    r = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    split = jnp.asarray([True, False, True, False])
+    nl, nr, active, n = compact_children(l, r, split, capacity=8)
+    assert int(n) == 4
+    np.testing.assert_allclose(np.asarray(nl[:4]), [0.0, 0.5, 2.0, 2.5])
+    np.testing.assert_allclose(np.asarray(nr[:4]), [0.5, 1.0, 2.5, 3.0])
+    assert np.asarray(active).tolist() == [True] * 4 + [False] * 4
+
+
+def test_compact_children_overflow_drops():
+    l = jnp.zeros(4)
+    r = jnp.ones(4)
+    split = jnp.ones(4, dtype=bool)
+    nl, nr, active, n = compact_children(l, r, split, capacity=4)
+    assert int(n) == 8  # caller detects overflow via n > capacity
+    assert np.asarray(active).sum() == 4  # mask capped at capacity
+
+
+def test_device_matches_host_golden():
+    cfg = REFERENCE_CONFIG.replace(capacity=4096)
+    dev = device_integrate(cfg)
+    host = integrate(cfg)
+    assert f"{dev.area:.6f}" == "7583461.801486"
+    assert dev.metrics.tasks == host.metrics.tasks == 6567
+    assert dev.metrics.splits == 3283
+    assert dev.metrics.rounds == 15
+    # identical breadth-first ordering => bit-identical leaf sums per round,
+    # same Kahan accumulation => bit-identical area
+    assert dev.area == host.area
+
+
+def test_device_overflow_falls_back_to_host():
+    # Capacity 64 < peak frontier 1642: must overflow and fall back.
+    cfg = REFERENCE_CONFIG.replace(capacity=64)
+    res = device_integrate(cfg, fallback=True)
+    assert f"{res.area:.6f}" == "7583461.801486"
+    assert res.metrics.tasks == 6567
+
+
+def test_device_overflow_raises_without_fallback():
+    cfg = REFERENCE_CONFIG.replace(capacity=64)
+    with pytest.raises(RuntimeError, match="overflow"):
+        device_integrate(cfg, fallback=False)
+
+
+def test_device_simpson_sin():
+    cfg = QuadConfig(integrand="sin", a=0.0, b=1.0, eps=1e-8,
+                     rule=Rule.SIMPSON, capacity=1024)
+    res = device_integrate(cfg)
+    assert res.global_error < 1e-7

@@ -1,0 +1,95 @@
+"""Golden parity with the reference (SURVEY.md §0 verified ground truth):
+Area=7583461.801486 at printed precision, 6567 tasks (3283 splits + 3284
+leaves), depth 14, 15 wavefront rounds, peak frontier 1642."""
+
+import numpy as np
+import pytest
+
+from ppls_tpu import QuadConfig, integrate
+from ppls_tpu.config import REFERENCE_CONFIG, Rule
+
+
+@pytest.fixture(scope="module")
+def reference_run():
+    return integrate(REFERENCE_CONFIG)
+
+
+def test_golden_area(reference_run):
+    # The header transcript's printed value (aquadPartA.c:32) at %lf
+    # precision (6 decimal places).
+    assert f"{reference_run.area:.6f}" == "7583461.801486"
+
+
+def test_golden_task_counts(reference_run):
+    m = reference_run.metrics
+    assert m.tasks == 6567
+    assert m.splits == 3283
+    assert m.leaves == 3284
+
+
+def test_golden_depth_and_rounds(reference_run):
+    m = reference_run.metrics
+    assert m.rounds == 15
+    assert m.max_depth == 14
+    assert max(s.frontier_width for s in m.per_round) == 1642
+
+
+def test_global_error_vs_analytic(reference_run):
+    # SURVEY.md §0: global abs error ~0.44 — eps is a local tolerance.
+    assert reference_run.exact is not None
+    assert abs(reference_run.global_error - 0.439990) < 1e-5
+
+
+def test_eval_count_minimal(reference_run):
+    # 3 evals per task (minimal), not the reference's 5 (SURVEY.md §2).
+    assert reference_run.metrics.integrand_evals == 6567 * 3
+
+
+def test_simpson_beats_trapezoid_globally():
+    trap = integrate(REFERENCE_CONFIG)
+    simp = integrate(REFERENCE_CONFIG.replace(rule=Rule.SIMPSON))
+    assert simp.global_error < trap.global_error
+    assert simp.metrics.tasks < trap.metrics.tasks  # fewer, smarter tasks
+
+
+def test_sin_config():
+    res = integrate(QuadConfig(integrand="sin", a=0.0, b=1.0, eps=1e-6))
+    assert abs(res.area - res.exact) < 1e-4  # local tol -> small global err
+
+
+def test_resume_midway_matches_full_run():
+    # Checkpointability of the engine state: stop after round 5, resume
+    # with the saved frontier/accumulator, and land on the identical area.
+    from ppls_tpu.runtime.host_frontier import integrate as run
+
+    full = run(REFERENCE_CONFIG)
+
+    saved = {}
+
+    class Stop(Exception):
+        pass
+
+    def hook(round_idx, frontier, acc, metrics):
+        if round_idx == 5:
+            saved["frontier"] = frontier.copy()
+            saved["acc"] = acc
+            raise Stop
+
+    with pytest.raises(Stop):
+        run(REFERENCE_CONFIG, on_round=hook)
+
+    resumed = run(REFERENCE_CONFIG, frontier=saved["frontier"],
+                  area_acc=saved["acc"])
+    assert resumed.area == full.area
+
+
+def test_deterministic_across_runs():
+    a1 = integrate(REFERENCE_CONFIG).area
+    a2 = integrate(REFERENCE_CONFIG).area
+    assert a1 == a2  # bit-identical, unlike MPI arrival-order sums
+
+
+def test_runge_adaptive():
+    res = integrate(QuadConfig(integrand="runge", a=-1.0, b=1.0, eps=1e-8,
+                               rule=Rule.SIMPSON))
+    assert res.global_error < 1e-6

@@ -1,0 +1,87 @@
+"""Unit tests of the quadrature rules against closed-form integrals
+(SURVEY.md §4: built from scratch against verified ground truth — the
+reference has no tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ppls_tpu import eval_batch, get_integrand
+from ppls_tpu.config import Rule
+from ppls_tpu.ops.rules import simpson_batch, trapezoid_batch
+
+
+def test_trapezoid_reference_semantics_single_interval():
+    # The very first task of the reference run: [0, 5] at eps=1e-3 must
+    # split (cosh^4 is wildly non-linear there), and the discrepancy must
+    # match the hand-computed trapezoid formulas of aquadPartA.c:185-191.
+    f = get_integrand("cosh4").fn
+    l = jnp.asarray([0.0])
+    r = jnp.asarray([5.0])
+    value, err, split = trapezoid_batch(l, r, f, 1e-3)
+    fl, fm, fr = float(f(0.0)), float(f(2.5)), float(f(5.0))
+    lrarea = (fl + fr) * 5.0 / 2.0
+    larea = (fl + fm) * 2.5 / 2.0
+    rarea = (fm + fr) * 2.5 / 2.0
+    np.testing.assert_allclose(float(value[0]), larea + rarea, rtol=1e-14)
+    np.testing.assert_allclose(float(err[0]), abs(larea + rarea - lrarea),
+                               rtol=1e-9)
+    assert bool(split[0])
+
+
+def test_trapezoid_strict_inequality():
+    # Reference splits on err > eps strictly (aquadPartA.c:191): an
+    # interval whose discrepancy equals eps exactly must be accepted.
+    # A linear integrand has zero discrepancy -> never splits even at eps=0.
+    f = lambda x: 2.0 * x
+    _, err, split = trapezoid_batch(jnp.asarray([0.0]), jnp.asarray([1.0]), f, 0.0)
+    assert float(err[0]) == 0.0
+    assert not bool(split[0])
+
+
+def test_simpson_exact_on_cubic():
+    # Simpson integrates cubics exactly: one interval, no split, value exact.
+    f = get_integrand("poly3").fn
+    value, err, split = simpson_batch(
+        jnp.asarray([0.0]), jnp.asarray([2.0]), f, 1e-12)
+    np.testing.assert_allclose(float(value[0]), 4.0, rtol=1e-14)
+    assert not bool(split[0])
+
+
+@pytest.mark.parametrize("rule", [Rule.TRAPEZOID, Rule.SIMPSON])
+def test_batch_matches_scalar(rule):
+    # Batched evaluation is elementwise-identical to per-interval eval.
+    f = get_integrand("sin").fn
+    l = jnp.linspace(0.0, 2.0, 64)
+    r = l + 0.25
+    bv, be, bs = eval_batch(l, r, f, 1e-6, rule)
+    for i in [0, 17, 63]:
+        sv, se, ss = eval_batch(l[i:i + 1], r[i:i + 1], f, 1e-6, rule)
+        np.testing.assert_array_equal(np.asarray(bv[i]), np.asarray(sv[0]))
+        np.testing.assert_array_equal(np.asarray(be[i]), np.asarray(se[0]))
+        assert bool(bs[i]) == bool(ss[0])
+
+
+def test_partition_additivity():
+    # Property: accepted value of [a,b] halves equals sum over the same
+    # halves evaluated as separate intervals (tolerance monotonicity basis).
+    f = get_integrand("exp").fn
+    v_whole, _, _ = trapezoid_batch(
+        jnp.asarray([0.0]), jnp.asarray([1.0]), f, 1e30)
+    # The accepted value of [0,1] is by construction the sum of the plain
+    # trapezoids on its halves (aquadPartA.c:189-190,199).
+    def coarse_trap(l, r):
+        return (np.exp(l) + np.exp(r)) * (r - l) / 2.0
+
+    expected = coarse_trap(0.0, 0.5) + coarse_trap(0.5, 1.0)
+    np.testing.assert_allclose(float(v_whole[0]), expected, rtol=1e-14)
+
+
+def test_integrand_registry():
+    from ppls_tpu import INTEGRANDS
+    for name in ["cosh4", "sin", "sin_recip", "gauss_peak", "poly3", "exp",
+                 "runge"]:
+        assert name in INTEGRANDS
+    # Analytic values sane
+    assert abs(get_integrand("cosh4").exact(0.0, 5.0) - 7583461.361497) < 1e-3
+    assert get_integrand("sin_recip").exact(0.0, 1.0) is None

@@ -1,0 +1,66 @@
+"""Multi-chip shard_map integrator on the 8-device CPU mesh
+(SURVEY.md §4: no TPU cluster needed in CI)."""
+
+import jax
+import numpy as np
+import pytest
+
+from ppls_tpu import QuadConfig, sharded_integrate
+from ppls_tpu.config import REFERENCE_CONFIG, Rule
+from ppls_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return make_mesh(8)
+
+
+def test_sharded_golden_area(mesh8):
+    cfg = REFERENCE_CONFIG.replace(capacity=1 << 14)
+    res = sharded_integrate(cfg, mesh=mesh8)
+    assert f"{res.area:.6f}" == "7583461.801486"
+    assert res.metrics.tasks == 6567
+    assert res.metrics.splits == 3283
+    assert res.metrics.rounds == 15
+    assert res.metrics.n_chips == 8
+
+
+def test_sharded_tasks_histogram_balanced(mesh8):
+    # The demand-driven rebalance should spread tasks within ~2x across
+    # chips (the reference's 4 workers got 1679/1605/1682/1601 —
+    # aquadPartA.c:36).
+    cfg = REFERENCE_CONFIG.replace(capacity=1 << 14)
+    res = sharded_integrate(cfg, mesh=mesh8)
+    counts = res.metrics.tasks_per_chip
+    assert len(counts) == 8
+    assert sum(counts) == 6567
+    assert max(counts) <= 2 * max(min(counts), 1)
+
+
+def test_sharded_matches_mesh_sizes():
+    # Same area across 1-, 2-, 4-, 8-chip meshes (reduction is
+    # deterministic per shape; cross-shape differences stay within fp noise).
+    areas = []
+    for n in [1, 2, 4, 8]:
+        mesh = make_mesh(n)
+        cfg = REFERENCE_CONFIG.replace(capacity=1 << 14)
+        areas.append(sharded_integrate(cfg, mesh=mesh).area)
+    for a in areas[1:]:
+        np.testing.assert_allclose(a, areas[0], rtol=1e-12)
+    # and every mesh shape prints the golden value
+    for a in areas:
+        assert f"{a:.6f}" == "7583461.801486"
+
+
+def test_sharded_deep_simpson(mesh8):
+    cfg = QuadConfig(integrand="runge", a=-1.0, b=1.0, eps=1e-10,
+                     rule=Rule.SIMPSON, capacity=1 << 14, max_rounds=64)
+    res = sharded_integrate(cfg, mesh=mesh8)
+    assert res.global_error < 1e-8
+
+
+def test_sharded_overflow_raises(mesh8):
+    cfg = REFERENCE_CONFIG.replace(capacity=128)  # 16/chip < peak 1642
+    with pytest.raises(RuntimeError, match="overflow"):
+        sharded_integrate(cfg, mesh=mesh8)
