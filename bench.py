@@ -1,0 +1,109 @@
+"""Benchmark: subintervals evaluated/sec/chip (BASELINE.json north star).
+
+Workload: the oscillatory family config — M independent integrals of
+sin(theta/x) on [1e-4, 1] at eps=1e-10 (BASELINE.json configs #2+#3
+combined: deep adaptive splitting, batched integrand family) — run
+end-to-end on the TPU bag engine, against the sequential C baseline
+(``ppls_tpu/backends/csrc/aquad_seq.c``, the "MPI/CPU" denominator; it is
+the reference architecture's single-process throughput on this host's
+modern CPU, a far harder baseline than the reference's 2010 Core 2 Duo).
+
+Correctness gate: TPU areas must match the C baseline areas (identical
+trapezoid rule + split semantics) to 1e-9 absolute before any number is
+reported.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+M = 128            # family size (independent integrals)
+EPS = 1e-10
+BOUNDS = (1e-4, 1.0)
+REPEATS = 3        # amortize fixed dispatch/sync overhead of the tunnel
+CPU_SAMPLE = 8     # C-baseline scales actually timed
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_cpu_baseline(theta):
+    """Sequential C reference on a sample of the family; returns
+    (evals_per_sec, {scale: area})."""
+    from ppls_tpu.backends.mpi_backend import build_seq, run_seq_family
+
+    if build_seq() is None:
+        return None, {}
+    total_evals = 0
+    total_time = 0.0
+    areas = {}
+    for s in theta[:: max(len(theta) // CPU_SAMPLE, 1)]:
+        d = run_seq_family("sin_recip_scaled", float(s), *BOUNDS, EPS)
+        total_evals += d["evals"]
+        total_time += d["wall_time_s"]
+        areas[float(s)] = d["area"]
+    return total_evals / total_time, areas
+
+
+def main():
+    theta = 1.0 + np.arange(M) / M
+
+    log(f"[bench] C baseline: {CPU_SAMPLE} of {M} scales at eps={EPS} ...")
+    cpu_rate, cpu_areas = run_cpu_baseline(theta)
+    if cpu_rate:
+        log(f"[bench] C seq: {cpu_rate/1e6:.1f} M evals/s")
+
+    from ppls_tpu.models.integrands import get_family
+    from ppls_tpu.parallel.bag_engine import integrate_family
+
+    f_theta = get_family("sin_recip_scaled")
+    kw = dict(chunk=1 << 16, capacity=1 << 22)
+
+    log("[bench] TPU warmup/compile ...")
+    res = integrate_family(f_theta, theta, BOUNDS, EPS, **kw)
+
+    # Correctness gate: identical rule + split semantics => areas match the
+    # C baseline to summation-order noise.
+    worst = 0.0
+    for i, s in enumerate(theta):
+        if float(s) in cpu_areas:
+            worst = max(worst, abs(res.areas[i] - cpu_areas[float(s)]))
+    if cpu_areas and worst > 1e-9:
+        print(json.dumps({"metric": "subintervals evaluated/sec/chip",
+                          "value": 0.0, "unit": "evals/s/chip",
+                          "vs_baseline": 0.0,
+                          "error": f"area mismatch vs C baseline: {worst:.3e}"}))
+        return 1
+    log(f"[bench] correctness: max |area_tpu - area_cpu| = {worst:.2e}")
+
+    log(f"[bench] timing {REPEATS} runs ...")
+    t0 = time.perf_counter()
+    evals = 0
+    for _ in range(REPEATS):
+        r = integrate_family(f_theta, theta, BOUNDS, EPS, **kw)
+        evals += r.metrics.integrand_evals
+    wall = time.perf_counter() - t0
+
+    value = evals / wall  # one chip
+    vs_baseline = value / cpu_rate if cpu_rate else 0.0
+    log(f"[bench] TPU: {value/1e6:.1f} M evals/s/chip "
+        f"({r.metrics.tasks} tasks/run, lane eff "
+        f"{r.lane_efficiency:.2f}) -> {vs_baseline:.1f}x CPU baseline")
+
+    print(json.dumps({
+        "metric": "subintervals evaluated/sec/chip",
+        "value": round(value, 1),
+        "unit": "evals/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

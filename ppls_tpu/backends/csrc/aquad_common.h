@@ -20,11 +20,16 @@
 
 /* ---- integrand registry (ids must match mpi_backend._C_INTEGRANDS) ---- */
 
+/* aq_scale parameterizes fid 3 (the "family" integrand sin(s/x), matching
+ * the jax registry's sin_recip_scaled) — set from argv before use. */
+static double aq_scale = 1.0;
+
 static double f_eval(int fid, double x) {
     switch (fid) {
     case 0: { double c = cosh(x); double c2 = c * c; return c2 * c2; }
     case 1: return sin(x);
     case 2: return sin(1.0 / x);
+    case 3: return sin(aq_scale / x);
     default:
         fprintf(stderr, "unknown integrand id %d\n", fid);
         exit(2);

@@ -8,14 +8,17 @@
 #include "aquad_common.h"
 
 int main(int argc, char **argv) {
-    if (argc != 5) {
-        fprintf(stderr, "usage: %s <integrand_id> <a> <b> <eps>\n", argv[0]);
+    if (argc != 5 && argc != 6) {
+        fprintf(stderr, "usage: %s <integrand_id> <a> <b> <eps> [scale]\n",
+                argv[0]);
         return 2;
     }
     int fid = atoi(argv[1]);
     double a = strtod(argv[2], NULL);
     double b = strtod(argv[3], NULL);
     double eps = strtod(argv[4], NULL);
+    if (argc == 6)
+        aq_scale = strtod(argv[5], NULL);
 
     aq_bag bag;
     bag_init(&bag);

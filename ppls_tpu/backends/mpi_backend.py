@@ -27,12 +27,22 @@ from ppls_tpu.utils.metrics import RunMetrics
 _CSRC = os.path.join(os.path.dirname(__file__), "csrc")
 _BUILD = os.path.join(_CSRC, "build")
 
-# Integrands the C backends implement (must match aquad_common.h).
+# Integrands the C backends implement (ids must match the f_eval switch in
+# aquad_common.h). Families take a scale argument (aq_scale).
 _C_INTEGRANDS = {"cosh4": 0, "sin": 1, "sin_recip": 2}
+_C_FAMILIES = {"sin_recip_scaled": 3}
 
 
 def mpi_available() -> bool:
     return shutil.which("mpicc") is not None and shutil.which("mpirun") is not None
+
+
+def _src_mtime(src: str) -> float:
+    """mtime of a C source INCLUDING its header (aquad_common.h carries
+    behavior — integrand registry, accumulation — so a header edit must
+    invalidate stale binaries)."""
+    header = os.path.join(_CSRC, "aquad_common.h")
+    return max(os.path.getmtime(src), os.path.getmtime(header))
 
 
 def _cc() -> Optional[str]:
@@ -50,7 +60,7 @@ def build_seq(force: bool = False) -> Optional[str]:
     out = os.path.join(_BUILD, "aquad_seq")
     src = os.path.join(_CSRC, "aquad_seq.c")
     if os.path.exists(out) and not force and \
-            os.path.getmtime(out) >= os.path.getmtime(src):
+            os.path.getmtime(out) >= _src_mtime(src):
         return out
     os.makedirs(_BUILD, exist_ok=True)
     subprocess.run([cc, "-O2", "-o", out, src, "-lm"], check=True,
@@ -65,7 +75,7 @@ def build_mpi(force: bool = False) -> Optional[str]:
     out = os.path.join(_BUILD, "aquad_mpi")
     src = os.path.join(_CSRC, "aquad_mpi.c")
     if os.path.exists(out) and not force and \
-            os.path.getmtime(out) >= os.path.getmtime(src):
+            os.path.getmtime(out) >= _src_mtime(src):
         return out
     os.makedirs(_BUILD, exist_ok=True)
     subprocess.run(["mpicc", "-O2", "-o", out, src, "-lm"], check=True,
@@ -117,6 +127,26 @@ def run_seq(config: QuadConfig) -> IntegrationResult:
          repr(config.eps)],
         capture_output=True, text=True, check=True)
     return _parse_result(proc.stdout, config, n_chips=1)
+
+
+def run_seq_family(family: str, scale: float, a: float, b: float,
+                   eps: float) -> dict:
+    """Run the sequential C driver on one member of a parameterized
+    family; returns the raw JSON record (area, tasks, evals, wall_time_s).
+    The protocol (id + scale argv) lives here, next to _C_FAMILIES, so
+    callers never hard-code integrand ids."""
+    if family not in _C_FAMILIES:
+        raise ValueError(
+            f"C backends support families {sorted(_C_FAMILIES)}; "
+            f"got {family!r}")
+    binary = build_seq()
+    if binary is None:
+        raise RuntimeError("no C compiler available for the seq backend")
+    proc = subprocess.run(
+        [binary, str(_C_FAMILIES[family]), repr(a), repr(b), repr(eps),
+         repr(float(scale))],
+        capture_output=True, text=True, check=True)
+    return json.loads(proc.stdout)
 
 
 def run_mpi(config: QuadConfig, n_workers: int = 4) -> IntegrationResult:

@@ -125,3 +125,30 @@ register_integrand(
     lambda x: math.atan(5.0 * x) / 5.0,
     doc="Runge function on [-1,1]: classic adaptive-refinement test.",
 )
+
+
+# --- parameterized families (BASELINE.json config #3: batch of independent
+# 1D integrals; consumed by parallel.bag_engine.integrate_family) ----------
+
+FAMILIES: Dict[str, Callable] = {}
+
+
+def register_family(name: str, f_theta: Callable) -> Callable:
+    """Register a parameterized integrand f(x, theta) for family runs."""
+    FAMILIES[name] = f_theta
+    return f_theta
+
+
+def get_family(name: str) -> Callable:
+    try:
+        return FAMILIES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown family {name!r}; registered: {sorted(FAMILIES)}"
+        ) from None
+
+
+register_family("sin_recip_scaled", lambda x, s: jnp.sin(s / x))
+register_family("sin_scaled", lambda x, s: jnp.sin(s * x))
+register_family("gauss_center", lambda x, c: jnp.exp(
+    -0.5 * ((x - c) / 1e-3) ** 2))

@@ -47,7 +47,7 @@ class DeviceState(NamedTuple):
 
 
 def compact_children(l: jnp.ndarray, r: jnp.ndarray, split: jnp.ndarray,
-                     capacity: int
+                     capacity: int, fill: float = 1.0
                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Scatter the two halves of every split interval into a dense prefix.
 
@@ -55,6 +55,11 @@ def compact_children(l: jnp.ndarray, r: jnp.ndarray, split: jnp.ndarray,
     split interval #k (0-based, in lane order) writes [l, mid] to slot 2k
     and [mid, r] to slot 2k+1 — deterministic breadth-first ordering, left
     child first like the worker's two tag-0 sends (``aquadPartA.c:192-197``).
+
+    ``fill`` pads inactive slots and MUST be a point inside the integrand's
+    domain: masked lanes still execute the integrand, and out-of-domain
+    values (Inf/NaN) put TPU f64-emulated transcendentals on a ~1000x slow
+    path.
 
     Returns (new_l, new_r, new_active, n_children). Lanes whose slot would
     exceed ``capacity`` are dropped (caller checks n_children > capacity).
@@ -65,8 +70,8 @@ def compact_children(l: jnp.ndarray, r: jnp.ndarray, split: jnp.ndarray,
     oob = jnp.asarray(capacity, dtype=jnp.int32)
     left_slot = jnp.where(split, 2 * idx, oob)
     right_slot = jnp.where(split, 2 * idx + 1, oob)
-    new_l = jnp.zeros(capacity, dtype=l.dtype)
-    new_r = jnp.zeros(capacity, dtype=r.dtype)
+    new_l = jnp.full(capacity, fill, dtype=l.dtype)
+    new_r = jnp.full(capacity, fill, dtype=r.dtype)
     new_l = new_l.at[left_slot].set(l, mode="drop")
     new_r = new_r.at[left_slot].set(mid, mode="drop")
     new_l = new_l.at[right_slot].set(mid, mode="drop")
@@ -78,9 +83,11 @@ def compact_children(l: jnp.ndarray, r: jnp.ndarray, split: jnp.ndarray,
 def initial_state(a: float, b: float, capacity: int,
                   dtype=jnp.float64) -> DeviceState:
     """Seed the frontier with [a, b] (the farmer's initial push,
-    ``aquadPartA.c:135-137``)."""
-    l = jnp.zeros(capacity, dtype=dtype).at[0].set(a)
-    r = jnp.zeros(capacity, dtype=dtype).at[0].set(b)
+    ``aquadPartA.c:135-137``). Inactive slots hold the midpoint — an
+    in-domain value — to keep masked lanes off the NaN slow path."""
+    fill = 0.5 * (a + b)
+    l = jnp.full(capacity, fill, dtype=dtype).at[0].set(a)
+    r = jnp.full(capacity, fill, dtype=dtype).at[0].set(b)
     active = jnp.zeros(capacity, dtype=bool).at[0].set(True)
     zero = jnp.zeros((), dtype=dtype)
     i0 = jnp.zeros((), dtype=jnp.int64)
@@ -90,7 +97,7 @@ def initial_state(a: float, b: float, capacity: int,
 
 
 def round_body(state: DeviceState, f, eps: float, rule: Rule,
-               capacity: int) -> DeviceState:
+               capacity: int, fill: float = 1.0) -> DeviceState:
     """One wavefront round: evaluate → accumulate → compact."""
     value, _err, split = eval_batch(state.l, state.r, f, eps, rule)
     split = jnp.logical_and(split, state.active)
@@ -102,7 +109,7 @@ def round_body(state: DeviceState, f, eps: float, rule: Rule,
     n_split = jnp.sum(split.astype(jnp.int64))
 
     new_l, new_r, new_active, n_children = compact_children(
-        state.l, state.r, split, capacity)
+        state.l, state.r, split, capacity, fill)
     overflow = jnp.logical_or(state.overflow,
                               n_children > jnp.asarray(capacity, jnp.int32))
 
@@ -119,7 +126,9 @@ def round_body(state: DeviceState, f, eps: float, rule: Rule,
 @functools.partial(jax.jit, static_argnames=("f", "eps", "rule",
                                              "capacity", "max_rounds"))
 def _run(state: DeviceState, *, f, eps: float, rule: Rule,
-         capacity: int, max_rounds: int) -> DeviceState:
+         capacity: int, max_rounds: int, fill=1.0) -> DeviceState:
+    # ``fill`` is traced (not static): sweeping many (a, b) panels must not
+    # recompile the whole loop per pair.
     # ``f`` (the integrand function object, hashable) is the static key —
     # not a registry name — so re-registration never hits a stale compile.
 
@@ -130,7 +139,7 @@ def _run(state: DeviceState, *, f, eps: float, rule: Rule,
         )
 
     def body(s: DeviceState):
-        return round_body(s, f, eps, rule, capacity)
+        return round_body(s, f, eps, rule, capacity, fill)
 
     return lax.while_loop(cond, body, state)
 
@@ -162,11 +171,18 @@ def device_integrate(config: QuadConfig = QuadConfig(),
     t0 = time.perf_counter()
     out = _run(state, f=entry.fn, eps=float(config.eps),
                rule=Rule(config.rule), capacity=int(config.capacity),
-               max_rounds=int(config.max_rounds))
-    out = jax.tree.map(lambda x: x.block_until_ready(), out)
+               max_rounds=int(config.max_rounds),
+               fill=0.5 * (config.a + config.b))
+    # ONE device->host pull of only the SMALL fields (scalars + the
+    # pending flag): remote-tunneled backends pay ~100ms per sync and
+    # ~8MB/s for bulk, so the (capacity,) arrays stay on device.
+    (acc_s, acc_c, tasks_n, splits_n, rounds_n, overflow_b,
+     any_active) = jax.device_get(
+        (out.acc_s, out.acc_c, out.tasks, out.splits, out.rounds,
+         out.overflow, out.active.any()))
     wall = time.perf_counter() - t0
 
-    if bool(out.overflow):
+    if bool(overflow_b):
         if not fallback:
             raise RuntimeError(
                 f"device frontier overflowed capacity={config.capacity}; "
@@ -178,23 +194,23 @@ def device_integrate(config: QuadConfig = QuadConfig(),
         return DeviceResult(area=host.area, state=out, metrics=metrics,
                             exact=host.exact)
 
-    if bool(out.rounds >= config.max_rounds) and bool(jnp.any(out.active)):
+    if int(rounds_n) >= config.max_rounds and bool(any_active):
         raise RuntimeError(f"max_rounds={config.max_rounds} exceeded")
 
-    tasks = int(out.tasks)
+    tasks = int(tasks_n)
     metrics = RunMetrics(
         tasks=tasks,
-        splits=int(out.splits),
-        leaves=tasks - int(out.splits),
-        rounds=int(out.rounds),
-        max_depth=max(int(out.rounds) - 1, 0),
+        splits=int(splits_n),
+        leaves=tasks - int(splits_n),
+        rounds=int(rounds_n),
+        max_depth=max(int(rounds_n) - 1, 0),
         integrand_evals=tasks * EVALS_PER_TASK[Rule(config.rule)],
         wall_time_s=wall,
         n_chips=1,
         tasks_per_chip=[tasks],
     )
     return DeviceResult(
-        area=float(out.acc_s + out.acc_c),
+        area=float(acc_s + acc_c),
         state=out,
         metrics=metrics,
         exact=entry.exact(config.a, config.b),

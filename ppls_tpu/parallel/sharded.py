@@ -55,7 +55,7 @@ class ShardState(NamedTuple):
 
 
 def _shard_round(state: ShardState, f, eps: float, rule: Rule,
-                 cap: int, axis: str) -> ShardState:
+                 cap: int, axis: str, fill: float = 1.0) -> ShardState:
     """One sharded wavefront round. ``cap`` is capacity per chip."""
     n_dev = lax.axis_size(axis)
     my = lax.axis_index(axis)
@@ -73,7 +73,7 @@ def _shard_round(state: ShardState, f, eps: float, rule: Rule,
     # --- children of local splits, compacted to a dense local prefix
     # (same cumsum scatter as the single-chip engine) ---
     ch_l, ch_r, _ch_active, n_children_local = compact_children(
-        state.l, state.r, split, 2 * cap)  # 2*cap slots: never drops
+        state.l, state.r, split, 2 * cap, fill)  # 2*cap slots: never drops
 
     # --- global rebalance: the demand-driven farmer dispatch recreated at
     # batch granularity (SURVEY.md §7 "load balance across chips").
@@ -93,8 +93,8 @@ def _shard_round(state: ShardState, f, eps: float, rule: Rule,
     valid = local_pos[None, :] < counts[:, None]
     glob_slot = jnp.where(valid, offsets[:, None] + local_pos[None, :],
                           jnp.asarray(glob_size, jnp.int32))
-    g_l = jnp.zeros(glob_size, dtype=state.l.dtype)
-    g_r = jnp.zeros(glob_size, dtype=state.r.dtype)
+    g_l = jnp.full(glob_size, fill, dtype=state.l.dtype)
+    g_r = jnp.full(glob_size, fill, dtype=state.r.dtype)
     g_l = g_l.at[glob_slot.reshape(-1)].set(all_l.reshape(-1), mode="drop")
     g_r = g_r.at[glob_slot.reshape(-1)].set(all_r.reshape(-1), mode="drop")
 
@@ -117,7 +117,8 @@ def _shard_round(state: ShardState, f, eps: float, rule: Rule,
 
 
 def build_sharded_run(mesh: Mesh, integrand: str, eps: float, rule: Rule,
-                      cap_per_chip: int, max_rounds: int):
+                      cap_per_chip: int, max_rounds: int,
+                      fill: float = 1.0):
     """Build the jitted sharded integrator for a mesh.
 
     Returns ``run(state) -> state`` where state arrays are globally shaped
@@ -147,7 +148,7 @@ def build_sharded_run(mesh: Mesh, integrand: str, eps: float, rule: Rule,
             )
 
         def body(s: ShardState):
-            return _shard_round(s, f, eps, rule, cap_per_chip, axis)
+            return _shard_round(s, f, eps, rule, cap_per_chip, axis, fill)
 
         out = lax.while_loop(cond, body, state)
         return (out.l, out.r, out.active,
@@ -186,13 +187,15 @@ def sharded_integrate(config: QuadConfig = QuadConfig(),
     n_dev = mesh.devices.size
     cap = max(config.capacity // n_dev, 8)
 
+    fill = 0.5 * (config.a + config.b)
     run = build_sharded_run(mesh, config.integrand, float(config.eps),
-                            Rule(config.rule), cap, int(config.max_rounds))
+                            Rule(config.rule), cap, int(config.max_rounds),
+                            fill=fill)
 
     glob = n_dev * cap
     dtype = jnp.dtype(config.dtype)
-    l = jnp.zeros(glob, dtype=dtype).at[0].set(config.a)
-    r = jnp.zeros(glob, dtype=dtype).at[0].set(config.b)
+    l = jnp.full(glob, fill, dtype=dtype).at[0].set(config.a)
+    r = jnp.full(glob, fill, dtype=dtype).at[0].set(config.b)
     active = jnp.zeros(glob, dtype=bool).at[0].set(True)
     zeros_chip = jnp.zeros(n_dev, dtype=dtype)
     i0_chip = jnp.zeros(n_dev, dtype=jnp.int64)
@@ -202,10 +205,16 @@ def sharded_integrate(config: QuadConfig = QuadConfig(),
     t0 = time.perf_counter()
     out = run(l, r, active, zeros_chip, zeros_chip, i0_chip, i0_chip,
               rounds0, overflow0)
-    out = jax.tree.map(lambda x: x.block_until_ready(), out)
-    wall = time.perf_counter() - t0
-    (_, _, out_active, acc_s, acc_c, tasks_chip, splits_chip,
+    # Single device->host pull of ONLY the small fields (remote-tunneled
+    # backends charge ~100ms per sync and ~8MB/s bulk; the (glob,) l/r
+    # arrays stay on device).
+    (out_l, out_r, out_active_dev, acc_s, acc_c, tasks_chip, splits_chip,
      rounds_chip, overflow_chip) = out
+    any_active, acc_s, acc_c, tasks_chip, splits_chip, rounds_chip, \
+        overflow_chip = jax.device_get(
+            (jnp.any(out_active_dev), acc_s, acc_c, tasks_chip,
+             splits_chip, rounds_chip, overflow_chip))
+    wall = time.perf_counter() - t0
     rounds = int(np.asarray(rounds_chip)[0])
     overflow = bool(np.asarray(overflow_chip)[0])
 
@@ -213,7 +222,7 @@ def sharded_integrate(config: QuadConfig = QuadConfig(),
         raise RuntimeError(
             f"sharded frontier overflowed global capacity {glob}; raise "
             f"config.capacity")
-    if rounds >= config.max_rounds and np.asarray(out_active).any():
+    if rounds >= config.max_rounds and bool(any_active):
         raise RuntimeError(f"max_rounds={config.max_rounds} exceeded")
 
     # Deterministic cross-chip reduction on host: fixed chip order.

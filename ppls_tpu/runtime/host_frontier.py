@@ -125,9 +125,12 @@ def integrate(config: QuadConfig = QuadConfig(),
             )
         n = frontier.shape[0]
         width = _bucket_width(n, config.min_batch)
-        # Pad with degenerate [0,0] intervals, masked inactive.
-        l = np.zeros(width, dtype=dtype)
-        r = np.zeros(width, dtype=dtype)
+        # Padding lanes hold an in-domain point (first pending midpoint):
+        # masked lanes still execute the integrand, and out-of-domain
+        # values (NaN/Inf) hit TPU f64-emulation slow paths.
+        fill = 0.5 * (frontier[0, 0] + frontier[0, 1])
+        l = np.full(width, fill, dtype=dtype)
+        r = np.full(width, fill, dtype=dtype)
         l[:n] = frontier[:, 0]
         r[:n] = frontier[:, 1]
         active = np.zeros(width, dtype=bool)
