@@ -1,0 +1,284 @@
+"""Double-single (two-float32) arithmetic: TPU-native extended precision.
+
+TPUs have no hardware f64; XLA emulates it, and that emulation has severe
+data-dependent slow paths (measured ~200x on v5e for e.g. small-argument
+``sin``) plus no Pallas support. This module implements the classic
+double-single compensated representation — a value is an unevaluated sum
+``hi + lo`` of two f32 with ``|lo| <= ulp(hi)/2`` — giving ~48 mantissa
+bits with *branch-free, slow-path-free* f32 VPU arithmetic that works
+identically under jit, vmap, shard_map, and inside Pallas TPU kernels
+(SURVEY.md §7 hard parts: "double-double (two-float) compensated
+arithmetic in the Pallas kernel; measure both").
+
+All functions take/return ``(hi, lo)`` tuples of equal-shaped f32 arrays.
+Error-free transforms follow Dekker (1971) / Knuth TAOCP v2; the division
+and square root use one Newton step on the f32 seed.
+
+The transcendental layer (``ds_sin``/``ds_cos``) uses branch-free
+Cody-Waite reduction with a three-term pi/2 (72 bits), exact for
+arguments up to ~2^22, followed by Taylor polynomials evaluated in ds for
+the leading terms and f32 for the tail. Absolute error is ~1e-13 over
+|x| <= 2e4 (validated against numpy in tests/test_ds.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+DS = Tuple[jnp.ndarray, jnp.ndarray]
+
+_F32 = jnp.float32
+# Dekker splitter for f32: 2^12 + 1.
+_SPLIT = np.float32(4097.0)
+
+
+# --- error-free transforms ---------------------------------------------------
+
+def two_sum(a, b):
+    """s + e == a + b exactly (no magnitude precondition).
+
+    The sum is fenced with :func:`_freeze`: XLA's algebraic simplifier
+    otherwise rewrites ``(C + b) - C -> b`` when one operand is a literal
+    (e.g. a Taylor coefficient), which erases the compensation term.
+    """
+    s = _freeze(a + b)
+    v = s - a
+    e = (a - (s - v)) + (b - v)
+    return s, e
+
+
+def quick_two_sum(a, b):
+    """s + e == a + b exactly, REQUIRES |a| >= |b| (or a == 0)."""
+    s = _freeze(a + b)
+    e = b - (s - a)
+    return s, e
+
+
+def _freeze(x):
+    """Make a float value opaque to cross-op optimization so downstream
+    adds/subs are NOT fma-contracted with the producing multiply. FMA
+    contraction ("excess precision") silently breaks error-free
+    transforms: e.g. ``x - t1`` with ``t1 = k*p1`` becomes
+    ``fma(-k, p1, x)``, double-counting the separately-tracked rounding
+    term (observed on both XLA:CPU and XLA:TPU jit;
+    --xla_allow_excess_precision=false does not stop it, a bitcast
+    round-trip is elided by the algebraic simplifier, and
+    optimization_barrier is expanded away before codegen). The reliable
+    fence is a select on ``x == x``: the compiler cannot prove the
+    predicate true (NaN semantics), so the select survives into the
+    backend and breaks mul/add adjacency."""
+    return jnp.where(jnp.equal(x, x), x, jnp.zeros_like(x))
+
+
+def _dekker_split(a):
+    t = _freeze(_SPLIT * a)
+    hi = t - (t - a)
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    """p + e == a * b exactly (Dekker product, no FMA dependency)."""
+    p = _freeze(a * b)
+    ah, al = _dekker_split(a)
+    bh, bl = _dekker_split(b)
+    e = ((_freeze(ah * bh) - p) + _freeze(ah * bl) + _freeze(al * bh)) + _freeze(al * bl)
+    return p, e
+
+
+# --- ds construction / destruction ------------------------------------------
+
+def ds_from_f64(x) -> DS:
+    """Split a float64 array (host side / XLA glue) into (hi, lo) f32."""
+    hi = jnp.asarray(x).astype(_F32)
+    lo = (jnp.asarray(x) - hi.astype(jnp.float64)).astype(_F32)
+    return hi, lo
+
+
+def ds_to_f64(x: DS):
+    """Recombine to float64 (XLA glue only — not for kernel interiors)."""
+    return x[0].astype(jnp.float64) + x[1].astype(jnp.float64)
+
+
+def ds_const(v: float, like=None) -> DS:
+    """ds constant from a Python float (exact split, host-computed)."""
+    hi = np.float32(v)
+    lo = np.float32(v - float(hi))
+    if like is not None:
+        shape = jnp.shape(like[0] if isinstance(like, tuple) else like)
+        return (jnp.full(shape, hi, _F32), jnp.full(shape, lo, _F32))
+    return (jnp.asarray(hi), jnp.asarray(lo))
+
+
+def ds_zero_like(x) -> DS:
+    z = jnp.zeros_like(x)
+    return z, z
+
+
+# --- core arithmetic ---------------------------------------------------------
+
+def ds_neg(x: DS) -> DS:
+    return -x[0], -x[1]
+
+
+def ds_add(x: DS, y: DS) -> DS:
+    s, e = two_sum(x[0], y[0])
+    e = e + (x[1] + y[1])
+    return quick_two_sum(s, e)
+
+
+def ds_sub(x: DS, y: DS) -> DS:
+    return ds_add(x, ds_neg(y))
+
+
+def ds_add_f32(x: DS, b) -> DS:
+    s, e = two_sum(x[0], b)
+    e = e + x[1]
+    return quick_two_sum(s, e)
+
+
+def ds_mul(x: DS, y: DS) -> DS:
+    p, e = two_prod(x[0], y[0])
+    e = e + (x[0] * y[1] + x[1] * y[0])
+    return quick_two_sum(p, e)
+
+
+def ds_mul_f32(x: DS, b) -> DS:
+    p, e = two_prod(x[0], b)
+    e = e + x[1] * b
+    return quick_two_sum(p, e)
+
+
+def ds_mul_pow2(x: DS, k: float) -> DS:
+    """Exact scaling by a power of two (no renormalization needed)."""
+    return x[0] * _F32(k), x[1] * _F32(k)
+
+
+def ds_div(x: DS, y: DS) -> DS:
+    """One long-division refinement on the f32 quotient seed."""
+    q1 = x[0] / y[0]
+    # r = x - q1 * y, computed exactly in ds
+    p, pe = two_prod(q1, y[0])
+    r = ds_sub(x, (p, pe + q1 * y[1]))
+    q2 = (r[0] + r[1]) / y[0]
+    return quick_two_sum(q1, q2)
+
+
+def ds_abs(x: DS) -> DS:
+    neg = x[0] < 0
+    return jnp.where(neg, -x[0], x[0]), jnp.where(neg, -x[1], x[1])
+
+
+def ds_lt(x: DS, y: DS):
+    """x < y (exact on the ds representation)."""
+    d = ds_sub(x, y)
+    return (d[0] < 0) | ((d[0] == 0) & (d[1] < 0))
+
+
+def ds_gt(x: DS, y: DS):
+    d = ds_sub(x, y)
+    return (d[0] > 0) | ((d[0] == 0) & (d[1] > 0))
+
+
+def ds_where(c, x: DS, y: DS) -> DS:
+    return jnp.where(c, x[0], y[0]), jnp.where(c, x[1], y[1])
+
+
+# --- sin / cos ---------------------------------------------------------------
+
+# pi/2 as a three-term f32 expansion (72 bits): p1 + p2 + p3 == pi/2 to
+# ~2^-72. Host-computed exact splits.
+_PIO2_1 = np.float32(1.5707963267948966)
+_PIO2_2 = np.float32(1.5707963267948966 - float(np.float32(1.5707963267948966)))
+_PIO2_3 = np.float32(
+    1.5707963267948966
+    - float(np.float32(1.5707963267948966))
+    - float(_PIO2_2)
+)
+_TWO_OVER_PI = np.float32(0.6366197723675814)
+
+# Taylor coefficients as exact ds pairs (1/(2k+1)! etc.), host-split.
+
+
+def _c(v: float):
+    hi = np.float32(v)
+    return hi, np.float32(v - float(hi))
+
+
+_S3 = _c(-1.0 / 6.0)
+_S5 = _c(1.0 / 120.0)
+_S7 = _c(-1.0 / 5040.0)
+_S9 = _c(1.0 / 362880.0)
+_S11 = np.float32(-1.0 / 39916800.0)
+_S13 = np.float32(1.0 / 6227020800.0)
+
+_C2 = _c(-0.5)
+_C4 = _c(1.0 / 24.0)
+_C6 = _c(-1.0 / 720.0)
+_C8 = _c(1.0 / 40320.0)
+_C10 = np.float32(-1.0 / 3628800.0)
+_C12 = np.float32(1.0 / 479001600.0)
+
+
+def _sin_poly(y: DS) -> DS:
+    """sin(y) for |y| <= pi/4 + ~1e-3: ds through y^9, f32 tail y^11+."""
+    y2 = ds_mul(y, y)
+    y2_f = y2[0]
+    # f32 tail: magnitude ~2.5e-8; its rounding error is harmless after
+    # the deeper ds Horner levels scale it by y^10.
+    tail = _S11 + y2_f * _S13
+    p = ds_add(_S9, ds_mul_f32(y2, tail))
+    p = ds_add(_S7, ds_mul(y2, p))
+    p = ds_add(_S5, ds_mul(y2, p))
+    p = ds_add(_S3, ds_mul(y2, p))
+    # sin = y + y*y2*p
+    return ds_add(y, ds_mul(ds_mul(y, y2), p))
+
+
+def _cos_poly(y: DS) -> DS:
+    """cos(y) for |y| <= pi/4 + ~1e-3: ds through y^8, f32 tail y^10+."""
+    y2 = ds_mul(y, y)
+    y2_f = y2[0]
+    tail = _C10 + y2_f * _C12
+    p = ds_add(_C8, ds_mul_f32(y2, tail))
+    p = ds_add(_C6, ds_mul(y2, p))
+    p = ds_add(_C4, ds_mul(y2, p))
+    p = ds_add(_C2, ds_mul(y2, p))
+    one = (jnp.ones_like(y[0]), jnp.zeros_like(y[0]))
+    return ds_add(one, ds_mul(y2, p))
+
+
+def ds_sin(x: DS) -> DS:
+    """sin(x) in ds precision, branch-free, |x| <= ~2^22.
+
+    Cody-Waite: k = round(x * 2/pi); y = x - k*pi/2 via the three-term
+    pi/2; quadrant select among {sin, cos, -sin, -cos}(y).
+    """
+    k = jnp.round(x[0] * _TWO_OVER_PI)
+    # y = x - k*(p1+p2+p3). The leading difference x.hi - k*p1 is exact by
+    # Sterbenz (the operands agree to within pi/4), so the reduction error
+    # is ~ulp_ds(y) — NOT ulp_ds(x), which for x ~ 2e4 would be ~7e-11.
+    t1, e1 = two_prod(k, _PIO2_1)
+    h = x[0] - t1
+    t2, e2 = two_prod(k, _PIO2_2)
+    y = (h, jnp.zeros_like(h))
+    y = ds_add_f32(y, -e1)
+    y = ds_add_f32(y, x[1])
+    y = ds_add_f32(y, -t2)
+    y = ds_add_f32(y, -e2)
+    y = ds_add_f32(y, -(k * _PIO2_3))
+
+    q = jnp.asarray(k, jnp.int32) & 3
+    sin_y = _sin_poly(y)
+    cos_y = _cos_poly(y)
+    use_cos = (q & 1) == 1
+    negate = q >= 2
+    res = ds_where(use_cos, cos_y, sin_y)
+    return ds_where(negate, ds_neg(res), res)
+
+
+def ds_cos(x: DS) -> DS:
+    half_pi = (jnp.full_like(x[0], _PIO2_1), jnp.full_like(x[0], _PIO2_2))
+    return ds_sin(ds_add(x, half_pi))

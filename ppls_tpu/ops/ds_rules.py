@@ -1,0 +1,80 @@
+"""Quadrature rules in double-single arithmetic.
+
+The TPU-native fast path: identical evaluate-or-split semantics to
+``ops.rules.trapezoid_batch`` (the reference worker's test,
+``aquadPartA.c:185-191``) but computed entirely in branch-free two-float32
+arithmetic — no f64 emulation, no data-dependent slow paths, Pallas-ready.
+
+Integrands here are *ds integrands*: ``f(x_ds, theta_ds) -> y_ds`` built
+from ``ops.ds`` primitives. The registry below mirrors
+``models.integrands.FAMILIES`` for the members that have ds forms.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from ppls_tpu.ops import ds
+from ppls_tpu.ops.ds import DS
+
+
+def ds_trapezoid_batch(l: DS, r: DS, f_ds: Callable, theta: DS,
+                       eps: float) -> Tuple[DS, jnp.ndarray, jnp.ndarray]:
+    """(value, err_hi, split) for a batch of ds intervals.
+
+    Matches the reference formulas exactly (whole-interval trapezoid vs
+    half-interval sum, strict ``>`` split test, accepted value
+    ``larea + rarea``), with 3 distinct integrand evaluations.
+    """
+    mid = ds.ds_mul_pow2(ds.ds_add(l, r), 0.5)
+    fl = f_ds(l, theta)
+    fm = f_ds(mid, theta)
+    fr = f_ds(r, theta)
+
+    half = 0.5
+    hl = ds.ds_mul_pow2(ds.ds_sub(mid, l), half)    # (mid-l)/2
+    hr = ds.ds_mul_pow2(ds.ds_sub(r, mid), half)    # (r-mid)/2
+    hw = ds.ds_mul_pow2(ds.ds_sub(r, l), half)      # (r-l)/2
+
+    lrarea = ds.ds_mul(ds.ds_add(fl, fr), hw)
+    larea = ds.ds_mul(ds.ds_add(fl, fm), hl)
+    rarea = ds.ds_mul(ds.ds_add(fm, fr), hr)
+    value = ds.ds_add(larea, rarea)
+    err = ds.ds_abs(ds.ds_sub(value, lrarea))
+    # The tolerance test needs only f32 range/precision on the error
+    # estimate's leading term (eps >= 1e-30 dwarfs f32 denormals).
+    split = err[0] > jnp.float32(eps)
+    return value, err[0], split
+
+
+# --- ds integrand registry ---------------------------------------------------
+
+DS_FAMILIES: Dict[str, Callable] = {}
+
+
+def register_ds_family(name: str, f_ds: Callable) -> Callable:
+    """Register a ds-arithmetic family integrand f(x_ds, theta_ds)."""
+    DS_FAMILIES[name] = f_ds
+    return f_ds
+
+
+def get_ds_family(name: str) -> Callable:
+    try:
+        return DS_FAMILIES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown ds family {name!r}; registered: {sorted(DS_FAMILIES)}"
+        ) from None
+
+
+register_ds_family(
+    "sin_recip_scaled",
+    lambda x, th: ds.ds_sin(ds.ds_div(th, x)),
+)
+
+register_ds_family(
+    "sin_scaled",
+    lambda x, th: ds.ds_sin(ds.ds_mul(th, x)),
+)

@@ -83,15 +83,16 @@ def bag_step(state: BagState, theta: jnp.ndarray, f_theta: Callable,
     else:
         acc = state.acc.at[fam].add(leaf)
 
-    # Children compaction WITHOUT scatter (TPU scatters with computed
-    # indices are ~5x slower than a stable argsort + gather): stable-sort
-    # the chunk so split lanes form a dense prefix in lane order, then
-    # interleave [l, mid], [mid, r] — the same deterministic
-    # left-child-first order as device_engine.compact_children.
-    order = jnp.argsort(jnp.logical_not(split), stable=True)
-    sl = l[order]
-    sr = r[order]
-    sfam = fam[order]
+    # Children compaction WITHOUT scatter or gather: ONE stable
+    # multi-operand sort moves the payload columns alongside the 1-bit key
+    # (TPU scatters with computed indices and per-column post-argsort
+    # gathers both measured ~0.5ms/column on v5e; the fused sort is ~10x
+    # cheaper). Split lanes form a dense prefix in lane order; interleaving
+    # [l, mid], [mid, r] reproduces device_engine.compact_children's
+    # deterministic left-child-first order.
+    key = jnp.logical_not(split).astype(jnp.int32)
+    _, sl, sr, sfam = lax.sort((key, l, r, fam), dimension=0,
+                               is_stable=True, num_keys=1)
     smid = (sl + sr) * 0.5
     ch_l = jnp.stack([sl, smid], axis=1).reshape(-1)      # (2*chunk,)
     ch_r = jnp.stack([smid, sr], axis=1).reshape(-1)

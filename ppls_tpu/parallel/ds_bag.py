@@ -1,0 +1,221 @@
+"""Chunked-LIFO bag engine in double-single arithmetic — the fast path.
+
+Same architecture as ``parallel.bag_engine`` (pop fixed-width chunks off a
+device-resident bag, evaluate, push compacted children) but every
+coordinate and function value is a two-float32 pair (``ops.ds``), so the
+hot loop is pure f32 VPU work with no f64-emulation slow paths. This is
+the engine ``bench.py`` runs and the one the Pallas kernel accelerates
+further (the evaluate step maps 1:1 onto a Pallas grid).
+
+Accuracy: ds carries ~48 mantissa bits; on the BASELINE.json north-star
+config (sin(1/x), eps=1e-10) areas match the C f64 baseline to ~1e-12
+(see tests/test_ds_bag.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ppls_tpu.ops import ds
+from ppls_tpu.ops.ds_rules import ds_trapezoid_batch
+from ppls_tpu.utils.metrics import RunMetrics
+
+
+class DsBagState(NamedTuple):
+    bag_lh: jnp.ndarray     # (store,) f32 left hi
+    bag_ll: jnp.ndarray     # (store,) f32 left lo
+    bag_rh: jnp.ndarray     # (store,) f32 right hi
+    bag_rl: jnp.ndarray     # (store,) f32 right lo
+    bag_fam: jnp.ndarray    # (store,) int32
+    count: jnp.ndarray      # int32
+    acc: jnp.ndarray        # (n_families,) f64 accumulator
+    tasks: jnp.ndarray      # int64
+    splits: jnp.ndarray     # int64
+    iters: jnp.ndarray      # int64
+    overflow: jnp.ndarray   # bool
+
+
+def ds_bag_step(state: DsBagState, th_h, th_l, f_ds: Callable, eps: float,
+                chunk: int, capacity: int) -> DsBagState:
+    n_take = jnp.minimum(state.count, chunk)
+    start = state.count - n_take
+
+    sl = lambda a: lax.dynamic_slice(a, (start,), (chunk,))
+    l = (sl(state.bag_lh), sl(state.bag_ll))
+    r = (sl(state.bag_rh), sl(state.bag_rl))
+    fam = sl(state.bag_fam)
+    active = jnp.arange(chunk, dtype=jnp.int32) < n_take
+
+    theta = (th_h[fam], th_l[fam])
+    value, _err, split = ds_trapezoid_batch(l, r, f_ds, theta, eps)
+    split = jnp.logical_and(split, active)
+    accept = jnp.logical_and(active, jnp.logical_not(split))
+
+    # Per-family accumulation in f64 (adds only — no emulated
+    # transcendentals, so no slow-path exposure).
+    leaf64 = jnp.where(accept, ds.ds_to_f64(value), 0.0)
+    m = state.acc.shape[0]
+    if m <= 256:
+        fam_ids = jnp.arange(m, dtype=jnp.int32)
+        seg = jnp.where(fam[None, :] == fam_ids[:, None],
+                        leaf64[None, :], 0.0).sum(axis=1)
+        acc = state.acc + seg
+    else:
+        acc = state.acc.at[fam].add(leaf64)
+
+    # Compaction via ONE stable multi-operand sort (split lanes to the
+    # front, in lane order). An argsort + per-column gathers costs ~0.5ms
+    # PER GATHER on v5e (TPU gathers are row-at-a-time); lax.sort carries
+    # all payload columns through its comparator network in one pass.
+    key = jnp.logical_not(split).astype(jnp.int32)
+    _, slh, sll, srh, srl, sfam = lax.sort(
+        (key, l[0], l[1], r[0], r[1], fam), dimension=0, is_stable=True,
+        num_keys=1)
+    smid = ds.ds_mul_pow2(ds.ds_add((slh, sll), (srh, srl)), 0.5)
+
+    def interleave(a, b):
+        return jnp.stack([a, b], axis=1).reshape(-1)
+
+    ch_lh = interleave(slh, smid[0])
+    ch_ll = interleave(sll, smid[1])
+    ch_rh = interleave(smid[0], srh)
+    ch_rl = interleave(smid[1], srl)
+    ch_fam = jnp.repeat(sfam, 2)
+    n_children = (2 * jnp.sum(split.astype(jnp.int32))).astype(jnp.int32)
+
+    dus = lambda bag, ch: lax.dynamic_update_slice(bag, ch, (start,))
+    new_count_raw = start + n_children
+    cap32 = jnp.asarray(capacity, jnp.int32)
+    return DsBagState(
+        bag_lh=dus(state.bag_lh, ch_lh), bag_ll=dus(state.bag_ll, ch_ll),
+        bag_rh=dus(state.bag_rh, ch_rh), bag_rl=dus(state.bag_rl, ch_rl),
+        bag_fam=dus(state.bag_fam, ch_fam),
+        count=jnp.minimum(new_count_raw, cap32),
+        acc=acc,
+        tasks=state.tasks + n_take.astype(jnp.int64),
+        splits=state.splits + jnp.sum(split.astype(jnp.int64)),
+        iters=state.iters + 1,
+        overflow=jnp.logical_or(state.overflow, new_count_raw > cap32),
+    )
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("f_ds", "eps", "chunk", "capacity",
+                                    "max_iters"))
+def _run_ds_bag(state: DsBagState, th_h, th_l, *, f_ds: Callable,
+                eps: float, chunk: int, capacity: int,
+                max_iters: int) -> DsBagState:
+    def cond(s: DsBagState):
+        return jnp.logical_and(
+            jnp.logical_and(s.count > 0, jnp.logical_not(s.overflow)),
+            s.iters < max_iters)
+
+    def body(s: DsBagState):
+        return ds_bag_step(s, th_h, th_l, f_ds, eps, chunk, capacity)
+
+    return lax.while_loop(cond, body, state)
+
+
+def initial_ds_bag(bounds: np.ndarray, capacity: int, n_families: int,
+                   chunk: int) -> DsBagState:
+    bounds = np.asarray(bounds, dtype=np.float64).reshape(-1, 2)
+    m = bounds.shape[0]
+    if m > capacity:
+        raise ValueError(f"{m} seed tasks exceed bag capacity {capacity}")
+    store = capacity + 2 * chunk
+    # In-domain fill for dead slots (family-0 midpoint): masked lanes still
+    # execute the integrand and must stay off NaN/Inf paths.
+    fill = 0.5 * (bounds[0, 0] + bounds[0, 1])
+
+    def split_col(v64, fillv):
+        hi = np.asarray(v64, np.float32)
+        lo = np.asarray(v64 - hi.astype(np.float64), np.float32)
+        fh = np.float32(fillv)
+        fl = np.float32(fillv - float(fh))
+        bh = np.full(store, fh, np.float32)
+        bl = np.full(store, fl, np.float32)
+        bh[:m] = hi
+        bl[:m] = lo
+        return jnp.asarray(bh), jnp.asarray(bl)
+
+    bag_lh, bag_ll = split_col(bounds[:, 0], fill)
+    bag_rh, bag_rl = split_col(bounds[:, 1], fill)
+    bag_fam = jnp.zeros(store, jnp.int32).at[:m].set(
+        jnp.arange(m, dtype=jnp.int32))
+    return DsBagState(
+        bag_lh=bag_lh, bag_ll=bag_ll, bag_rh=bag_rh, bag_rl=bag_rl,
+        bag_fam=bag_fam,
+        count=jnp.asarray(m, jnp.int32),
+        acc=jnp.zeros(n_families, jnp.float64),
+        tasks=jnp.zeros((), jnp.int64),
+        splits=jnp.zeros((), jnp.int64),
+        iters=jnp.zeros((), jnp.int64),
+        overflow=jnp.zeros((), bool),
+    )
+
+
+@dataclasses.dataclass
+class DsFamilyResult:
+    areas: np.ndarray
+    metrics: RunMetrics
+    lane_efficiency: float
+
+
+def ds_integrate_family(f_ds: Callable, theta: Sequence[float], bounds,
+                        eps: float, chunk: int = 1 << 16,
+                        capacity: int = 1 << 22,
+                        max_iters: int = 1 << 20) -> DsFamilyResult:
+    """Multi-problem adaptive integration on the ds fast path.
+
+    ``f_ds(x_ds, theta_ds)`` built from ``ops.ds`` primitives (see
+    ``ops.ds_rules.DS_FAMILIES``).
+    """
+    theta64 = jnp.asarray(theta, jnp.float64)
+    th_h, th_l = ds.ds_from_f64(theta64)
+    m = theta64.shape[0]
+    bounds = np.asarray(bounds, dtype=np.float64)
+    if bounds.ndim == 1:
+        bounds = np.tile(bounds.reshape(1, 2), (m, 1))
+    if chunk > capacity:
+        raise ValueError(f"chunk={chunk} exceeds capacity={capacity}")
+
+    state = initial_ds_bag(bounds, capacity, m, chunk)
+    t0 = time.perf_counter()
+    out = _run_ds_bag(state, th_h, th_l, f_ds=f_ds, eps=float(eps),
+                      chunk=int(chunk), capacity=int(capacity),
+                      max_iters=int(max_iters))
+    acc_np, count, tasks, splits, iters, overflow = jax.device_get(
+        (out.acc, out.count, out.tasks, out.splits, out.iters, out.overflow))
+    wall = time.perf_counter() - t0
+
+    if bool(overflow):
+        raise RuntimeError(f"ds bag overflowed capacity={capacity}")
+    if int(count) > 0:
+        raise RuntimeError(f"max_iters={max_iters} exceeded with "
+                           f"{int(count)} tasks pending")
+
+    tasks = int(tasks)
+    iters = int(iters)
+    metrics = RunMetrics(
+        tasks=tasks,
+        splits=int(splits),
+        leaves=tasks - int(splits),
+        rounds=iters,
+        integrand_evals=tasks * 3,
+        wall_time_s=wall,
+        n_chips=1,
+        tasks_per_chip=[tasks],
+    )
+    return DsFamilyResult(
+        areas=np.asarray(acc_np),
+        metrics=metrics,
+        lane_efficiency=tasks / (iters * chunk) if iters else 0.0,
+    )

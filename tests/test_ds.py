@@ -1,0 +1,113 @@
+"""Double-single arithmetic validation against numpy float64."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ppls_tpu.ops import ds
+
+
+def _rand(n, lo, hi, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(lo, hi, n)
+
+
+def _to_ds(x):
+    return ds.ds_from_f64(jnp.asarray(x))
+
+
+def _rep(x):
+    """The f64 value actually represented by the ds split of x — the
+    correct reference input (the split itself drops ~5 mantissa bits,
+    which cancellation can amplify arbitrarily in relative terms)."""
+    hi, lo = ds.ds_from_f64(jnp.asarray(x))
+    return np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+
+
+def _err(ds_val, ref):
+    got = np.asarray(ds.ds_to_f64(ds_val))
+    return np.abs(got - ref)
+
+
+def test_split_roundtrip():
+    # ds carries ~48 of f64's 53 mantissa bits: rel error <= 2^-47.
+    x = _rand(1000, -1e6, 1e6)
+    hi, lo = _to_ds(x)
+    np.testing.assert_allclose(np.asarray(hi, np.float64) +
+                               np.asarray(lo, np.float64), x, rtol=2 ** -47)
+
+
+@pytest.mark.parametrize("op,ref", [
+    (ds.ds_add, lambda a, b: a + b),
+    (ds.ds_sub, lambda a, b: a - b),
+    (ds.ds_mul, lambda a, b: a * b),
+    (ds.ds_div, lambda a, b: a / b),
+])
+def test_arith_close_to_f64(op, ref):
+    a = _rand(4096, -100.0, 100.0, seed=1)
+    b = _rand(4096, 0.1, 100.0, seed=2)
+    got = op(_to_ds(a), _to_ds(b))
+    expected = ref(_rep(a), _rep(b))
+    # ds error is bounded in ulps of the INPUTS (2^-48 * |operand|);
+    # cancellation makes result-relative error unbounded by design.
+    scale = np.maximum(np.maximum(np.abs(_rep(a)), np.abs(_rep(b))),
+                       np.abs(expected))
+    rel = _err(got, expected) / scale
+    assert rel.max() < 2 ** -46, rel.max()
+
+
+def test_mul_exactness_small_ints():
+    # products of small integers are exact in ds
+    a = np.arange(1.0, 100.0)
+    got = ds.ds_to_f64(ds.ds_mul(_to_ds(a), _to_ds(a)))
+    np.testing.assert_array_equal(np.asarray(got), a * a)
+
+
+def test_comparisons():
+    a = np.array([1.0, 1.0, 2.0])
+    b = np.array([1.0 + 1e-12, 1.0, 1.0])
+    lt = np.asarray(ds.ds_lt(_to_ds(a), _to_ds(b)))
+    gt = np.asarray(ds.ds_gt(_to_ds(a), _to_ds(b)))
+    assert lt.tolist() == [True, False, False]
+    assert gt.tolist() == [False, False, True]
+
+
+def test_ds_sin_accuracy_small_args():
+    x = _rand(1 << 14, -0.78, 0.78, seed=3)
+    got = ds.ds_sin(_to_ds(x))
+    assert _err(got, np.sin(_rep(x))).max() < 5e-14
+
+
+def test_ds_sin_accuracy_medium_args():
+    x = _rand(1 << 14, -30.0, 30.0, seed=4)
+    got = ds.ds_sin(_to_ds(x))
+    assert _err(got, np.sin(_rep(x))).max() < 5e-13
+
+
+def test_ds_sin_accuracy_large_args():
+    # the deep-quadrature regime: args up to 2e4 (theta/x at x=1e-4)
+    x = _rand(1 << 14, 1.0, 2e4, seed=5)
+    got = ds.ds_sin(_to_ds(x))
+    assert _err(got, np.sin(_rep(x))).max() < 2e-11
+
+
+def test_ds_sin_small_magnitude_args():
+    # the XLA f64-emulation slow-path region — must be fast AND accurate
+    x = _rand(1 << 14, 1e-4, 2e-3, seed=6)
+    got = ds.ds_sin(_to_ds(x))
+    assert _err(got, np.sin(_rep(x))).max() < 1e-14
+
+
+def test_ds_cos():
+    x = _rand(1 << 12, -10.0, 10.0, seed=7)
+    got = ds.ds_cos(_to_ds(x))
+    assert _err(got, np.cos(_rep(x))).max() < 5e-13
+
+
+def test_jit_and_vmap_compatible():
+    f = jax.jit(lambda hi, lo: ds.ds_sin((hi, lo)))
+    x = _rand(128, -5.0, 5.0)
+    hi, lo = _to_ds(x)
+    got = f(hi, lo)
+    assert _err(got, np.sin(_rep(x))).max() < 5e-13
