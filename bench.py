@@ -96,12 +96,17 @@ def main():
         f"({r.metrics.tasks} tasks/run, lane eff "
         f"{r.lane_efficiency:.2f}) -> {vs_baseline:.1f}x CPU baseline")
 
-    print(json.dumps({
+    out = {
         "metric": "subintervals evaluated/sec/chip",
         "value": round(value, 1),
         "unit": "evals/s/chip",
         "vs_baseline": round(vs_baseline, 3),
-    }))
+    }
+    if not cpu_areas:
+        # No C toolchain -> the area gate could not run; say so explicitly
+        # instead of printing a silently-ungated number (ADVICE r1).
+        out["ungated"] = True
+    print(json.dumps(out))
     return 0
 
 

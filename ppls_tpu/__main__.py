@@ -70,7 +70,7 @@ def main(argv=None) -> int:
             import os
 
             from ppls_tpu.runtime.checkpoint import Checkpointer, resume
-            ckpt = Checkpointer(args.checkpoint)
+            ckpt = Checkpointer(args.checkpoint, config=cfg)
             if os.path.exists(args.checkpoint):
                 res = resume(args.checkpoint, cfg, on_round=ckpt.hook)
             else:

@@ -52,6 +52,18 @@ def _cc() -> Optional[str]:
     return None
 
 
+def _compile(cmd: list) -> None:
+    """Run a compiler, surfacing its stderr on failure (a bare
+    CalledProcessError with captured-and-discarded output is useless —
+    ADVICE r1)."""
+    try:
+        subprocess.run(cmd, check=True, cwd=_CSRC, capture_output=True,
+                       text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"compile failed: {' '.join(cmd)}\n{e.stderr}") from e
+
+
 def build_seq(force: bool = False) -> Optional[str]:
     """Build the sequential C driver; returns binary path or None."""
     cc = _cc()
@@ -63,8 +75,7 @@ def build_seq(force: bool = False) -> Optional[str]:
             os.path.getmtime(out) >= _src_mtime(src):
         return out
     os.makedirs(_BUILD, exist_ok=True)
-    subprocess.run([cc, "-O2", "-o", out, src, "-lm"], check=True,
-                   cwd=_CSRC, capture_output=True)
+    _compile([cc, "-O2", "-o", out, src, "-lm"])
     return out
 
 
@@ -78,8 +89,7 @@ def build_mpi(force: bool = False) -> Optional[str]:
             os.path.getmtime(out) >= _src_mtime(src):
         return out
     os.makedirs(_BUILD, exist_ok=True)
-    subprocess.run(["mpicc", "-O2", "-o", out, src, "-lm"], check=True,
-                   cwd=_CSRC, capture_output=True)
+    _compile(["mpicc", "-O2", "-o", out, src, "-lm"])
     return out
 
 

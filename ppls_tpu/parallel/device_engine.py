@@ -147,7 +147,9 @@ def _run(state: DeviceState, *, f, eps: float, rule: Rule,
 @dataclasses.dataclass
 class DeviceResult:
     area: float
-    state: DeviceState
+    # None when the device run overflowed and the result came from the
+    # host-engine fallback (the overflowed device state is not meaningful).
+    state: Optional[DeviceState]
     metrics: RunMetrics
     exact: Optional[float] = None
 
@@ -190,8 +192,13 @@ def device_integrate(config: QuadConfig = QuadConfig(),
             )
         from ppls_tpu.runtime.host_frontier import integrate
         host = integrate(config)
+        # area/metrics come from the host rerun; state=None because the
+        # overflowed device state is inconsistent with them (ADVICE r1).
+        # Charge the wasted device attempt to wall_time_s so the number
+        # reflects what the caller actually paid.
         metrics = host.metrics
-        return DeviceResult(area=host.area, state=out, metrics=metrics,
+        metrics.wall_time_s += wall
+        return DeviceResult(area=host.area, state=None, metrics=metrics,
                             exact=host.exact)
 
     if int(rounds_n) >= config.max_rounds and bool(any_active):

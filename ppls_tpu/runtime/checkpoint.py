@@ -9,7 +9,7 @@ metrics) — a few KB per round — and any round boundary is a resume point.
 
 Usage::
 
-    ckpt = Checkpointer(path, every=1)
+    ckpt = Checkpointer(path, every=1, config=cfg)
     result = integrate(cfg, on_round=ckpt.hook)           # run + snapshot
     ...
     result = resume(path, cfg)                            # pick up anywhere
@@ -25,19 +25,33 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from ppls_tpu.config import QuadConfig
+from ppls_tpu.config import QuadConfig, Rule
 from ppls_tpu.utils.metrics import RoundStats, RunMetrics
 
 _META_KEYS = ("tasks", "splits", "leaves", "rounds", "max_depth",
               "integrand_evals", "wall_time_s", "n_chips")
 
 
+def _config_identity(config: QuadConfig) -> dict:
+    """The fields that define *which problem* a snapshot belongs to.
+
+    Resuming under a different identity would silently blend two runs
+    (ADVICE r1): the accumulated area and frontier are meaningless for a
+    different integrand/bounds/eps/rule.
+    """
+    return {"integrand": config.integrand, "a": config.a, "b": config.b,
+            "eps": config.eps, "rule": str(Rule(config.rule).value)}
+
+
 def save_checkpoint(path: str, frontier: np.ndarray,
                     area_acc: Tuple[float, float],
-                    metrics: RunMetrics) -> None:
+                    metrics: RunMetrics,
+                    config: Optional[QuadConfig] = None) -> None:
     """Atomically write (frontier, accumulator, metrics) to ``path``."""
     meta = {k: getattr(metrics, k) for k in _META_KEYS}
     meta["per_round"] = [dataclasses.asdict(s) for s in metrics.per_round]
+    if config is not None:
+        meta["config"] = _config_identity(config)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
@@ -56,33 +70,60 @@ def save_checkpoint(path: str, frontier: np.ndarray,
 
 
 def load_checkpoint(path: str):
-    """Returns (frontier, (s, c), RunMetrics)."""
+    """Returns (frontier, (s, c), RunMetrics, stored_config_or_None)."""
     with np.load(path) as z:
         frontier = z["frontier"]
         s, c = (float(x) for x in z["acc"])
         meta = json.loads(bytes(z["meta"]).decode())
+    stored_cfg = meta.pop("config", None)
     per_round = [RoundStats(**d) for d in meta.pop("per_round")]
     metrics = RunMetrics(**meta, per_round=per_round)
-    return frontier, (s, c), metrics
+    return frontier, (s, c), metrics, stored_cfg
 
 
 class Checkpointer:
-    """``on_round`` hook that snapshots every N rounds."""
+    """``on_round`` hook that snapshots every N rounds.
 
-    def __init__(self, path: str, every: int = 1):
+    Pass ``config`` so snapshots carry the problem identity and
+    ``resume`` can reject a mismatched run.
+    """
+
+    def __init__(self, path: str, every: int = 1,
+                 config: Optional[QuadConfig] = None):
         self.path = path
         self.every = max(int(every), 1)
+        self.config = config
 
     def hook(self, round_index: int, frontier, area_acc, metrics) -> None:
         if round_index % self.every == 0:
-            save_checkpoint(self.path, frontier, area_acc, metrics)
+            save_checkpoint(self.path, frontier, area_acc, metrics,
+                            config=self.config)
 
 
 def resume(path: str, config: QuadConfig,
            on_round: Optional[callable] = None):
-    """Continue an interrupted run from its last snapshot."""
+    """Continue an interrupted run from its last snapshot.
+
+    Raises ``ValueError`` if the snapshot was written for a different
+    problem identity (integrand/bounds/eps/rule); warns when resuming a
+    finished run (empty frontier — the result is simply replayed).
+    """
+    import warnings
+
     from ppls_tpu.runtime.host_frontier import integrate
 
-    frontier, acc, metrics = load_checkpoint(path)
+    frontier, acc, metrics, stored_cfg = load_checkpoint(path)
+    if stored_cfg is not None:
+        now = _config_identity(config)
+        if stored_cfg != now:
+            diff = {k: (stored_cfg.get(k), now[k]) for k in now
+                    if stored_cfg.get(k) != now[k]}
+            raise ValueError(
+                f"checkpoint {path!r} belongs to a different problem; "
+                f"refusing to blend runs (stored vs requested): {diff}")
+    if frontier.size == 0:
+        warnings.warn(
+            f"checkpoint {path!r} has an empty frontier (finished run); "
+            f"resume just replays the stored result", stacklevel=2)
     return integrate(config, frontier=frontier, area_acc=acc,
                      metrics=metrics, on_round=on_round)

@@ -25,11 +25,12 @@ def test_save_load_roundtrip(tmp_path):
     m.record_round(RoundStats(round_index=0, frontier_width=1, splits=1,
                               leaves=0, padded_width=256))
     save_checkpoint(path, frontier, (1.5, -2e-17), m)
-    f2, (s, c), m2 = load_checkpoint(path)
+    f2, (s, c), m2, cfg2 = load_checkpoint(path)
     np.testing.assert_array_equal(f2, frontier)
     assert (s, c) == (1.5, -2e-17)
     assert m2.tasks == m.tasks and m2.rounds == m.rounds
     assert m2.per_round[0].frontier_width == 1
+    assert cfg2 is None  # no config supplied at save time
 
 
 def test_interrupt_and_resume_exact(tmp_path):
@@ -39,7 +40,7 @@ def test_interrupt_and_resume_exact(tmp_path):
     class Interrupt(Exception):
         pass
 
-    ckpt = Checkpointer(path)
+    ckpt = Checkpointer(path, config=REFERENCE_CONFIG)
 
     def crashing_hook(round_index, frontier, acc, metrics):
         ckpt.hook(round_index, frontier, acc, metrics)
@@ -54,3 +55,26 @@ def test_interrupt_and_resume_exact(tmp_path):
     assert res.area == full.area  # bit-identical to the uninterrupted run
     assert res.metrics.tasks == full.metrics.tasks == 6567
     assert res.metrics.rounds == 15
+
+
+def test_resume_rejects_mismatched_config(tmp_path):
+    """A snapshot from one problem must not silently resume another
+    (ADVICE r1: stale/blended results with no error)."""
+    path = str(tmp_path / "run.ckpt")
+    ckpt = Checkpointer(path, config=REFERENCE_CONFIG)
+    integrate(REFERENCE_CONFIG, on_round=ckpt.hook)
+
+    with pytest.raises(ValueError, match="different problem"):
+        resume(path, REFERENCE_CONFIG.replace(eps=1e-6))
+    with pytest.raises(ValueError, match="different problem"):
+        resume(path, REFERENCE_CONFIG.replace(integrand="sin"))
+
+
+def test_resume_finished_run_warns(tmp_path):
+    path = str(tmp_path / "run.ckpt")
+    ckpt = Checkpointer(path, config=REFERENCE_CONFIG)
+    full = integrate(REFERENCE_CONFIG, on_round=ckpt.hook)
+
+    with pytest.warns(UserWarning, match="empty frontier"):
+        res = resume(path, REFERENCE_CONFIG)
+    assert res.area == full.area
