@@ -22,7 +22,7 @@ import time
 
 import numpy as np
 
-M = 128            # family size (independent integrals)
+M = 1024           # family size (BASELINE.json config #3: 1024 integrals)
 EPS = 1e-10
 BOUNDS = (1e-4, 1.0)
 REPEATS = 3        # amortize fixed dispatch/sync overhead of the tunnel
@@ -63,7 +63,8 @@ def main():
     from ppls_tpu.parallel.bag_engine import integrate_family
 
     f_theta = get_family("sin_recip_scaled")
-    kw = dict(chunk=1 << 16, capacity=1 << 22)
+    # chunk 2^15 measured fastest across {2^13..2^17} on v5e (tools/profile_bag.py)
+    kw = dict(chunk=1 << 15, capacity=1 << 23)
 
     log("[bench] TPU warmup/compile ...")
     res = integrate_family(f_theta, theta, BOUNDS, EPS, **kw)

@@ -7,18 +7,35 @@ each iteration *pops a fixed-width chunk of B tasks* off the top
 (``lax.dynamic_slice`` at a traced offset), evaluates all B lanes in one
 fused step, and *pushes* the compacted children back on top. Compared to
 the breadth-first wavefront engine (``device_engine``), lane efficiency is
-``total_tasks / (iterations * B)`` ≈ 60-80% instead of ``avg_width /
+``total_tasks / (iterations * B)`` ≈ 60-95% instead of ``avg_width /
 capacity``, because the chunk width is constant regardless of how the
 frontier breathes — the same reason the reference chose a bag over a
 per-level barrier.
 
 It is also the **family engine** (BASELINE.json config #3: "batch of 1024
-independent 1D integrals"): every task carries an ``int32`` family id, the
-integrand is ``f(x, theta[fam])``, and leaf areas scatter-add into a
-per-family accumulator. Independent problems share one bag, so a problem
-that refines deeply keeps the lanes fed after shallow problems finish —
-cross-problem load balancing for free (the demand-driven spirit of
-``aquadPartA.c:156-165`` at chunk granularity).
+independent 1D integrals"): every task carries its family id and its own
+``theta`` parameter, the integrand is ``f(x, theta)``, and leaf areas
+reduce into a per-family accumulator. Independent problems share one bag,
+so a problem that refines deeply keeps the lanes fed after shallow
+problems finish — cross-problem load balancing for free (the
+demand-driven spirit of ``aquadPartA.c:156-165`` at chunk granularity).
+
+Layout (round-2 redesign, informed by on-TPU microbenchmarks in
+``tools/profile_bag.py``):
+
+* ``theta`` is a **bag column**, not a lookup table. The round-1 design
+  did a ``theta[fam]`` gather per iteration; a 65536-wide gather costs
+  ~1.05 ms on v5e — half the measured 2.16 ms iteration — because XLA
+  lowers computed-index gathers serially on TPU. Carrying the value
+  through pop/sort/push costs ~40 us instead.
+* ``depth`` and ``fam`` are packed into ONE int32 "meta" word
+  (``fam << DEPTH_BITS | depth``), so task identity rides the existing
+  compaction sort for free and the engine reports the true maximum
+  refinement depth (round-1 reported none).
+* Per-family leaf accumulation uses a broadcast-mask reduction
+  (~44 us at M=128) — measured 100x cheaper than a colliding
+  scatter-add (4.4 ms) and 120x cheaper than a f64 one-hot matmul
+  (5.3 ms) inside a TPU loop body.
 """
 
 from __future__ import annotations
@@ -37,21 +54,32 @@ from ppls_tpu.config import Rule
 from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
 from ppls_tpu.utils.metrics import RunMetrics
 
+# Meta word layout (int32): | accept/dead sort bit 30 | fam 29..14 | depth 13..0 |
+# depth <= 16383 is structurally safe: an f64 interval can only be bisected
+# ~1100 times before its width underflows to 0 and it self-accepts
+# (err = 0 <= eps), so the depth field cannot saturate first.
+DEPTH_BITS = 14
+DEPTH_MASK = (1 << DEPTH_BITS) - 1
+ACCEPT_BIT = jnp.int32(1 << 30)
+MAX_FAMILIES = 1 << 16
+
 
 class BagState(NamedTuple):
-    bag_l: jnp.ndarray      # (capacity,) left endpoints
-    bag_r: jnp.ndarray      # (capacity,) right endpoints
-    bag_fam: jnp.ndarray    # (capacity,) int32 family ids
+    bag_l: jnp.ndarray      # (store,) left endpoints
+    bag_r: jnp.ndarray      # (store,) right endpoints
+    bag_th: jnp.ndarray     # (store,) per-task integrand parameter
+    bag_meta: jnp.ndarray   # (store,) int32: fam << DEPTH_BITS | depth
     count: jnp.ndarray      # int32 — live entries occupy [0, count)
     acc: jnp.ndarray        # (n_families,) per-family area accumulator
     tasks: jnp.ndarray      # int64 total intervals evaluated
     splits: jnp.ndarray     # int64
     iters: jnp.ndarray      # int64 chunk iterations executed
+    max_depth: jnp.ndarray  # int32 deepest task evaluated
     overflow: jnp.ndarray   # bool — a push exceeded bag capacity
 
 
-def bag_step(state: BagState, theta: jnp.ndarray, f_theta: Callable,
-             eps: float, rule: Rule, chunk: int, capacity: int) -> BagState:
+def bag_step(state: BagState, f_theta: Callable, eps: float, rule: Rule,
+             chunk: int, capacity: int) -> BagState:
     """Pop a chunk off the bag top, evaluate, push children, accumulate."""
     n_take = jnp.minimum(state.count, chunk)
     start = state.count - n_take
@@ -61,52 +89,82 @@ def bag_step(state: BagState, theta: jnp.ndarray, f_theta: Callable,
     # window shifts but masking by n_take keeps exactly the live entries.
     l = lax.dynamic_slice(state.bag_l, (start,), (chunk,))
     r = lax.dynamic_slice(state.bag_r, (start,), (chunk,))
-    fam = lax.dynamic_slice(state.bag_fam, (start,), (chunk,))
+    th = lax.dynamic_slice(state.bag_th, (start,), (chunk,))
+    meta = lax.dynamic_slice(state.bag_meta, (start,), (chunk,))
     lane = jnp.arange(chunk, dtype=jnp.int32)
     active = lane < n_take
 
-    th = theta[fam]
+    fam = meta >> DEPTH_BITS
+    depth = meta & DEPTH_MASK
+
     value, _err, split = eval_batch(l, r, lambda x: f_theta(x, th), eps, rule)
     split = jnp.logical_and(split, active)
     accept = jnp.logical_and(active, jnp.logical_not(split))
 
-    # Per-family leaf accumulation. General scatters are slow inside TPU
-    # loop bodies; for small family counts a fused broadcast-mask reduce is
-    # much faster than a colliding scatter-add (measured ~5x on v5e).
+    # Per-family leaf accumulation (see module docstring for the measured
+    # cost of the alternatives).
     leaf = jnp.where(accept, value, 0.0)
     m = state.acc.shape[0]
-    if m <= 256:
+    if m == 1:
+        acc = state.acc + jnp.sum(leaf)[None]
+    elif m > 4096:
+        # Very large family counts: the O(m*chunk) mask below would build
+        # a multi-GiB intermediate. A colliding scatter-add is ~4.4 ms/iter
+        # on v5e but O(chunk) — slow, exact, and it scales.
+        acc = state.acc.at[fam].add(leaf)
+    else:
+        # Exact f64 broadcast-mask reduction, O(m * chunk). Cheaper
+        # near-exact alternatives were measured and rejected on v5e
+        # (M=1024, chunk=2^15; tools/profile_bag.py): hi/lo-f32 one-hot
+        # MXU matmuls are 2.5x cheaper (~99 us vs ~254 us) but the MXU's
+        # f32 accumulation drifts 1e-8 over a deep run — failing the
+        # 1e-9 C-parity gate — and a sorted-cumsum segment reduce costs
+        # 2x more (f64 cumsum alone is ~290 us). Colliding scatter-add:
+        # 4.4 ms. Parity beats the 99 us here; the Pallas kernel path is
+        # the sanctioned way to get both.
         fam_ids = jnp.arange(m, dtype=jnp.int32)
         seg = jnp.where(fam[None, :] == fam_ids[:, None],
                         leaf[None, :], 0.0).sum(axis=1)
         acc = state.acc + seg
-    else:
-        acc = state.acc.at[fam].add(leaf)
 
-    # Children compaction WITHOUT scatter or gather: ONE stable
-    # multi-operand sort moves the payload columns alongside the 1-bit key
-    # (TPU scatters with computed indices and per-column post-argsort
-    # gathers both measured ~0.5ms/column on v5e; the fused sort is ~10x
-    # cheaper). Split lanes form a dense prefix in lane order; interleaving
-    # [l, mid], [mid, r] reproduces device_engine.compact_children's
-    # deterministic left-child-first order.
-    key = jnp.logical_not(split).astype(jnp.int32)
-    _, sl, sr, sfam = lax.sort((key, l, r, fam), dimension=0,
-                               is_stable=True, num_keys=1)
+    max_depth = jnp.maximum(state.max_depth,
+                            jnp.max(jnp.where(active, depth, 0)))
+
+    # Children compaction WITHOUT scatter or gather: ONE multi-operand sort
+    # moves the payload columns alongside the packed key (TPU scatters with
+    # computed indices and per-column post-argsort gathers both measured
+    # ~0.5-1 ms/column on v5e; the fused sort is ~10x cheaper). Split lanes
+    # form a dense prefix; the ACCEPT_BIT in the key sends accepted and
+    # dead lanes to the tail. Within the prefix, lanes group by (fam,
+    # depth) — deterministic, and family-contiguous for locality.
+    skey = jnp.where(split, meta, meta | ACCEPT_BIT)
+    skey, sl, sr, sth = lax.sort((skey, l, r, th), dimension=0,
+                                 is_stable=True, num_keys=1)
     smid = (sl + sr) * 0.5
-    ch_l = jnp.stack([sl, smid], axis=1).reshape(-1)      # (2*chunk,)
-    ch_r = jnp.stack([smid, sr], axis=1).reshape(-1)
-    ch_fam = jnp.repeat(sfam, 2)
-    n_children = (2 * jnp.sum(split.astype(jnp.int32))).astype(jnp.int32)
+    ch_meta = (skey & ~ACCEPT_BIT) + 1                    # depth + 1
+    n_split32 = jnp.sum(split, dtype=jnp.int32)
+    n_children = 2 * n_split32
 
-    # Push: children overwrite the bag from `start` upward (the popped
-    # chunk's slots are dead, so the garbage tail of ch_* past n_children
-    # lands on dead slots). Contiguous dynamic_update_slice — no scatter.
+    # Push: children overwrite the bag from `start` upward. The sorted
+    # split prefix is written as TWO overlapping chunk-wide windows — left
+    # children [l, mid] at `start`, right children [mid, r] at
+    # `start + n_split` — left first, so the right window's garbage tail
+    # (lanes >= n_split) lands only on dead slots past the children block.
+    # This avoids interleaving children lane-by-lane: the round-1
+    # stack/reshape+repeat interleave is a cross-lane shuffle that costs
+    # ~450 us/iter at chunk=65536 on v5e, vs ~0 for contiguous windows
+    # (XLA updates the loop-carried bag in place either way).
     # Bag arrays carry 2*chunk slots of slack past `capacity` so the write
-    # window never clamps (see initial_bag).
-    bag_l = lax.dynamic_update_slice(state.bag_l, ch_l, (start,))
-    bag_r = lax.dynamic_update_slice(state.bag_r, ch_r, (start,))
-    bag_fam = lax.dynamic_update_slice(state.bag_fam, ch_fam, (start,))
+    # windows never clamp (see initial_bag).
+    mid_start = start + n_split32
+    bag_l = lax.dynamic_update_slice(state.bag_l, sl, (start,))
+    bag_l = lax.dynamic_update_slice(bag_l, smid, (mid_start,))
+    bag_r = lax.dynamic_update_slice(state.bag_r, smid, (start,))
+    bag_r = lax.dynamic_update_slice(bag_r, sr, (mid_start,))
+    bag_th = lax.dynamic_update_slice(state.bag_th, sth, (start,))
+    bag_th = lax.dynamic_update_slice(bag_th, sth, (mid_start,))
+    bag_meta = lax.dynamic_update_slice(state.bag_meta, ch_meta, (start,))
+    bag_meta = lax.dynamic_update_slice(bag_meta, ch_meta, (mid_start,))
 
     new_count_raw = start + n_children
     overflow = jnp.logical_or(state.overflow,
@@ -115,10 +173,12 @@ def bag_step(state: BagState, theta: jnp.ndarray, f_theta: Callable,
 
     n_split = jnp.sum(split.astype(jnp.int64))
     return BagState(
-        bag_l=bag_l, bag_r=bag_r, bag_fam=bag_fam, count=new_count, acc=acc,
+        bag_l=bag_l, bag_r=bag_r, bag_th=bag_th, bag_meta=bag_meta,
+        count=new_count, acc=acc,
         tasks=state.tasks + n_take.astype(jnp.int64),
         splits=state.splits + n_split,
         iters=state.iters + 1,
+        max_depth=max_depth,
         overflow=overflow,
     )
 
@@ -126,7 +186,7 @@ def bag_step(state: BagState, theta: jnp.ndarray, f_theta: Callable,
 @functools.partial(jax.jit,
                    static_argnames=("f_theta", "eps", "rule", "chunk",
                                     "capacity", "max_iters"))
-def _run_bag(state: BagState, theta: jnp.ndarray, *, f_theta: Callable,
+def _run_bag(state: BagState, *, f_theta: Callable,
              eps: float, rule: Rule, chunk: int, capacity: int,
              max_iters: int) -> BagState:
     def cond(s: BagState):
@@ -135,21 +195,28 @@ def _run_bag(state: BagState, theta: jnp.ndarray, *, f_theta: Callable,
             s.iters < max_iters)
 
     def body(s: BagState):
-        return bag_step(s, theta, f_theta, eps, rule, chunk, capacity)
+        return bag_step(s, f_theta, eps, rule, chunk, capacity)
 
     return lax.while_loop(cond, body, state)
 
 
 def initial_bag(bounds: np.ndarray, capacity: int, n_families: int,
-                chunk: int, dtype=jnp.float64) -> BagState:
+                chunk: int, theta=None, dtype=jnp.float64) -> BagState:
     """Seed the bag with one [a, b] task per family.
 
     ``bounds``: (n_families, 2) array of per-problem integration bounds.
+    ``theta``: (n_families,) per-problem integrand parameter (0.0 if None).
     """
     bounds = np.asarray(bounds, dtype=np.float64).reshape(-1, 2)
     m = bounds.shape[0]
     if m > capacity:
         raise ValueError(f"{m} seed tasks exceed bag capacity {capacity}")
+    if n_families > MAX_FAMILIES:
+        raise ValueError(f"n_families={n_families} exceeds the meta-word "
+                         f"fam field ({MAX_FAMILIES})")
+    if theta is None:
+        theta = np.zeros(m, dtype=np.float64)
+    theta = np.asarray(theta, dtype=np.float64).reshape(-1)
     # 2*chunk slots of slack past capacity: bag_step pushes children with a
     # contiguous dynamic_update_slice whose window must never clamp;
     # overflow detection still triggers at `capacity`.
@@ -158,22 +225,24 @@ def initial_bag(bounds: np.ndarray, capacity: int, n_families: int,
     # padding lanes still execute the integrand, and an out-of-domain
     # evaluation (e.g. sin(1/0) -> NaN) drops TPU f64-emulated
     # transcendentals onto a ~1000x slow path (measured on v5e).
-    # Dead slots carry fam id 0 (zero-init), so pad with a point inside
-    # family 0's domain; a global mean can fall outside every domain when
-    # per-family bounds are heterogeneous.
+    # Dead slots carry fam id 0 (zero-init meta), so pad with a point
+    # inside family 0's domain and family 0's theta; a global mean can
+    # fall outside every domain when per-family bounds differ.
     fill = float(0.5 * (bounds[0, 0] + bounds[0, 1]))
     store = capacity + 2 * chunk
     bag_l = jnp.full(store, fill, dtype=dtype).at[:m].set(bounds[:, 0])
     bag_r = jnp.full(store, fill, dtype=dtype).at[:m].set(bounds[:, 1])
-    bag_fam = jnp.zeros(store, dtype=jnp.int32).at[:m].set(
-        jnp.arange(m, dtype=jnp.int32))
+    bag_th = jnp.full(store, float(theta[0]), dtype=dtype).at[:m].set(theta)
+    bag_meta = jnp.zeros(store, dtype=jnp.int32).at[:m].set(
+        jnp.arange(m, dtype=jnp.int32) << DEPTH_BITS)
     return BagState(
-        bag_l=bag_l, bag_r=bag_r, bag_fam=bag_fam,
+        bag_l=bag_l, bag_r=bag_r, bag_th=bag_th, bag_meta=bag_meta,
         count=jnp.asarray(m, jnp.int32),
         acc=jnp.zeros(n_families, dtype=dtype),
         tasks=jnp.zeros((), jnp.int64),
         splits=jnp.zeros((), jnp.int64),
         iters=jnp.zeros((), jnp.int64),
+        max_depth=jnp.zeros((), jnp.int32),
         overflow=jnp.zeros((), bool),
     )
 
@@ -197,7 +266,7 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
     ``theta`` the (n,) parameter vector; ``bounds`` either one (a, b) pair
     shared by all problems or an (n, 2) array.
     """
-    theta = jnp.asarray(theta, dtype=jnp.float64)
+    theta = np.asarray(theta, dtype=np.float64)
     m = theta.shape[0]
     bounds = np.asarray(bounds, dtype=np.float64)
     if bounds.ndim == 1:
@@ -205,15 +274,16 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
 
     if chunk > capacity:
         raise ValueError(f"chunk={chunk} exceeds capacity={capacity}")
-    state = initial_bag(bounds, capacity, m, chunk)
+    state = initial_bag(bounds, capacity, m, chunk, theta=theta)
     t0 = time.perf_counter()
-    out = _run_bag(state, theta, f_theta=f_theta, eps=float(eps),
+    out = _run_bag(state, f_theta=f_theta, eps=float(eps),
                    rule=Rule(rule), chunk=int(chunk), capacity=int(capacity),
                    max_iters=int(max_iters))
     # Single host pull of ONLY the small fields: the bag arrays are tens of
     # MB and a remote-tunneled device pays ~8MB/s + ~100ms per sync.
-    acc_np, count, tasks, splits, iters, overflow = jax.device_get(
-        (out.acc, out.count, out.tasks, out.splits, out.iters, out.overflow))
+    acc_np, count, tasks, splits, iters, max_depth, overflow = jax.device_get(
+        (out.acc, out.count, out.tasks, out.splits, out.iters,
+         out.max_depth, out.overflow))
     wall = time.perf_counter() - t0
 
     if bool(overflow):
@@ -230,6 +300,7 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
         splits=int(splits),
         leaves=tasks - int(splits),
         rounds=iters,
+        max_depth=int(max_depth),
         integrand_evals=tasks * EVALS_PER_TASK[Rule(rule)],
         wall_time_s=wall,
         n_chips=1,

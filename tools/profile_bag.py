@@ -1,0 +1,200 @@
+"""Microbenchmark of the bag-engine loop-body components on the live
+backend (run on the real TPU to see what an iteration actually costs).
+
+Each component runs K times inside ONE jitted fori_loop, with the
+component's *inputs derived from the loop carry* and its *output folded
+back into the carry* — a true loop-carried data dependence, so XLA can
+neither DCE the component nor hoist it out of the loop (a plain `x * 0`
+sink gets constant-folded away entirely; measured 0.5 us/iter for
+everything, i.e. nothing ran).
+
+Usage: python tools/profile_bag.py [K]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+
+CHUNK = 1 << 16
+CAP = 1 << 22
+M = 128
+K = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+
+
+def bench(name, run, *args):
+    f = jax.jit(run)
+    out = f(*args)          # compile + warmup
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / K
+    print(f"{name:45s} {dt*1e6:9.1f} us/iter")
+    return dt
+
+
+def scalar_loop(body):
+    """K iterations; body(carry, *args) -> new f64 carry, inputs perturbed
+    by the carry so each iteration truly depends on the previous."""
+    def run(*args):
+        def b(i, c):
+            return body(c, *args)
+        return lax.fori_loop(0, K, b, jnp.float64(1.0))
+    return run
+
+
+def main():
+    rng = np.random.default_rng(0)
+    l = jnp.asarray(rng.uniform(1e-4, 0.5, CHUNK))
+    r = l + 1e-6
+    fam = jnp.asarray(rng.integers(0, M, CHUNK), dtype=jnp.int32)
+    theta = jnp.asarray(1.0 + np.arange(M) / M)
+    bag_l = jnp.asarray(rng.uniform(1e-4, 1.0, CAP + 2 * CHUNK))
+    leaf = jnp.asarray(rng.uniform(0, 1e-9, CHUNK))
+
+    def f_eval(x, th):
+        return jnp.sin(th / x)
+
+    def wob(c):
+        """tiny carry-dependent perturbation, keeps values in range"""
+        return (c % jnp.float64(3.0)) * 1e-9
+
+    # 1. integrand eval: 3 points + trapezoid arithmetic (f64)
+    def eval_body(c, l, r, th):
+        ll = l + wob(c)
+        m = (ll + r) * 0.5
+        fl, fm, fr = f_eval(ll, th), f_eval(m, th), f_eval(r, th)
+        h = r - ll
+        lr = (fl + fr) * h * 0.5
+        two = (fl + fm) * h * 0.25 + (fm + fr) * h * 0.25
+        return c + jnp.sum(jnp.where(jnp.abs(two - lr) > 1e-10, two, lr))
+
+    bench("eval 3pt+trap, scalar theta (f64)",
+          scalar_loop(eval_body), l, r, jnp.float64(1.5))
+    th_vec = theta[fam]
+    bench("eval 3pt+trap, vector theta (f64)",
+          scalar_loop(eval_body), l, r, th_vec)
+
+    def eval32_body(c, l, r, th):
+        ll = l + wob(c).astype(jnp.float32)
+        m = (ll + r) * 0.5
+        fl, fm, fr = f_eval(ll, th), f_eval(m, th), f_eval(r, th)
+        h = r - ll
+        lr = (fl + fr) * h * 0.5
+        two = (fl + fm) * h * 0.25 + (fm + fr) * h * 0.25
+        return c + jnp.sum(jnp.where(jnp.abs(two - lr) > 1e-7, two, lr))
+
+    bench("eval 3pt+trap, vector theta (f32)",
+          scalar_loop(eval32_body), l.astype(jnp.float32),
+          r.astype(jnp.float32), th_vec.astype(jnp.float32))
+
+    # 2. the theta[fam] gather alone (indices depend on carry)
+    def gather_body(c, theta, fam):
+        idx = (fam + (c.astype(jnp.int32) & 1)) % M
+        return c + theta[idx].sum() * 1e-12
+
+    bench("theta[fam] gather (128-table, 65536)",
+          scalar_loop(gather_body), theta, fam)
+
+    # 3. 4-operand stable sort by 1-bit key (operands depend on carry)
+    def sort_body(c, l, r, fam):
+        ll = l + wob(c)
+        key = (ll > 0.25).astype(jnp.int32)
+        _, sl, sr, sfam = lax.sort((key, ll, r, fam), dimension=0,
+                                   is_stable=True, num_keys=1)
+        return c + sl[0] + sr[CHUNK - 1] + sfam[0] * 1e-12
+
+    bench("4-op stable sort (65536)", scalar_loop(sort_body), l, r, fam)
+
+    def sort2_body(c, l, r, fam):
+        ll = l + wob(c)
+        key = (ll > 0.25).astype(jnp.int32)
+        _, sl, sr = lax.sort((key, ll, r), dimension=0,
+                             is_stable=True, num_keys=1)
+        return c + sl[0] + sr[CHUNK - 1]
+
+    bench("3-op stable sort (65536)", scalar_loop(sort2_body), l, r, fam)
+
+    # 4. family reduce variants (leaf depends on carry)
+    def famred_mask(c, fam, leaf):
+        lf = leaf + wob(c)
+        ids = jnp.arange(M, dtype=jnp.int32)
+        seg = jnp.where(fam[None, :] == ids[:, None], lf[None, :], 0.0).sum(axis=1)
+        return c + seg.sum() * 1e-12
+
+    bench("family reduce: mask (128x65536 f64)",
+          scalar_loop(famred_mask), fam, leaf)
+
+    def famred_mm(c, fam, leaf):
+        lf = leaf + wob(c)
+        hi = lf.astype(jnp.float32)
+        lo = (lf - hi.astype(jnp.float64)).astype(jnp.float32)
+        oh = jax.nn.one_hot(fam, M, dtype=jnp.float32)
+        s = (hi @ oh).astype(jnp.float64) + (lo @ oh).astype(jnp.float64)
+        return c + s.sum() * 1e-12
+
+    bench("family reduce: 2xf32 one-hot matmul",
+          scalar_loop(famred_mm), fam, leaf)
+
+    def famred_scatter(c, fam, leaf):
+        lf = leaf + wob(c)
+        acc = jnp.zeros(M, dtype=jnp.float64).at[fam].add(lf)
+        return c + acc.sum() * 1e-12
+
+    bench("family reduce: scatter-add", scalar_loop(famred_scatter), fam, leaf)
+
+    def famred_mm64(c, fam, leaf):
+        lf = leaf + wob(c)
+        oh = jax.nn.one_hot(fam, M, dtype=jnp.float64)
+        return c + (lf @ oh).sum() * 1e-12
+
+    bench("family reduce: f64 one-hot matmul",
+          scalar_loop(famred_mm64), fam, leaf)
+
+    # 5. dynamic_slice pops from the big bag at a carry-dependent offset
+    def pop_body(c, bag):
+        start = (c.astype(jnp.int32) * 2654435761 % CAP) & (CAP - 1)
+        a = lax.dynamic_slice(bag, (start,), (CHUNK,))
+        b = lax.dynamic_slice(bag, (start,), (CHUNK,))
+        d = lax.dynamic_slice(bag, (start,), (CHUNK,))
+        return c + a[0] + b[1] + d[2]
+
+    bench("3x dynamic_slice pop (4M bag)", scalar_loop(pop_body), bag_l)
+
+    # 6. dynamic_update_slice push: carries the big bag itself
+    ch = jnp.concatenate([l, r])
+
+    def push1(bag, ch):
+        def b(i, carry):
+            bag2, c = carry
+            start = (c.astype(jnp.int32) * 2654435761 % CAP) & (CAP - 1)
+            bag2 = lax.dynamic_update_slice(bag2, ch + wob(c), (start,))
+            return (bag2, c + bag2[0])
+        out = lax.fori_loop(0, K, b, (bag, jnp.float64(1.0)))
+        return out[1]
+
+    bench("1x dyn_update_slice push (131072 into 4M)", push1, bag_l, ch)
+
+    def push3(b1, b2, b3, ch):
+        def b(i, carry):
+            x1, x2, x3, c = carry
+            start = (c.astype(jnp.int32) * 2654435761 % CAP) & (CAP - 1)
+            x1 = lax.dynamic_update_slice(x1, ch + wob(c), (start,))
+            x2 = lax.dynamic_update_slice(x2, ch + wob(c), (start,))
+            x3 = lax.dynamic_update_slice(x3, ch + wob(c), (start,))
+            return (x1, x2, x3, c + x1[0] + x2[0] + x3[0])
+        out = lax.fori_loop(0, K, b, (b1, b2, b3, jnp.float64(1.0)))
+        return out[3]
+
+    bench("3x dyn_update_slice push", push3, bag_l, bag_l + 1, bag_l + 2, ch)
+
+
+if __name__ == "__main__":
+    main()
