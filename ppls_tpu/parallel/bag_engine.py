@@ -32,10 +32,12 @@ Layout (round-2 redesign, informed by on-TPU microbenchmarks in
   (``fam << DEPTH_BITS | depth``), so task identity rides the existing
   compaction sort for free and the engine reports the true maximum
   refinement depth (round-1 reported none).
-* Per-family leaf accumulation uses a broadcast-mask reduction
-  (~44 us at M=128) — measured 100x cheaper than a colliding
-  scatter-add (4.4 ms) and 120x cheaper than a f64 one-hot matmul
-  (5.3 ms) inside a TPU loop body.
+* Per-family leaf accumulation is exact: a broadcast-mask f64
+  reduction for small family counts, and the digit-plane MXU
+  segmented sum (``ops.reduction.exact_segment_sum``) beyond — both
+  bit-equivalent to sequential f64 accumulation, unlike plain f32
+  one-hot matmuls whose MXU accumulation drifts ~1e-8 over a deep
+  run (measured; fails the 1e-9 C-parity gate).
 """
 
 from __future__ import annotations
@@ -51,6 +53,7 @@ import numpy as np
 from jax import lax
 
 from ppls_tpu.config import Rule
+from ppls_tpu.ops.reduction import exact_segment_sum
 from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
 from ppls_tpu.utils.metrics import RunMetrics
 
@@ -107,25 +110,17 @@ def bag_step(state: BagState, f_theta: Callable, eps: float, rule: Rule,
     m = state.acc.shape[0]
     if m == 1:
         acc = state.acc + jnp.sum(leaf)[None]
-    elif m > 4096:
-        # Very large family counts: the O(m*chunk) mask below would build
-        # a multi-GiB intermediate. A colliding scatter-add is ~4.4 ms/iter
-        # on v5e but O(chunk) — slow, exact, and it scales.
-        acc = state.acc.at[fam].add(leaf)
-    else:
-        # Exact f64 broadcast-mask reduction, O(m * chunk). Cheaper
-        # near-exact alternatives were measured and rejected on v5e
-        # (M=1024, chunk=2^15; tools/profile_bag.py): hi/lo-f32 one-hot
-        # MXU matmuls are 2.5x cheaper (~99 us vs ~254 us) but the MXU's
-        # f32 accumulation drifts 1e-8 over a deep run — failing the
-        # 1e-9 C-parity gate — and a sorted-cumsum segment reduce costs
-        # 2x more (f64 cumsum alone is ~290 us). Colliding scatter-add:
-        # 4.4 ms. Parity beats the 99 us here; the Pallas kernel path is
-        # the sanctioned way to get both.
+    elif m <= 256:
+        # Exact f64 broadcast-mask reduction, O(m * chunk) — cheapest
+        # option for small family counts (~27 us at m=128, chunk=2^15).
         fam_ids = jnp.arange(m, dtype=jnp.int32)
         seg = jnp.where(fam[None, :] == fam_ids[:, None],
                         leaf[None, :], 0.0).sum(axis=1)
         acc = state.acc + seg
+    else:
+        # Exact digit-plane MXU reduction (ops/reduction.py): ~75 us at
+        # m=1024 vs ~216 us for the f64 mask, with zero reduction error.
+        acc = state.acc + exact_segment_sum(fam, leaf, m, chunk)
 
     max_depth = jnp.maximum(state.max_depth,
                             jnp.max(jnp.where(active, depth, 0)))
