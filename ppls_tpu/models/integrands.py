@@ -152,3 +152,40 @@ register_family("sin_recip_scaled", lambda x, s: jnp.sin(s / x))
 register_family("sin_scaled", lambda x, s: jnp.sin(s * x))
 register_family("gauss_center", lambda x, c: jnp.exp(
     -0.5 * ((x - c) / 1e-3) ** 2))
+
+
+# --- double-single counterparts for the Pallas walker kernel --------------
+# (fence-free ds arithmetic; see ops/ds_kernel.py and parallel/walker.py)
+
+DS_FAMILIES: Dict[str, Callable] = {}
+
+
+def register_family_ds(name: str, f_ds: Callable) -> Callable:
+    """Register the ds-arithmetic twin of a family: f_ds(x_ds, theta_ds)
+    with (hi, lo) f32 pairs, usable inside Pallas TPU kernels."""
+    DS_FAMILIES[name] = f_ds
+    return f_ds
+
+
+def get_family_ds(name: str) -> Callable:
+    try:
+        return DS_FAMILIES[name]
+    except KeyError:
+        raise KeyError(
+            f"no ds kernel twin for family {name!r}; registered: "
+            f"{sorted(DS_FAMILIES)}"
+        ) from None
+
+
+def _sin_recip_scaled_ds(x, th):
+    from ppls_tpu.ops import ds_kernel as dsk
+    return dsk.ds_sin(dsk.ds_div(th, x))
+
+
+def _sin_scaled_ds(x, th):
+    from ppls_tpu.ops import ds_kernel as dsk
+    return dsk.ds_sin(dsk.ds_mul(th, x))
+
+
+register_family_ds("sin_recip_scaled", _sin_recip_scaled_ds)
+register_family_ds("sin_scaled", _sin_scaled_ds)

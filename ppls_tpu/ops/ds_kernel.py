@@ -1,0 +1,205 @@
+"""Fence-free double-single (two-float32) arithmetic for PALLAS KERNEL
+INTERIORS ONLY.
+
+``ops/ds.py`` is the XLA-level ds library: every error-free transform is
+fenced with a NaN-predicated select (``_freeze``) because XLA's algebraic
+simplifier FMA-contracts and reassociates across ops, silently destroying
+the compensation terms. Those fences cost ~2 extra VPU ops per transform
+and, worse, shatter fusion (the round-1 ds engine measured 7.6x slower
+than emulated f64 because of them).
+
+Inside a Pallas TPU kernel the Mosaic compiler does NOT perform algebraic
+reassociation or FMA contraction across the expression tree, so the
+transforms hold with plain arithmetic — verified on v5e: the fence-free
+chain ``(a*b + b) / a`` in a kernel agrees with f64 to 4.3e-14 relative
+(f32 would be 6e-8). DO NOT import this module into XLA-level code; use
+``ops/ds.py`` there.
+
+Same algorithms as ``ops/ds.py`` (Dekker/Knuth transforms, Cody-Waite
+three-term pi/2 reduction, ds-leading Taylor polynomials); see that
+module for the numerical documentation and ``tests/test_ds.py`` +
+``tests/test_walker.py`` for validation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+DS = Tuple[jnp.ndarray, jnp.ndarray]
+
+_F32 = jnp.float32
+_SPLIT = np.float32(4097.0)  # Dekker splitter for f32: 2^12 + 1
+
+
+def two_sum(a, b):
+    """s + e == a + b exactly (no magnitude precondition)."""
+    s = a + b
+    v = s - a
+    e = (a - (s - v)) + (b - v)
+    return s, e
+
+
+def quick_two_sum(a, b):
+    """s + e == a + b exactly, REQUIRES |a| >= |b| (or a == 0)."""
+    s = a + b
+    e = b - (s - a)
+    return s, e
+
+
+def _dekker_split(a):
+    t = _SPLIT * a
+    hi = t - (t - a)
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    """p + e == a * b exactly (Dekker product, no FMA dependency)."""
+    p = a * b
+    ah, al = _dekker_split(a)
+    bh, bl = _dekker_split(b)
+    e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+def ds(hi, lo=None) -> DS:
+    if lo is None:
+        lo = jnp.zeros_like(hi)
+    return hi, lo
+
+
+def ds_neg(x: DS) -> DS:
+    return -x[0], -x[1]
+
+
+def ds_add(x: DS, y: DS) -> DS:
+    s, e = two_sum(x[0], y[0])
+    e = e + (x[1] + y[1])
+    return quick_two_sum(s, e)
+
+
+def ds_sub(x: DS, y: DS) -> DS:
+    return ds_add(x, ds_neg(y))
+
+
+def ds_add_f32(x: DS, b) -> DS:
+    s, e = two_sum(x[0], b)
+    e = e + x[1]
+    return quick_two_sum(s, e)
+
+
+def ds_mul(x: DS, y: DS) -> DS:
+    p, e = two_prod(x[0], y[0])
+    e = e + (x[0] * y[1] + x[1] * y[0])
+    return quick_two_sum(p, e)
+
+
+def ds_mul_f32(x: DS, b) -> DS:
+    p, e = two_prod(x[0], b)
+    e = e + x[1] * b
+    return quick_two_sum(p, e)
+
+
+def ds_mul_pow2(x: DS, k: float) -> DS:
+    """Exact scaling by a power of two."""
+    return x[0] * _F32(k), x[1] * _F32(k)
+
+
+def ds_div(x: DS, y: DS) -> DS:
+    """One long-division refinement on the f32 quotient seed."""
+    q1 = x[0] / y[0]
+    p, pe = two_prod(q1, y[0])
+    r = ds_sub(x, (p, pe + q1 * y[1]))
+    q2 = (r[0] + r[1]) / y[0]
+    return quick_two_sum(q1, q2)
+
+
+def ds_abs(x: DS) -> DS:
+    neg = x[0] < 0
+    return jnp.where(neg, -x[0], x[0]), jnp.where(neg, -x[1], x[1])
+
+
+def ds_where(c, x: DS, y: DS) -> DS:
+    return jnp.where(c, x[0], y[0]), jnp.where(c, x[1], y[1])
+
+
+def ds_f64ish(x: DS):
+    """hi + lo in f32 — an approximation usable for threshold compares."""
+    return x[0] + x[1]
+
+
+# --- sin -- Cody-Waite + ds-leading Taylor (see ops/ds.py) -------------------
+
+_PIO2_1 = np.float32(1.5707963267948966)
+_PIO2_2 = np.float32(1.5707963267948966 - float(np.float32(1.5707963267948966)))
+_PIO2_3 = np.float32(
+    1.5707963267948966
+    - float(np.float32(1.5707963267948966))
+    - float(_PIO2_2)
+)
+_TWO_OVER_PI = np.float32(0.6366197723675814)
+
+
+def _c(v: float):
+    hi = np.float32(v)
+    return hi, np.float32(v - float(hi))
+
+
+_S3 = _c(-1.0 / 6.0)
+_S5 = _c(1.0 / 120.0)
+_S7 = _c(-1.0 / 5040.0)
+_S9 = _c(1.0 / 362880.0)
+_S11 = np.float32(-1.0 / 39916800.0)
+_S13 = np.float32(1.0 / 6227020800.0)
+
+_C2 = _c(-0.5)
+_C4 = _c(1.0 / 24.0)
+_C6 = _c(-1.0 / 720.0)
+_C8 = _c(1.0 / 40320.0)
+_C10 = np.float32(-1.0 / 3628800.0)
+_C12 = np.float32(1.0 / 479001600.0)
+
+
+def _sin_poly(y: DS) -> DS:
+    y2 = ds_mul(y, y)
+    tail = _S11 + y2[0] * _S13
+    p = ds_add(_S9, ds_mul_f32(y2, tail))
+    p = ds_add(_S7, ds_mul(y2, p))
+    p = ds_add(_S5, ds_mul(y2, p))
+    p = ds_add(_S3, ds_mul(y2, p))
+    return ds_add(y, ds_mul(ds_mul(y, y2), p))
+
+
+def _cos_poly(y: DS) -> DS:
+    y2 = ds_mul(y, y)
+    tail = _C10 + y2[0] * _C12
+    p = ds_add(_C8, ds_mul_f32(y2, tail))
+    p = ds_add(_C6, ds_mul(y2, p))
+    p = ds_add(_C4, ds_mul(y2, p))
+    p = ds_add(_C2, ds_mul(y2, p))
+    one = (jnp.ones_like(y[0]), jnp.zeros_like(y[0]))
+    return ds_add(one, ds_mul(y2, p))
+
+
+def ds_sin(x: DS) -> DS:
+    """sin(x) in ds precision, branch-free, |x| <= ~2^22."""
+    k = jnp.round(x[0] * _TWO_OVER_PI)
+    t1, e1 = two_prod(k, _PIO2_1)
+    h = x[0] - t1            # exact by Sterbenz
+    t2, e2 = two_prod(k, _PIO2_2)
+    y = (h, jnp.zeros_like(h))
+    y = ds_add_f32(y, -e1)
+    y = ds_add_f32(y, x[1])
+    y = ds_add_f32(y, -t2)
+    y = ds_add_f32(y, -e2)
+    y = ds_add_f32(y, -(k * _PIO2_3))
+
+    q = k.astype(jnp.int32) & 3
+    sin_y = _sin_poly(y)
+    cos_y = _cos_poly(y)
+    use_cos = (q & 1) == 1
+    negate = q >= 2
+    res = ds_where(use_cos, cos_y, sin_y)
+    return ds_where(negate, ds_neg(res), res)

@@ -180,14 +180,20 @@ def bag_step(state: BagState, f_theta: Callable, eps: float, rule: Rule,
 
 @functools.partial(jax.jit,
                    static_argnames=("f_theta", "eps", "rule", "chunk",
-                                    "capacity", "max_iters"))
+                                    "capacity", "max_iters", "stop_count"))
 def _run_bag(state: BagState, *, f_theta: Callable,
              eps: float, rule: Rule, chunk: int, capacity: int,
-             max_iters: int) -> BagState:
+             max_iters: int,
+             stop_count: Optional[int] = None) -> BagState:
+    """Run the bag to empty (default) or until it holds >= stop_count
+    tasks (the walker's breeding phase — see parallel/walker.py)."""
     def cond(s: BagState):
-        return jnp.logical_and(
+        live = jnp.logical_and(
             jnp.logical_and(s.count > 0, jnp.logical_not(s.overflow)),
             s.iters < max_iters)
+        if stop_count is not None:
+            live = jnp.logical_and(live, s.count < stop_count)
+        return live
 
     def body(s: BagState):
         return bag_step(s, f_theta, eps, rule, chunk, capacity)

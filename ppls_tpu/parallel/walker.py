@@ -1,0 +1,706 @@
+"""Depth-first subtree-walker: the Pallas flagship engine.
+
+Every chunked engine in this package pays a per-task scheduling tax in
+XLA ops: the compaction sort (~53 us per 2^15-task chunk on v5e), pops,
+pushes, and the per-op scheduling gaps between them — a hard ceiling of
+~1.8 G evals/s no matter how fast the evaluation itself gets (profiled in
+round 2; see tools/profile_bag.py and the BENCH history).
+
+This engine removes the scheduling tax entirely for the hot phase. Each
+of 2^15 SIMD lanes walks ONE task's whole refinement subtree depth-first,
+*in registers*, using the implicit binary-tree address (i, d): the
+current node of root [A, A+W] is [A + i*W*2^-d, A + (i+1)*W*2^-d].
+
+* No bag traffic per task: descend is ``i <<= 1``; advance after an
+  accepted leaf strips trailing ones (t = ctz(i+1); i = (i >> t) + 1;
+  d -= t) — pure int32 VPU ops, no stack (depth <= 30 per root).
+* One integrand eval per step: DFS visits leaves left-to-right, so
+  consecutive nodes share an endpoint. The kernel caches f(left) and
+  f(right) per lane; a TEST step evaluates only the midpoint, an
+  ADVANCE step reloads only the new right endpoint. (The reference
+  evaluates 5 points per task — aquadPartA.c:185-190; the chunked
+  engines here evaluate 3; the walker amortizes to ~1.5.)
+* Arithmetic is fence-free double-single f32 (``ops/ds_kernel.py``) —
+  TPU-native extended precision inside Mosaic, where error-free
+  transforms survive without the XLA fences that made the round-1 ds
+  engine 7.6x slower than emulated f64.
+* Leaf areas accumulate lane-locally in ds; per-family credit happens
+  only at segment boundaries via the exact digit-plane MXU reduction
+  (``ops/reduction.exact_segment_sum``).
+
+Orchestration (all device-resident, 3 jit programs):
+
+1. BREED: the f64 bag engine (exact reference semantics,
+   ``aquadPartA.c:183-202``) refines the seed intervals until the bag
+   holds >= roots_per_lane * LANES tasks — the walker's root queue.
+2. WALK: segments of K kernel iterations; between segments, finished
+   lanes bank their accumulators (exact_segment_sum by family) and
+   take fresh roots from the queue (one monotone gather). Stops when
+   the queue is dry and lane occupancy drops below a threshold.
+3. MOP-UP: un-walked state is converted BACK into explicit bag tasks —
+   a suspended DFS position (i, d) expands into its pending right
+   siblings ((i >> k) + 1 at depth d - k for each zero bit k) plus the
+   current node — and the f64 bag engine finishes them with leftover
+   roots. This also catches (never-observed) depth-30 overflows.
+
+Precision: the walker's split test and leaf values are ds (~1e-14 rel),
+not bit-identical to the C/f64 engines — borderline split decisions can
+flip, so task counts may differ by O(10 ppm) and areas by ~1e-11. The
+f64 bag engine remains the parity path; the bench area gate (1e-9 vs the
+sequential C baseline) passes through the walker. Validated in
+tests/test_walker.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ppls_tpu.config import Rule
+from ppls_tpu.ops import ds_kernel as dsk
+from ppls_tpu.ops.reduction import exact_segment_sum
+from ppls_tpu.parallel.bag_engine import (
+    ACCEPT_BIT,
+    DEPTH_BITS,
+    DEPTH_MASK,
+    BagState,
+    _run_bag,
+    initial_bag,
+)
+from ppls_tpu.utils.metrics import RunMetrics
+
+DEFAULT_LANES = 1 << 15     # SIMD lanes of the walker (multiple of 128)
+MAX_REL_DEPTH = 30          # i must stay in int32
+
+# flags bits
+_MODE_LOAD = 1              # next eval reloads f(right) instead of midpoint
+_PARKED = 2                 # lane finished its root (or has none)
+_NO_ROOT = 4                # lane has no root assigned (idle)
+_OVF = 8                    # lane parked on depth overflow: its partial
+                            # accumulator is banked, but it must NOT be
+                            # refilled — its (i, d) pending set feeds the
+                            # mop-up phase
+
+
+class WalkState(NamedTuple):
+    """Per-lane walker state, all (ROWS, 128)."""
+
+    a_h: jnp.ndarray        # root left endpoint (ds)
+    a_l: jnp.ndarray
+    w_h: jnp.ndarray        # root width (ds)
+    w_l: jnp.ndarray
+    th_h: jnp.ndarray       # integrand parameter (ds)
+    th_l: jnp.ndarray
+    fl_h: jnp.ndarray       # cached f(left endpoint of current node)
+    fl_l: jnp.ndarray
+    fr_h: jnp.ndarray       # cached f(right endpoint of current node)
+    fr_l: jnp.ndarray
+    acc_h: jnp.ndarray      # ds accumulator for the current root
+    acc_l: jnp.ndarray
+    i: jnp.ndarray          # int32 node index at depth d
+    d: jnp.ndarray          # int32 depth relative to the root
+    base_d: jnp.ndarray     # int32 absolute depth of the root
+    fam: jnp.ndarray        # int32 family of the current root
+    flags: jnp.ndarray      # int32 mode/parked/no-root bits
+    tasks: jnp.ndarray      # int32 cumulative tasks evaluated by this lane
+    splits: jnp.ndarray     # int32
+    maxd: jnp.ndarray       # int32 max absolute depth seen
+
+
+def _node_geometry(s: WalkState):
+    """Exact-ish dyadic coordinates of the current node from (i, d):
+    stateless reconstruction, so coordinate error (~1 ds ulp) does not
+    accumulate along the walk."""
+    scale = jnp.exp2(-s.d.astype(jnp.float32))          # exact powers of 2
+    w = (s.w_h * scale, s.w_l * scale)
+    il = (s.i & 0x7FFF).astype(jnp.float32)             # two exact limbs
+    ih = (s.i >> 15).astype(jnp.float32)
+    step = dsk.ds_add(dsk.ds_mul_f32(dsk.ds_mul_pow2(w, 32768.0), ih),
+                      dsk.ds_mul_f32(w, il))
+    x0 = dsk.ds_add((s.a_h, s.a_l), step)
+    x1 = dsk.ds_add(x0, w)
+    return w, x0, x1
+
+
+def _ctz(k):
+    """Count trailing zeros of a positive int32 via the f32 exponent."""
+    low = k & (-k)
+    f = low.astype(jnp.float32)
+    return (lax.bitcast_convert_type(f, jnp.int32) >> 23) - 127
+
+
+def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
+                     interpret: bool = False):
+    """Build the segment kernel: seg_iters walker steps over all lanes.
+
+    ``f_ds((hi, lo) x, (hi, lo) theta) -> (hi, lo)`` is the ds integrand.
+    """
+    eps32 = np.float32(eps)
+
+    def step(s: WalkState) -> WalkState:
+        parked = (s.flags & _PARKED) != 0
+        mode_load = (s.flags & _MODE_LOAD) != 0
+        live = jnp.logical_not(parked)
+
+        w, x0, x1 = _node_geometry(s)
+        mid = dsk.ds_add(x0, dsk.ds_mul_pow2(w, 0.5))
+
+        # the single eval of this step (parked lanes eval a benign point)
+        xq = dsk.ds_where(mode_load, x1, mid)
+        xq = dsk.ds_where(parked, (jnp.ones_like(xq[0]),
+                                   jnp.zeros_like(xq[1])), xq)
+        fq = f_ds(xq, (s.th_h, s.th_l))
+
+        # trapezoid test (reference semantics, aquadPartA.c:185-199)
+        quarter = dsk.ds_mul_pow2(w, 0.25)
+        fl = (s.fl_h, s.fl_l)
+        fr = (s.fr_h, s.fr_l)
+        la = dsk.ds_mul(dsk.ds_add(fl, fq), quarter)
+        ra = dsk.ds_mul(dsk.ds_add(fq, fr), quarter)
+        val = dsk.ds_add(la, ra)
+        lr = dsk.ds_mul(dsk.ds_add(fl, fr), dsk.ds_mul_pow2(w, 0.5))
+        err = dsk.ds_abs(dsk.ds_sub(val, lr))
+        split = (err[0] + err[1]) > eps32
+
+        testing = jnp.logical_and(live, jnp.logical_not(mode_load))
+        do_split = jnp.logical_and(testing, split)
+        # depth guard: an overflow lane parks un-finished; the mop-up
+        # phase expands its pending nodes into bag tasks.
+        ovf = jnp.logical_and(do_split, s.d >= MAX_REL_DEPTH)
+        do_split = jnp.logical_and(do_split, jnp.logical_not(ovf))
+        do_accept = jnp.logical_and(testing, jnp.logical_not(split))
+
+        # --- descend (left child): i <<= 1, midpoint becomes f(right)
+        # --- accept: bank value, advance to the DFS successor
+        acc = dsk.ds_add((s.acc_h, s.acc_l), dsk.ds_where(
+            do_accept, val, (jnp.zeros_like(val[0]), jnp.zeros_like(val[1]))))
+        t = _ctz(s.i + 1)
+        fin = jnp.logical_and(do_accept, t >= s.d)   # last leaf of the root
+        adv = jnp.logical_and(do_accept, jnp.logical_not(fin))
+        i_next = jnp.where(do_split, s.i * 2,
+                           jnp.where(adv, (s.i >> t) + 1, s.i))
+        d_next = jnp.where(do_split, s.d + 1,
+                           jnp.where(adv, s.d - t, s.d))
+        # caches: descend keeps f(left), f(mid) becomes f(right);
+        # advance shifts f(right) to f(left) and must reload f(right).
+        new_fl = dsk.ds_where(adv, fr, fl)
+        new_fr = dsk.ds_where(do_split, fq, fr)
+        new_fr = dsk.ds_where(mode_load, fq, new_fr)
+
+        flags = s.flags
+        flags = jnp.where(adv, flags | _MODE_LOAD, flags)
+        flags = jnp.where(mode_load, flags & ~_MODE_LOAD, flags)
+        flags = jnp.where(fin, flags | _PARKED, flags)
+        flags = jnp.where(ovf, flags | (_PARKED | _OVF), flags)
+
+        return WalkState(
+            a_h=s.a_h, a_l=s.a_l, w_h=s.w_h, w_l=s.w_l,
+            th_h=s.th_h, th_l=s.th_l,
+            fl_h=new_fl[0], fl_l=new_fl[1],
+            fr_h=new_fr[0], fr_l=new_fr[1],
+            acc_h=acc[0], acc_l=acc[1],
+            i=i_next, d=d_next, base_d=s.base_d, fam=s.fam,
+            flags=flags,
+            tasks=s.tasks + testing.astype(jnp.int32),
+            splits=s.splits + do_split.astype(jnp.int32),
+            maxd=jnp.maximum(s.maxd, jnp.where(
+                testing, s.base_d + s.d, jnp.int32(0))),
+        )
+
+    n_fields = len(WalkState._fields)
+
+    def kernel(*refs):
+        in_refs = refs[:n_fields]
+        out_refs = refs[n_fields:]
+        s = WalkState(*(r[:] for r in in_refs))
+
+        def body(_, s):
+            return step(s)
+
+        out = lax.fori_loop(0, seg_iters, body, s)
+        for r, v in zip(out_refs, out):
+            r[:] = v
+
+    def run_segment(state: WalkState) -> WalkState:
+        shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in state)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=shapes,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_fields,
+            out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * n_fields,
+            interpret=interpret,
+        )(*state)
+        return WalkState(*out)
+
+    return run_segment
+
+
+# ---------------------------------------------------------------------------
+# XLA orchestration
+# ---------------------------------------------------------------------------
+
+
+class _WalkCarry(NamedTuple):
+    lanes: WalkState
+    bag: BagState           # the root queue (phase-1 output, read-only here)
+    cursor: jnp.ndarray     # int32 — next unconsumed root in [0, bag.count)
+    acc: jnp.ndarray        # (m,) f64 per-family banked areas
+    segs: jnp.ndarray       # int32 segments executed
+
+
+def _bank_and_refill(c: _WalkCarry, f64_f: Callable, m: int,
+                     lanes: int) -> _WalkCarry:
+    """Credit finished lanes' accumulators to their families and hand
+    them fresh roots (one monotone gather from the root queue)."""
+    s = c.lanes
+    parked = ((s.flags & _PARKED) != 0).reshape(-1)
+    has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
+    ovf = ((s.flags & _OVF) != 0).reshape(-1)
+    bank = jnp.logical_and(parked, has_root)
+
+    contrib = jnp.where(
+        bank,
+        s.acc_h.astype(jnp.float64).reshape(-1)
+        + s.acc_l.astype(jnp.float64).reshape(-1),
+        0.0)
+    acc = c.acc + exact_segment_sum(s.fam.reshape(-1), contrib, m, lanes)
+
+    rows = lanes // 128
+    # refill: parked lanes take queue entries in lane order — EXCEPT
+    # overflow lanes, whose (i, d) pending state must survive for the
+    # mop-up phase. rank = position among refill candidates.
+    refillable = jnp.logical_and(parked, jnp.logical_not(ovf))
+    rank = jnp.cumsum(refillable, dtype=jnp.int32) - 1
+    avail = c.bag.count - c.cursor
+    take = jnp.logical_and(refillable, rank < avail)
+    idx = jnp.clip(c.cursor + rank, 0, c.bag.count - 1)
+
+    rl = c.bag.bag_l[idx]
+    rr = c.bag.bag_r[idx]
+    rth = c.bag.bag_th[idx]
+    rmeta = c.bag.bag_meta[idx]
+    f_l = f64_f(rl, rth)
+    f_r = f64_f(rr, rth)
+
+    def to_ds(x):
+        hi = x.astype(jnp.float32)
+        lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+        return hi.reshape(rows, 128), lo.reshape(rows, 128)
+
+    a_h, a_l = to_ds(rl)
+    w_h, w_l = to_ds(rr - rl)
+    th_h, th_l = to_ds(rth)
+    flh, fll = to_ds(f_l)
+    frh, frl = to_ds(f_r)
+    fam_new = (rmeta >> DEPTH_BITS).reshape(rows, 128)
+    based_new = (rmeta & DEPTH_MASK).reshape(rows, 128)
+
+    take2 = take.reshape(rows, 128)
+    z32 = jnp.zeros((rows, 128), jnp.float32)
+    zi = jnp.zeros((rows, 128), jnp.int32)
+
+    def pick(new, old):
+        return jnp.where(take2, new, old)
+
+    # Finished lanes that got no root go idle (parked | no-root); banked
+    # lanes' accumulators reset; OVF lanes keep their flags AND state.
+    bank2 = bank.reshape(rows, 128)
+    retire = jnp.logical_and(refillable, jnp.logical_not(take))
+    flags = s.flags
+    flags = jnp.where(take2, zi, flags)                       # fresh TEST
+    flags = jnp.where(retire.reshape(rows, 128),
+                      jnp.int32(_PARKED | _NO_ROOT), flags)
+
+    lanes = WalkState(
+        a_h=pick(a_h, s.a_h), a_l=pick(a_l, s.a_l),
+        w_h=pick(w_h, s.w_h), w_l=pick(w_l, s.w_l),
+        th_h=pick(th_h, s.th_h), th_l=pick(th_l, s.th_l),
+        fl_h=pick(flh, s.fl_h), fl_l=pick(fll, s.fl_l),
+        fr_h=pick(frh, s.fr_h), fr_l=pick(frl, s.fr_l),
+        acc_h=jnp.where(bank2, z32, s.acc_h),
+        acc_l=jnp.where(bank2, z32, s.acc_l),
+        i=pick(zi, s.i), d=pick(zi, s.d),
+        base_d=pick(based_new, s.base_d), fam=pick(fam_new, s.fam),
+        flags=flags,
+        tasks=s.tasks, splits=s.splits, maxd=s.maxd,
+    )
+    n_taken = jnp.sum(take, dtype=jnp.int32)
+    return _WalkCarry(lanes=lanes, bag=c.bag,
+                      cursor=c.cursor + n_taken, acc=acc,
+                      segs=c.segs + 1)
+
+
+def _idle_lanes(s: WalkState):
+    return jnp.sum((s.flags & _PARKED) != 0, dtype=jnp.int32)
+
+
+def _run_walk(bag: BagState, *, f_ds: Callable, f64_f: Callable, eps: float,
+              m: int, seg_iters: int, max_segments: int,
+              min_active_frac: float, interpret: bool,
+              lanes: int) -> _WalkCarry:
+    """One walk phase (traced inline inside :func:`_run_cycles`)."""
+    run_segment = make_walk_kernel(f_ds, eps, seg_iters, interpret=interpret)
+
+    rows = lanes // 128
+    z32 = jnp.zeros((rows, 128), jnp.float32)
+    zi = jnp.zeros((rows, 128), jnp.int32)
+    ones = jnp.ones((rows, 128), jnp.float32)
+    lane0 = WalkState(
+        a_h=ones, a_l=z32, w_h=ones, w_l=z32, th_h=ones, th_l=z32,
+        fl_h=z32, fl_l=z32, fr_h=z32, fr_l=z32, acc_h=z32, acc_l=z32,
+        i=zi, d=zi, base_d=zi, fam=zi,
+        flags=jnp.full((rows, 128), _PARKED | _NO_ROOT, jnp.int32),
+        tasks=zi, splits=zi, maxd=zi,
+    )
+    # segs starts at -1: the initial seeding call below increments it,
+    # so `segs` counts executed kernel segments only.
+    carry = _WalkCarry(lanes=lane0, bag=bag, cursor=jnp.int32(0),
+                       acc=jnp.zeros(m, jnp.float64), segs=jnp.int32(-1))
+    carry = _bank_and_refill(carry, f64_f, m, lanes)   # initial seeding
+    min_active = jnp.int32(int(lanes * min_active_frac))
+
+    def cond(c: _WalkCarry):
+        idle = _idle_lanes(c.lanes)
+        active = lanes - idle
+        queue_left = c.bag.count - c.cursor
+        useful = jnp.logical_or(active >= min_active,
+                                jnp.logical_and(queue_left > 0,
+                                                active + queue_left
+                                                >= min_active))
+        return jnp.logical_and(useful, c.segs < max_segments)
+
+    def body(c: _WalkCarry):
+        new_lanes = run_segment(c.lanes)
+        return _bank_and_refill(c._replace(lanes=new_lanes), f64_f, m, lanes)
+
+    out = lax.while_loop(cond, body, carry)
+    # Final credit: lanes still mid-walk (suspended) hold accepted-leaf
+    # partial sums that no bank has seen — credit them now; their pending
+    # (un-walked) nodes become mop-up tasks via _expand_pending. OVF and
+    # finished lanes were already banked by the loop body.
+    s = out.lanes
+    suspended = jnp.logical_and(((s.flags & _NO_ROOT) == 0).reshape(-1),
+                                ((s.flags & _PARKED) == 0).reshape(-1))
+    contrib = jnp.where(
+        suspended,
+        s.acc_h.astype(jnp.float64).reshape(-1)
+        + s.acc_l.astype(jnp.float64).reshape(-1),
+        0.0)
+    acc = out.acc + exact_segment_sum(s.fam.reshape(-1), contrib, m, lanes)
+    return out._replace(acc=acc)
+
+
+def _expand_pending(c: _WalkCarry, capacity: int, chunk: int,
+                    m: int) -> BagState:
+    """Convert un-walked state back into explicit bag tasks:
+
+    * roots never consumed: queue entries [cursor, count)
+    * suspended lanes: the current node (i, d) plus the pending right
+      sibling (i >> k) + 1 at depth d - k for every zero bit k < d.
+    """
+    s = c.lanes
+    has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
+    parked = ((s.flags & _PARKED) != 0).reshape(-1)
+    ovf = ((s.flags & _OVF) != 0).reshape(-1)
+    # Pending work exists on lanes suspended mid-walk (active with a
+    # root) and on depth-overflow lanes (parked but un-finished, kept
+    # un-refilled by _bank_and_refill). Finished lanes were refilled or
+    # retired to _NO_ROOT and have no pending nodes.
+    suspended = jnp.logical_or(
+        jnp.logical_and(has_root, jnp.logical_not(parked)), ovf)
+
+    i_l = s.i.reshape(-1)
+    d_l = s.d.reshape(-1)
+    a_h = s.a_h.reshape(-1).astype(jnp.float64)
+    a_l = s.a_l.reshape(-1).astype(jnp.float64)
+    w_h = s.w_h.reshape(-1).astype(jnp.float64)
+    w_l = s.w_l.reshape(-1).astype(jnp.float64)
+    th = (s.th_h.reshape(-1).astype(jnp.float64)
+          + s.th_l.reshape(-1).astype(jnp.float64))
+    a64 = a_h + a_l
+    w64 = w_h + w_l
+    fam_l = s.fam.reshape(-1)
+    based = s.base_d.reshape(-1)
+
+    # pending grid: k = 0 -> the current node; k = 1..MAX -> ancestors'
+    # right siblings at depth d - (k - 1) where bit (k-1) of i is 0.
+    ks = jnp.arange(MAX_REL_DEPTH + 1, dtype=jnp.int32)[:, None]  # (K+1, L)
+    kb = jnp.maximum(ks - 1, 0)    # ks==0 row is fully masked below
+    node_i = jnp.where(ks == 0, i_l[None, :],
+                       (i_l[None, :] >> kb) + 1)
+    node_d = jnp.where(ks == 0, d_l[None, :], d_l[None, :] - kb)
+    valid = jnp.where(
+        ks == 0, suspended[None, :],
+        jnp.logical_and(
+            jnp.logical_and(suspended[None, :], kb < d_l[None, :]),
+            ((i_l[None, :] >> kb) & 1) == 0))
+
+    wd = w64[None, :] * jnp.exp2(-node_d.astype(jnp.float64))
+    ln = a64[None, :] + node_i.astype(jnp.float64) * wd
+    rn = ln + wd
+    meta_n = ((fam_l[None, :] << DEPTH_BITS)
+              + jnp.minimum(based[None, :] + node_d, DEPTH_MASK))
+    th_n = jnp.broadcast_to(th[None, :], ln.shape)
+
+    # plus the unconsumed roots
+    qvalid = jnp.arange(c.bag.bag_l.shape[0], dtype=jnp.int32)
+    qvalid = jnp.logical_and(qvalid >= c.cursor, qvalid < c.bag.count)
+
+    flat = lambda x: x.reshape(-1)
+    all_l = jnp.concatenate([flat(ln), c.bag.bag_l])
+    all_r = jnp.concatenate([flat(rn), c.bag.bag_r])
+    all_th = jnp.concatenate([flat(th_n), c.bag.bag_th])
+    all_meta = jnp.concatenate([flat(meta_n), c.bag.bag_meta])
+    all_valid = jnp.concatenate([flat(valid), qvalid])
+
+    # compact valid tasks to a dense prefix (the engine's standard
+    # sort-compaction), then lay them into a fresh bag.
+    key = jnp.logical_not(all_valid).astype(jnp.int32)
+    key, sl, sr, sth, smeta = lax.sort(
+        (key, all_l, all_r, all_th, all_meta), dimension=0, is_stable=True,
+        num_keys=1)
+    n_tasks = jnp.sum(all_valid, dtype=jnp.int32)
+
+    store = capacity + 2 * chunk
+    ns = sl.shape[0]
+    # Dead slots (beyond n_tasks) must hold benign in-domain data — they
+    # are still evaluated under the mask (see initial_bag's dead-slot
+    # note). Overwrite them with the first valid task's values. (If
+    # n_tasks == 0 the fill is garbage but the bag loop never runs.)
+    live = jnp.arange(ns, dtype=jnp.int32) < n_tasks
+
+    def fit(x, fill):
+        x = jnp.where(live, x, fill)
+        if ns >= store:
+            return x[:store]
+        return jnp.concatenate(
+            [x, jnp.broadcast_to(fill, (store - ns,)).astype(x.dtype)])
+
+    bag_l = fit(sl, sl[0])
+    bag_r = fit(sr, sr[0])
+    bag_th = fit(sth, sth[0])
+    bag_meta = fit(smeta, jnp.int32(0))
+
+    return BagState(
+        bag_l=bag_l, bag_r=bag_r, bag_th=bag_th, bag_meta=bag_meta,
+        count=jnp.minimum(n_tasks, capacity),
+        acc=jnp.zeros(m, jnp.float64),
+        tasks=jnp.zeros((), jnp.int64),
+        splits=jnp.zeros((), jnp.int64),
+        iters=jnp.zeros((), jnp.int64),
+        max_depth=jnp.zeros((), jnp.int32),
+        overflow=n_tasks > capacity,
+    )
+
+
+class _CycleCarry(NamedTuple):
+    bag: BagState
+    acc: jnp.ndarray        # (m,) f64 accumulated areas (all phases)
+    tasks: jnp.ndarray      # i64 total tasks (all phases)
+    splits: jnp.ndarray     # i64
+    btasks: jnp.ndarray     # i64 tasks done by the f64 bag phases
+    wtasks: jnp.ndarray     # i64 tasks done by the Pallas walker
+    wsplits: jnp.ndarray    # i64
+    roots: jnp.ndarray      # i64 roots consumed by the walker
+    rounds: jnp.ndarray     # i64 bag iterations (breed + drain)
+    segs: jnp.ndarray       # i64 walker segments
+    maxd: jnp.ndarray       # i32
+    cycles: jnp.ndarray     # i32
+    overflow: jnp.ndarray   # bool
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("f_theta", "f_ds", "eps", "m", "seg_iters",
+                     "max_segments", "min_active_frac", "interpret",
+                     "lanes", "capacity", "breed_chunk", "target",
+                     "max_cycles"))
+def _run_cycles(bag: BagState, *, f_theta: Callable, f_ds: Callable,
+                eps: float, m: int, seg_iters: int, max_segments: int,
+                min_active_frac: float, interpret: bool, lanes: int,
+                capacity: int, breed_chunk: int, target: int,
+                max_cycles: int) -> _CycleCarry:
+    """The full engine as ONE device program:
+
+        while bag not empty:
+            breed   (f64 BFS bag until >= target roots, or done)
+            walk    (Pallas walker until queue dry & occupancy low)
+            expand  (suspended walks -> bag tasks)
+            drain   (f64 bag to empty, only when the remainder is small)
+
+    Deep refinement regions that stall the walker are re-bred into
+    fresh, deeper roots on the next cycle, so occupancy recovers instead
+    of collapsing into one giant f64 mop-up (the single-pass design
+    measured only 28% walker coverage on the deep bench workload).
+    """
+
+    def cond(c: _CycleCarry):
+        return jnp.logical_and(
+            jnp.logical_and(c.bag.count > 0, c.cycles < max_cycles),
+            jnp.logical_not(c.overflow))
+
+    def body(c: _CycleCarry):
+        bred = _run_bag(c.bag, f_theta=f_theta, eps=eps,
+                        rule=Rule.TRAPEZOID, chunk=breed_chunk,
+                        capacity=capacity, max_iters=1 << 20,
+                        stop_count=target)
+        walk = _run_walk(bred, f_ds=f_ds, f64_f=f_theta, eps=eps, m=m,
+                         seg_iters=seg_iters, max_segments=max_segments,
+                         min_active_frac=min_active_frac,
+                         interpret=interpret, lanes=lanes)
+        bag2 = _expand_pending(walk, capacity, breed_chunk, m)
+
+        # small remainders: straight to the f64 engine (guarantees
+        # progress when count < the walker occupancy threshold).
+        def drain(b: BagState):
+            return _run_bag(b, f_theta=f_theta, eps=eps,
+                            rule=Rule.TRAPEZOID, chunk=breed_chunk,
+                            capacity=capacity, max_iters=1 << 20,
+                            stop_count=None)
+
+        bag3 = lax.cond(bag2.count < lanes, drain, lambda b: b, bag2)
+
+        wt = jnp.sum(walk.lanes.tasks.astype(jnp.int64))
+        ws = jnp.sum(walk.lanes.splits.astype(jnp.int64))
+        bag_tasks = bred.tasks + bag3.tasks
+        bag_splits = bred.splits + bag3.splits
+        next_bag = bag3._replace(
+            acc=jnp.zeros_like(bag3.acc),
+            tasks=jnp.zeros((), jnp.int64),
+            splits=jnp.zeros((), jnp.int64),
+            iters=jnp.zeros((), jnp.int64),
+            max_depth=jnp.zeros((), jnp.int32),
+        )
+        return _CycleCarry(
+            bag=next_bag,
+            acc=c.acc + bred.acc + walk.acc + bag3.acc,
+            tasks=c.tasks + bag_tasks + wt,
+            splits=c.splits + bag_splits + ws,
+            btasks=c.btasks + bag_tasks,
+            wtasks=c.wtasks + wt,
+            wsplits=c.wsplits + ws,
+            roots=c.roots + walk.cursor.astype(jnp.int64),
+            rounds=c.rounds + bred.iters + bag3.iters,
+            segs=c.segs + walk.segs.astype(jnp.int64),
+            maxd=jnp.maximum(
+                jnp.maximum(c.maxd, jnp.max(walk.lanes.maxd)),
+                jnp.maximum(bred.max_depth, bag3.max_depth)),
+            cycles=c.cycles + 1,
+            overflow=jnp.logical_or(bred.overflow, bag3.overflow),
+        )
+
+    z64 = jnp.zeros((), jnp.int64)
+    init = _CycleCarry(
+        bag=bag, acc=jnp.zeros(m, jnp.float64),
+        tasks=z64, splits=z64, btasks=z64, wtasks=z64, wsplits=z64,
+        roots=z64, rounds=z64, segs=z64,
+        maxd=jnp.zeros((), jnp.int32), cycles=jnp.zeros((), jnp.int32),
+        overflow=jnp.zeros((), bool),
+    )
+    return lax.while_loop(cond, body, init)
+
+
+@dataclasses.dataclass
+class WalkerResult:
+    areas: np.ndarray
+    metrics: RunMetrics
+    lane_efficiency: float       # walker tasks / (segments * K * lanes)
+    walker_fraction: float       # share of tasks done by the Pallas kernel
+    cycles: int = 0
+
+
+def integrate_family_walker(
+        f_theta: Callable, f_ds: Callable, theta: Sequence[float],
+        bounds, eps: float,
+        chunk: int = 1 << 15,
+        capacity: int = 1 << 23,
+        lanes: int = DEFAULT_LANES,
+        roots_per_lane: int = 12,
+        seg_iters: int = 512,
+        max_segments: int = 1 << 16,
+        min_active_frac: float = 0.25,
+        max_cycles: int = 64,
+        interpret: Optional[bool] = None) -> WalkerResult:
+    """Flagship integration: cycles of breed (f64 bag, BFS) -> walk
+    (Pallas ds kernel) -> expand -> drain, all in one device program.
+
+    ``f_theta(x, th)`` is the f64 integrand; ``f_ds(x_ds, th_ds)`` the
+    matching ds implementation used inside the kernel
+    (``models.integrands.get_family_ds``).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if lanes % 128:
+        raise ValueError(f"lanes must be a multiple of 128, got {lanes}")
+    theta = np.asarray(theta, dtype=np.float64)
+    m = theta.shape[0]
+    bounds = np.asarray(bounds, dtype=np.float64)
+    if bounds.ndim == 1:
+        bounds = np.tile(bounds.reshape(1, 2), (m, 1))
+
+    # Breeding pops the WHOLE bag each iteration (chunk >= target:
+    # breadth-first, the frontier doubles per round) — a plain LIFO
+    # chunk plateaus at ~2x the pop width and never reaches the target.
+    # A BFS frontier also yields depth-uniform roots, which balances
+    # the walker's subtree sizes.
+    target = min(roots_per_lane * lanes, capacity // 2)
+    breed_chunk = max(1 << int(target - 1).bit_length(), chunk)
+
+    t0 = time.perf_counter()
+    state = initial_bag(bounds, capacity, m, breed_chunk, theta=theta)
+    out = _run_cycles(state, f_theta=f_theta, f_ds=f_ds, eps=float(eps),
+                      m=m, seg_iters=int(seg_iters),
+                      max_segments=int(max_segments),
+                      min_active_frac=float(min_active_frac),
+                      interpret=bool(interpret), lanes=int(lanes),
+                      capacity=int(capacity), breed_chunk=int(breed_chunk),
+                      target=int(target), max_cycles=int(max_cycles))
+    (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
+     maxd, cycles, overflow, left) = jax.device_get(
+         (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
+          out.wsplits, out.roots, out.rounds, out.segs, out.maxd,
+          out.cycles, out.overflow, out.bag.count))
+    wall = time.perf_counter() - t0
+
+    if bool(overflow):
+        raise RuntimeError("walker bag overflowed; raise capacity")
+    if int(left) > 0:
+        raise RuntimeError(
+            f"walker did not converge in {int(cycles)} cycles "
+            f"({int(left)} tasks left); raise max_cycles")
+
+    tasks = int(tasks)
+    wtasks = int(wtasks)
+    segs = int(segs)
+    metrics = RunMetrics(
+        tasks=tasks,
+        splits=int(splits),
+        leaves=tasks - int(splits),
+        rounds=int(rounds) + segs,
+        max_depth=int(maxd),
+        # the walker evaluates 1 new point per TEST step, 1 per ADVANCE
+        # reload (one per accepted non-final leaf), and 2 per consumed
+        # root; the f64 bag phases evaluate 3 per task.
+        integrand_evals=3 * int(btasks)
+        + 2 * wtasks - int(wsplits) + 2 * int(roots),
+        wall_time_s=wall,
+        n_chips=1,
+        tasks_per_chip=[tasks],
+    )
+    denom = segs * seg_iters * lanes
+    return WalkerResult(
+        areas=np.asarray(acc),
+        metrics=metrics,
+        lane_efficiency=wtasks / denom if denom else 0.0,
+        walker_fraction=wtasks / tasks if tasks else 0.0,
+        cycles=int(cycles),
+    )
