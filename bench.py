@@ -70,18 +70,31 @@ def main():
     res = integrate_family(f_theta, theta, BOUNDS, EPS, **kw)
 
     # Correctness gate: identical rule + split semantics => areas match the
-    # C baseline to summation-order noise.
+    # C baseline to summation-order noise. The gate is NaN-PROOF by
+    # construction: finiteness is asserted first (a NaN slipping into
+    # Python's max() silently keeps the old value — exactly how the round-2
+    # all-NaN run recorded a perfect 0.00e+00 gate), and the pass condition
+    # is inverted (`not (worst <= tol)`) so a NaN residual fails.
+    if not np.all(np.isfinite(res.areas)):
+        print(json.dumps({"metric": "subintervals evaluated/sec/chip",
+                          "value": 0.0, "unit": "evals/s/chip",
+                          "vs_baseline": 0.0,
+                          "error": "non-finite TPU areas (NaN/inf)"}))
+        return 1
     worst = 0.0
+    gated = 0
     for i, s in enumerate(theta):
         if float(s) in cpu_areas:
             worst = max(worst, abs(res.areas[i] - cpu_areas[float(s)]))
-    if cpu_areas and worst > 1e-9:
+            gated += 1
+    if cpu_areas and not (worst <= 1e-9):
         print(json.dumps({"metric": "subintervals evaluated/sec/chip",
                           "value": 0.0, "unit": "evals/s/chip",
                           "vs_baseline": 0.0,
                           "error": f"area mismatch vs C baseline: {worst:.3e}"}))
         return 1
-    log(f"[bench] correctness: max |area_tpu - area_cpu| = {worst:.2e}")
+    log(f"[bench] correctness: max |area_tpu - area_cpu| = {worst:.2e} "
+        f"over {gated} gated scales")
 
     log(f"[bench] timing {REPEATS} runs ...")
     t0 = time.perf_counter()

@@ -123,7 +123,17 @@ def exact_segment_sum(fam: jnp.ndarray, leaf: jnp.ndarray, m: int,
     fa_n, fb_n = _segment_factors(m, planes)
 
     amax = jnp.max(jnp.abs(leaf))
-    e = jnp.ceil(jnp.log2(jnp.maximum(amax, jnp.float64(1e-300)))) + 1.0
+    # Zero/tiny guard: TPU emulates f64 as an f32 pair, so its exponent
+    # range is f32's — 1e-300 (and anything below ~2^-126) flushes to 0 on
+    # device, which made the old 1e-300 floor a no-op: an all-zero leaf
+    # vector gave log2(0) = -inf -> scale = 0 -> 0/0 = NaN, poisoning the
+    # accumulator for the rest of the run (the round-2 bench NaN). Clamp at
+    # 2^-40 instead: every derived quantity (scale >= 2^-39, smallest
+    # weight 2^(-72-39) = 2^-111) stays representable on-device, an all-zero
+    # vector yields exactly zero (r = 0/scale = 0), and a leaf smaller than
+    # the clamp contributes at most 2^-112 absolute — far below the 1e-9
+    # C-parity gate and below one ulp of any accepted area.
+    e = jnp.ceil(jnp.log2(jnp.maximum(amax, jnp.exp2(jnp.float64(-40.0))))) + 1.0
     scale = jnp.exp2(e)
     r = leaf / scale
     digs = []

@@ -287,6 +287,12 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
          out.max_depth, out.overflow))
     wall = time.perf_counter() - t0
 
+    acc_np = np.asarray(acc_np)
+    if not np.all(np.isfinite(acc_np)):
+        bad = int(np.sum(~np.isfinite(acc_np)))
+        raise FloatingPointError(
+            f"bag engine produced {bad}/{acc_np.size} non-finite areas "
+            f"(NaN/inf) — refusing to report garbage")
     if bool(overflow):
         raise RuntimeError(
             f"bag overflowed capacity={capacity}; raise capacity")
@@ -308,7 +314,7 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
         tasks_per_chip=[tasks],
     )
     return FamilyResult(
-        areas=np.asarray(acc_np),
+        areas=acc_np,
         metrics=metrics,
         lane_efficiency=tasks / (iters * chunk) if iters else 0.0,
     )

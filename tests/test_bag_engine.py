@@ -56,3 +56,15 @@ def test_bag_deterministic():
     a1 = integrate_bag(cfg, chunk=512).areas[0]
     a2 = integrate_bag(cfg, chunk=512).areas[0]
     assert a1 == a2
+
+
+def test_nan_areas_raise_not_report():
+    # An engine returning NaN must raise, not hand garbage to callers —
+    # the round-2 bench recorded a "perfect" gate over all-NaN areas
+    # because nothing between the accumulator and the JSON line checked
+    # finiteness (VERDICT r2 Weak #1/#2).
+    import jax.numpy as jnp
+
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        integrate_family(lambda x, th: x * jnp.nan, [0.0], (0.0, 1.0),
+                         1e-3, chunk=256, capacity=1 << 12)
