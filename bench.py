@@ -67,20 +67,22 @@ def main():
     kw = dict(chunk=1 << 15, capacity=1 << 23)
 
     log("[bench] TPU warmup/compile ...")
-    res = integrate_family(f_theta, theta, BOUNDS, EPS, **kw)
-
-    # Correctness gate: identical rule + split semantics => areas match the
-    # C baseline to summation-order noise. The gate is NaN-PROOF by
-    # construction: finiteness is asserted first (a NaN slipping into
-    # Python's max() silently keeps the old value — exactly how the round-2
-    # all-NaN run recorded a perfect 0.00e+00 gate), and the pass condition
-    # is inverted (`not (worst <= tol)`) so a NaN residual fails.
-    if not np.all(np.isfinite(res.areas)):
+    try:
+        res = integrate_family(f_theta, theta, BOUNDS, EPS, **kw)
+    except FloatingPointError as e:
+        # The engine raises on non-finite areas; keep the one-JSON-line
+        # contract so the driver records the failure instead of a traceback.
         print(json.dumps({"metric": "subintervals evaluated/sec/chip",
                           "value": 0.0, "unit": "evals/s/chip",
-                          "vs_baseline": 0.0,
-                          "error": "non-finite TPU areas (NaN/inf)"}))
+                          "vs_baseline": 0.0, "error": str(e)}))
         return 1
+
+    # Correctness gate: identical rule + split semantics => areas match the
+    # C baseline to summation-order noise. The gate is NaN-PROOF: the engine
+    # raised above on any non-finite area (a NaN slipping into Python's
+    # max() silently keeps the old value — exactly how the round-2 all-NaN
+    # run recorded a perfect 0.00e+00 gate), and the pass condition is
+    # inverted (`not (worst <= tol)`) so a NaN residual fails.
     worst = 0.0
     gated = 0
     for i, s in enumerate(theta):

@@ -288,17 +288,20 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
     wall = time.perf_counter() - t0
 
     acc_np = np.asarray(acc_np)
-    if not np.all(np.isfinite(acc_np)):
-        bad = int(np.sum(~np.isfinite(acc_np)))
-        raise FloatingPointError(
-            f"bag engine produced {bad}/{acc_np.size} non-finite areas "
-            f"(NaN/inf) — refusing to report garbage")
+    # Actionable resource errors first: an overflowed/truncated run often
+    # also has a garbage accumulator, and "raise capacity" is the fix the
+    # caller needs to see.
     if bool(overflow):
         raise RuntimeError(
             f"bag overflowed capacity={capacity}; raise capacity")
     if int(count) > 0:
         raise RuntimeError(f"max_iters={max_iters} exceeded with "
                            f"{int(count)} tasks pending")
+    if not np.all(np.isfinite(acc_np)):
+        bad = int(np.sum(~np.isfinite(acc_np)))
+        raise FloatingPointError(
+            f"bag engine produced {bad}/{acc_np.size} non-finite areas "
+            f"(NaN/inf) — refusing to report garbage")
 
     tasks = int(tasks)
     iters = int(iters)
