@@ -98,6 +98,15 @@ def main():
     log(f"[bench] correctness: max |area_tpu - area_cpu| = {worst:.2e} "
         f"over {gated} gated scales")
 
+    # North-star metric pair (BASELINE.json): throughput AND achieved abs
+    # error @ eps. Exact values from the host-side mpmath closed form
+    # (x·sin(θ/x) − θ·Ci(θ/x)), evaluated for the full family.
+    from ppls_tpu.models.integrands import family_exact
+    exact = family_exact("sin_recip_scaled", *BOUNDS, theta)
+    abs_err = float(np.max(np.abs(res.areas - np.asarray(exact))))
+    log(f"[bench] achieved abs error vs exact (mpmath, all {M} scales): "
+        f"max = {abs_err:.3e}")
+
     log(f"[bench] timing {REPEATS} runs ...")
     t0 = time.perf_counter()
     evals = 0
@@ -117,6 +126,8 @@ def main():
         "value": round(value, 1),
         "unit": "evals/s/chip",
         "vs_baseline": round(vs_baseline, 3),
+        "abs_error": abs_err,
+        "eps": EPS,
     }
     if not cpu_areas:
         # No C toolchain -> the area gate could not run; say so explicitly

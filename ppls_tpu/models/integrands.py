@@ -86,8 +86,23 @@ def _sin_recip(x):
     return jnp.sin(1.0 / x)
 
 
+def _sin_recip_anti(x):
+    # ∫sin(1/x) dx = x·sin(1/x) − Ci(1/x) for x > 0; the limit at x→0⁺ is 0
+    # (x·sin(1/x) → 0 and Ci(u) → 0 as u → ∞), so the improper integral
+    # from 0 converges. Cosine integral via mpmath at 40 digits on host
+    # (validated against independent high-precision quadrature to 16
+    # digits, tests/test_bag_engine.py).
+    if x < 0:
+        raise ValueError("sin_recip antiderivative defined for x >= 0")
+    if x == 0:
+        return 0.0
+    import mpmath
+    with mpmath.workdps(40):
+        return float(x * mpmath.sin(1.0 / x) - mpmath.ci(1.0 / x))
+
+
 register_integrand(
-    "sin_recip", _sin_recip, None,
+    "sin_recip", _sin_recip, _sin_recip_anti,
     doc="BASELINE.json oscillatory config: sin(1/x) on [1e-4, 1]; forces "
         "deep adaptive splitting near the left endpoint.",
 )
@@ -152,6 +167,59 @@ register_family("sin_recip_scaled", lambda x, s: jnp.sin(s / x))
 register_family("sin_scaled", lambda x, s: jnp.sin(s * x))
 register_family("gauss_center", lambda x, c: jnp.exp(
     -0.5 * ((x - c) / 1e-3) ** 2))
+
+
+# High-precision exact values for families, so the bench can report the
+# north-star metric pair (evals/sec/chip AND achieved abs error @ eps,
+# BASELINE.json). Host-side mpmath, never device math.
+
+FAMILY_EXACT: Dict[str, Callable] = {}
+
+
+def register_family_exact(name: str, fn: Callable) -> Callable:
+    """Register exact(a, b, theta) -> float for a parameterized family."""
+    FAMILY_EXACT[name] = fn
+    return fn
+
+
+def family_exact(name: str, a: float, b: float, theta) -> Optional["object"]:
+    """Exact integrals for every theta as a float list, or None if the
+    family has no registered closed form."""
+    fn = FAMILY_EXACT.get(name)
+    if fn is None:
+        return None
+    return [fn(float(a), float(b), float(t)) for t in theta]
+
+
+def _sin_recip_scaled_exact(a, b, th):
+    # ∫sin(θ/x) dx = x·sin(θ/x) − θ·Ci(θ/x)  (validated vs independent
+    # mpmath quadrature to 16 digits; see tests/test_bag_engine.py)
+    import mpmath
+    with mpmath.workdps(40):
+        t = mpmath.mpf(th)
+        F = lambda x: x * mpmath.sin(t / x) - t * mpmath.ci(t / x)
+        return float(F(mpmath.mpf(b)) - F(mpmath.mpf(a)))
+
+
+def _sin_scaled_exact(a, b, th):
+    import mpmath
+    with mpmath.workdps(40):
+        t = mpmath.mpf(th)
+        return float((mpmath.cos(t * a) - mpmath.cos(t * b)) / t)
+
+
+def _gauss_center_exact(a, b, c):
+    import mpmath
+    with mpmath.workdps(40):
+        s = mpmath.mpf("1e-3")
+        g = lambda x: s * mpmath.sqrt(mpmath.pi / 2) * mpmath.erf(
+            (mpmath.mpf(x) - c) / (s * mpmath.sqrt(2)))
+        return float(g(b) - g(a))
+
+
+register_family_exact("sin_recip_scaled", _sin_recip_scaled_exact)
+register_family_exact("sin_scaled", _sin_scaled_exact)
+register_family_exact("gauss_center", _gauss_center_exact)
 
 
 # --- double-single counterparts for the Pallas walker kernel --------------

@@ -84,4 +84,7 @@ def test_integrand_registry():
         assert name in INTEGRANDS
     # Analytic values sane
     assert abs(get_integrand("cosh4").exact(0.0, 5.0) - 7583461.361497) < 1e-3
-    assert get_integrand("sin_recip").exact(0.0, 1.0) is None
+    # ∫₀¹ sin(1/x) dx = sin(1) − Ci(1) (improper but convergent at 0)
+    import math
+    assert abs(get_integrand("sin_recip").exact(0.0, 1.0)
+               - (math.sin(1.0) - 0.3374039229009681)) < 1e-12
