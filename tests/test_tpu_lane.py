@@ -102,3 +102,26 @@ def test_device_engine_golden_area_on_device():
     res = device_integrate(cfg)
     assert abs(res.area - 7583461.801486) < 1e-5
     assert res.metrics.tasks == 6567
+
+
+def test_walker_parity_on_device():
+    # The Pallas walker (real Mosaic codegen, not interpret mode) must
+    # match the f64 bag engine within its ds contract on a deep workload.
+    from ppls_tpu.models.integrands import get_family, get_family_ds
+    from ppls_tpu.parallel.bag_engine import integrate_family
+    from ppls_tpu.parallel.walker import integrate_family_walker
+
+    f = get_family("sin_recip_scaled")
+    fds = get_family_ds("sin_recip_scaled")
+    theta = 1.0 + np.arange(8) / 8.0
+    eps = 1e-8
+    w = integrate_family_walker(f, fds, theta, (1e-4, 1.0), eps,
+                                capacity=1 << 20, lanes=1 << 12,
+                                roots_per_lane=4, seg_iters=64,
+                                min_active_frac=0.05)
+    b = integrate_family(f, theta, (1e-4, 1.0), eps,
+                         chunk=1 << 12, capacity=1 << 20)
+    assert np.all(np.isfinite(w.areas))
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9
+    assert abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks < 1e-3
+    assert w.walker_fraction > 0.5, w.walker_fraction

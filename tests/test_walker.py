@@ -1,0 +1,117 @@
+"""Pallas subtree-walker engine tests (interpret mode on CPU).
+
+The walker's split test runs in double-single f32, so its areas and task
+counts are NOT bit-identical to the f64 bag engine: borderline split
+decisions can flip and leaf values carry ~1e-14 relative ds error each
+(walker.py module docstring). Tolerances here encode the observed
+contract on this workload: areas ~1e-9, task drift well under 0.1%.
+
+These run the same orchestration code (`_run_cycles`) as the TPU path,
+with the Pallas kernel in interpret mode; the real-TPU twin lives in the
+`-m tpu` lane (tests/test_tpu_lane.py).
+"""
+
+import numpy as np
+import pytest
+
+from ppls_tpu.models.integrands import get_family, get_family_ds
+from ppls_tpu.parallel.bag_engine import integrate_family
+from ppls_tpu.parallel.walker import integrate_family_walker
+
+
+THETA = 1.0 + np.arange(4) / 4.0
+BOUNDS = (1e-2, 1.0)
+F = get_family("sin_recip_scaled")
+F_DS = get_family_ds("sin_recip_scaled")
+
+# Small-lane config so interpret mode stays fast; roots_per_lane=1 keeps
+# the breed target (lanes) below the workload's peak frontier so the
+# walker actually engages, and a low occupancy threshold keeps the deep
+# tail in the kernel instead of the f64 drain.
+KW = dict(capacity=1 << 16, lanes=256, roots_per_lane=1, seg_iters=32,
+          min_active_frac=0.05)
+
+
+def _bag(eps, theta=THETA, bounds=BOUNDS):
+    return integrate_family(F, theta, bounds, eps,
+                            chunk=1 << 10, capacity=1 << 16)
+
+
+def test_walker_parity_vs_bag():
+    eps = 1e-7
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps, **KW)
+    b = _bag(eps)
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9
+    # ds split decisions may flip near the tolerance boundary
+    drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 1e-3, (w.metrics.tasks, b.metrics.tasks)
+    assert w.metrics.tasks == w.metrics.splits + w.metrics.leaves
+
+
+def test_walker_actually_walks():
+    # The engine must not silently degrade into a pure bag run: on a deep
+    # workload with a small breed target most tasks go through the kernel.
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-7, **KW)
+    assert w.walker_fraction > 0.5, w.walker_fraction
+    assert 0.0 < w.lane_efficiency <= 1.0
+
+
+def test_walker_small_workload_falls_back():
+    # Shallow run: breeding satisfies the whole problem before the root
+    # target is reached — the walker must still return correct areas with
+    # fraction 0 (everything done by the exact f64 bag).
+    eps = 1e-3
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps, **KW)
+    b = _bag(eps)
+    assert np.max(np.abs(w.areas - b.areas)) < 1e-12
+    assert w.metrics.tasks == b.metrics.tasks
+
+
+def test_walker_mopup_via_forced_suspension():
+    # max_segments=1 suspends nearly every lane mid-walk: the result must
+    # still be correct via _expand_pending -> f64 drain (the mop-up path),
+    # over multiple cycles.
+    eps = 1e-7
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps,
+                                capacity=1 << 16, lanes=256,
+                                roots_per_lane=1, seg_iters=8,
+                                max_segments=1, max_cycles=256)
+    b = _bag(eps)
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9
+    drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 1e-3
+
+
+def test_walker_depth_overflow_mopup(monkeypatch):
+    # Lanes whose subtree exceeds MAX_REL_DEPTH park with the _OVF flag
+    # and their pending (i, d) set must be finished by the bag. Shrink the
+    # cap to force the path. seg_iters differs from other tests so the
+    # jitted _run_cycles cache cannot reuse a kernel traced with the
+    # original constant.
+    import ppls_tpu.parallel.walker as W
+    monkeypatch.setattr(W, "MAX_REL_DEPTH", 4)
+    eps = 1e-7
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps,
+                                capacity=1 << 16, lanes=256,
+                                roots_per_lane=1, seg_iters=33,
+                                max_cycles=256)
+    b = _bag(eps)
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9
+    # With the cap biting on every subtree, pending nodes are re-derived
+    # from (i, d) in f64 (a + i*w*2^-d) rather than by repeated midpoint
+    # bisection; the coordinate rounding differences flip borderline split
+    # decisions far more often than in normal operation.
+    drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 0.05
+
+
+def test_walker_deterministic():
+    w1 = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-6, **KW)
+    w2 = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-6, **KW)
+    assert np.array_equal(w1.areas, w2.areas)
+    assert w1.metrics.tasks == w2.metrics.tasks
+
+
+def test_walker_rejects_bad_lanes():
+    with pytest.raises(ValueError, match="multiple of 128"):
+        integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-6, lanes=100)
