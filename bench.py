@@ -3,14 +3,23 @@
 Workload: the oscillatory family config — M independent integrals of
 sin(theta/x) on [1e-4, 1] at eps=1e-10 (BASELINE.json configs #2+#3
 combined: deep adaptive splitting, batched integrand family) — run
-end-to-end on the TPU bag engine, against the sequential C baseline
-(``ppls_tpu/backends/csrc/aquad_seq.c``, the "MPI/CPU" denominator; it is
-the reference architecture's single-process throughput on this host's
-modern CPU, a far harder baseline than the reference's 2010 Core 2 Duo).
+end-to-end on the Pallas subtree-walker engine, against the sequential C
+baseline (``ppls_tpu/backends/csrc/aquad_seq.c``, the "MPI/CPU"
+denominator; it is the reference architecture's single-process
+throughput on this host's modern CPU, a far harder baseline than the
+reference's 2010 Core 2 Duo).
 
-Correctness gate: TPU areas must match the C baseline areas (identical
-trapezoid rule + split semantics) to 1e-9 absolute before any number is
-reported.
+The metric counts SUBINTERVALS (adaptive tasks) per second on both
+sides — the unit of work the reference farmer dispatches
+(``aquadPartA.c:159``). Integrand-evaluation counts are reported
+alongside: the C baseline spends 3 evals per subinterval; the walker's
+DFS endpoint caching amortizes to ~1.5 (part of the win, labeled).
+
+Correctness gates, in order:
+1. finiteness (the engine raises on NaN/inf — asserted end-to-end),
+2. areas vs the C baseline to 1e-9 absolute (walker ds arithmetic vs
+   real f64 on the CPU: measures the true cross-implementation error),
+3. achieved abs error vs the mpmath closed form (north-star pair).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
@@ -33,56 +42,67 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def fail(msg):
+    print(json.dumps({"metric": "subintervals evaluated/sec/chip",
+                      "value": 0.0, "unit": "subintervals/s/chip",
+                      "vs_baseline": 0.0, "error": msg}))
+    return 1
+
+
 def run_cpu_baseline(theta):
     """Sequential C reference on a sample of the family; returns
-    (evals_per_sec, {scale: area})."""
+    (tasks_per_sec, evals_per_sec, {scale: area})."""
     from ppls_tpu.backends.mpi_backend import build_seq, run_seq_family
 
     if build_seq() is None:
-        return None, {}
+        return None, None, {}
+    total_tasks = 0
     total_evals = 0
     total_time = 0.0
     areas = {}
     for s in theta[:: max(len(theta) // CPU_SAMPLE, 1)]:
         d = run_seq_family("sin_recip_scaled", float(s), *BOUNDS, EPS)
+        total_tasks += d["tasks"]
         total_evals += d["evals"]
         total_time += d["wall_time_s"]
         areas[float(s)] = d["area"]
-    return total_evals / total_time, areas
+    return total_tasks / total_time, total_evals / total_time, areas
 
 
 def main():
     theta = 1.0 + np.arange(M) / M
 
     log(f"[bench] C baseline: {CPU_SAMPLE} of {M} scales at eps={EPS} ...")
-    cpu_rate, cpu_areas = run_cpu_baseline(theta)
+    cpu_rate, cpu_evals_rate, cpu_areas = run_cpu_baseline(theta)
     if cpu_rate:
-        log(f"[bench] C seq: {cpu_rate/1e6:.1f} M evals/s")
+        log(f"[bench] C seq: {cpu_rate/1e6:.1f} M subintervals/s "
+            f"({cpu_evals_rate/1e6:.1f} M evals/s)")
 
-    from ppls_tpu.models.integrands import get_family
-    from ppls_tpu.parallel.bag_engine import integrate_family
+    from ppls_tpu.models.integrands import family_exact, get_family, \
+        get_family_ds
+    from ppls_tpu.parallel.walker import integrate_family_walker
 
     f_theta = get_family("sin_recip_scaled")
-    # chunk 2^15 measured fastest across {2^13..2^17} on v5e (tools/profile_bag.py)
-    kw = dict(chunk=1 << 15, capacity=1 << 23)
+    f_ds = get_family_ds("sin_recip_scaled")
+    # seg_iters=32 / roots_per_lane=12 / min_active_frac=0.1 measured
+    # fastest across the round-3 sweep on v5e (392 M subintervals/s).
+    kw = dict(capacity=1 << 23)
 
     log("[bench] TPU warmup/compile ...")
     try:
-        res = integrate_family(f_theta, theta, BOUNDS, EPS, **kw)
-    except FloatingPointError as e:
-        # The engine raises on non-finite areas; keep the one-JSON-line
-        # contract so the driver records the failure instead of a traceback.
-        print(json.dumps({"metric": "subintervals evaluated/sec/chip",
-                          "value": 0.0, "unit": "evals/s/chip",
-                          "vs_baseline": 0.0, "error": str(e)}))
-        return 1
+        res = integrate_family_walker(f_theta, f_ds, theta, BOUNDS, EPS,
+                                      **kw)
+    except (FloatingPointError, RuntimeError) as e:
+        # The engine raises on non-finite areas / overflow; keep the
+        # one-JSON-line contract so the driver records the failure
+        # instead of a traceback.
+        return fail(str(e))
 
-    # Correctness gate: identical rule + split semantics => areas match the
-    # C baseline to summation-order noise. The gate is NaN-PROOF: the engine
-    # raised above on any non-finite area (a NaN slipping into Python's
-    # max() silently keeps the old value — exactly how the round-2 all-NaN
-    # run recorded a perfect 0.00e+00 gate), and the pass condition is
-    # inverted (`not (worst <= tol)`) so a NaN residual fails.
+    # Gate 2: areas vs the C baseline. NaN-PROOF: the engine raised above
+    # on any non-finite area (a NaN slipping into Python's max() silently
+    # keeps the old value — exactly how the round-2 all-NaN run recorded a
+    # perfect 0.00e+00 gate), and the pass condition is inverted
+    # (`not (worst <= tol)`) so a NaN residual fails.
     worst = 0.0
     gated = 0
     for i, s in enumerate(theta):
@@ -90,46 +110,55 @@ def main():
             worst = max(worst, abs(res.areas[i] - cpu_areas[float(s)]))
             gated += 1
     if cpu_areas and not (worst <= 1e-9):
-        print(json.dumps({"metric": "subintervals evaluated/sec/chip",
-                          "value": 0.0, "unit": "evals/s/chip",
-                          "vs_baseline": 0.0,
-                          "error": f"area mismatch vs C baseline: {worst:.3e}"}))
-        return 1
+        return fail(f"area mismatch vs C baseline: {worst:.3e}")
     log(f"[bench] correctness: max |area_tpu - area_cpu| = {worst:.2e} "
-        f"over {gated} gated scales")
+        f"over {gated} gated scales (walker ds vs CPU f64)")
 
     # North-star metric pair (BASELINE.json): throughput AND achieved abs
     # error @ eps. Exact values from the host-side mpmath closed form
-    # (x·sin(θ/x) − θ·Ci(θ/x)), evaluated for the full family.
-    from ppls_tpu.models.integrands import family_exact
+    # (x*sin(t/x) - t*Ci(t/x)), evaluated for the full family.
     exact = family_exact("sin_recip_scaled", *BOUNDS, theta)
     abs_err = float(np.max(np.abs(res.areas - np.asarray(exact))))
+    # Gate 3: eps is a per-interval tolerance so global error accumulates
+    # over leaves; measured 2.7e-5 on this workload. 1e-3 catches any
+    # gross precision regression (and runs even without the C toolchain).
+    if not (abs_err <= 1e-3):
+        return fail(f"achieved abs error vs exact: {abs_err:.3e}")
     log(f"[bench] achieved abs error vs exact (mpmath, all {M} scales): "
         f"max = {abs_err:.3e}")
 
     log(f"[bench] timing {REPEATS} runs ...")
     t0 = time.perf_counter()
+    tasks = 0
     evals = 0
     for _ in range(REPEATS):
-        r = integrate_family(f_theta, theta, BOUNDS, EPS, **kw)
+        r = integrate_family_walker(f_theta, f_ds, theta, BOUNDS, EPS, **kw)
+        tasks += r.metrics.tasks
         evals += r.metrics.integrand_evals
     wall = time.perf_counter() - t0
 
-    value = evals / wall  # one chip
+    value = tasks / wall  # one chip
     vs_baseline = value / cpu_rate if cpu_rate else 0.0
-    log(f"[bench] TPU: {value/1e6:.1f} M evals/s/chip "
-        f"({r.metrics.tasks} tasks/run, lane eff "
-        f"{r.lane_efficiency:.2f}) -> {vs_baseline:.1f}x CPU baseline")
+    log(f"[bench] TPU walker: {value/1e6:.1f} M subintervals/s/chip "
+        f"({r.metrics.tasks} tasks/run, walker fraction "
+        f"{r.walker_fraction:.3f}, lane eff {r.lane_efficiency:.2f}) "
+        f"-> {vs_baseline:.1f}x CPU baseline")
 
     out = {
         "metric": "subintervals evaluated/sec/chip",
         "value": round(value, 1),
-        "unit": "evals/s/chip",
+        "unit": "subintervals/s/chip",
         "vs_baseline": round(vs_baseline, 3),
         "abs_error": abs_err,
         "eps": EPS,
+        "integrand_evals_per_sec": round(evals / wall, 1),
+        "evals_per_task_tpu": round(evals / tasks, 3),
+        "engine": "walker",
+        "walker_fraction": round(r.walker_fraction, 4),
     }
-    if not cpu_areas:
+    if cpu_rate:
+        out["evals_per_task_cpu"] = round(cpu_evals_rate / cpu_rate, 3)
+    else:
         # No C toolchain -> the area gate could not run; say so explicitly
         # instead of printing a silently-ungated number (ADVICE r1).
         out["ungated"] = True

@@ -229,8 +229,17 @@ DS_FAMILIES: Dict[str, Callable] = {}
 
 
 def register_family_ds(name: str, f_ds: Callable) -> Callable:
-    """Register the ds-arithmetic twin of a family: f_ds(x_ds, theta_ds)
-    with (hi, lo) f32 pairs, usable inside Pallas TPU kernels."""
+    """Register the ds-arithmetic twin of a family:
+    ``f_ds(x_ds, theta_ds, dsm=<ds module>)`` with (hi, lo) f32 pairs.
+
+    ``dsm`` selects the arithmetic implementation: the default
+    ``ops.ds_kernel`` (fence-free — Pallas kernel interiors ONLY) or
+    ``ops.ds`` (fenced — required at XLA level, where the algebraic
+    simplifier would otherwise destroy the error-free transforms and
+    silently degrade results to f32 accuracy; both modules share one
+    API). The walker kernel uses the default; its refill path passes
+    the fenced module.
+    """
     DS_FAMILIES[name] = f_ds
     return f_ds
 
@@ -245,14 +254,16 @@ def get_family_ds(name: str) -> Callable:
         ) from None
 
 
-def _sin_recip_scaled_ds(x, th):
-    from ppls_tpu.ops import ds_kernel as dsk
-    return dsk.ds_sin(dsk.ds_div(th, x))
+def _sin_recip_scaled_ds(x, th, dsm=None):
+    if dsm is None:
+        from ppls_tpu.ops import ds_kernel as dsm
+    return dsm.ds_sin(dsm.ds_div(th, x))
 
 
-def _sin_scaled_ds(x, th):
-    from ppls_tpu.ops import ds_kernel as dsk
-    return dsk.ds_sin(dsk.ds_mul(th, x))
+def _sin_scaled_ds(x, th, dsm=None):
+    if dsm is None:
+        from ppls_tpu.ops import ds_kernel as dsm
+    return dsm.ds_sin(dsm.ds_mul(th, x))
 
 
 register_family_ds("sin_recip_scaled", _sin_recip_scaled_ds)

@@ -53,7 +53,7 @@ import numpy as np
 from jax import lax
 
 from ppls_tpu.config import Rule
-from ppls_tpu.ops.reduction import exact_segment_sum
+from ppls_tpu.ops.reduction import segment_sum_auto
 from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
 from ppls_tpu.utils.metrics import RunMetrics
 
@@ -105,22 +105,10 @@ def bag_step(state: BagState, f_theta: Callable, eps: float, rule: Rule,
     accept = jnp.logical_and(active, jnp.logical_not(split))
 
     # Per-family leaf accumulation (see module docstring for the measured
-    # cost of the alternatives).
+    # cost of the alternatives; dispatch in ops/reduction.py).
     leaf = jnp.where(accept, value, 0.0)
     m = state.acc.shape[0]
-    if m == 1:
-        acc = state.acc + jnp.sum(leaf)[None]
-    elif m <= 256:
-        # Exact f64 broadcast-mask reduction, O(m * chunk) — cheapest
-        # option for small family counts (~27 us at m=128, chunk=2^15).
-        fam_ids = jnp.arange(m, dtype=jnp.int32)
-        seg = jnp.where(fam[None, :] == fam_ids[:, None],
-                        leaf[None, :], 0.0).sum(axis=1)
-        acc = state.acc + seg
-    else:
-        # Exact digit-plane MXU reduction (ops/reduction.py): ~75 us at
-        # m=1024 vs ~216 us for the f64 mask, with zero reduction error.
-        acc = state.acc + exact_segment_sum(fam, leaf, m, chunk)
+    acc = state.acc + segment_sum_auto(fam, leaf, m, chunk)
 
     max_depth = jnp.maximum(state.max_depth,
                             jnp.max(jnp.where(active, depth, 0)))

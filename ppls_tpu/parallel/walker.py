@@ -44,11 +44,17 @@ Orchestration (all device-resident, 3 jit programs):
    roots. This also catches (never-observed) depth-30 overflows.
 
 Precision: the walker's split test and leaf values are ds (~1e-14 rel),
-not bit-identical to the C/f64 engines — borderline split decisions can
-flip and per-leaf ds rounding accumulates, so task counts may differ by
-well under 0.1% and areas by ~1e-9 absolute on the oscillatory
-workloads (measured; tests/test_walker.py encodes the contract). The
-f64 bag engine remains the parity path.
+not bit-identical to the C/f64 engines. Where the trapezoid error
+estimate lands within ds noise of eps, borderline split decisions flip:
+area divergence from the f64 engines is O(flips * eps) with UNCHANGED
+quality versus the exact integral (measured: |walker - exact| ~=
+|bag - exact| in every flip-heavy configuration). At the bench's
+eps=1e-10 the threshold crossing sits far below the noise floor, so
+decisions and areas agree essentially exactly (|w - b| ~ 1e-14, zero
+task drift, real-TPU lane test); at eps=1e-7..1e-8 on deep-oscillatory
+domains expect ~0.1-5% task drift and ~100x-eps-level area divergence
+(tests/test_walker.py encodes the contract). The f64 bag engine remains
+the parity path.
 """
 
 from __future__ import annotations
@@ -67,13 +73,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ppls_tpu.config import Rule
 from ppls_tpu.ops import ds_kernel as dsk
-from ppls_tpu.ops.reduction import exact_segment_sum
+from ppls_tpu.ops.reduction import segment_sum_auto
 from ppls_tpu.parallel.bag_engine import (
     ACCEPT_BIT,
     DEPTH_BITS,
     DEPTH_MASK,
     BagState,
     _run_bag,
+    bag_step,
     initial_bag,
 )
 from ppls_tpu.utils.metrics import RunMetrics
@@ -257,10 +264,42 @@ class _WalkCarry(NamedTuple):
     segs: jnp.ndarray       # int32 segments executed
 
 
-def _bank_and_refill(c: _WalkCarry, f64_f: Callable, m: int,
+def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
+           capacity: int, target: int) -> BagState:
+    """BFS-refine the bag until it holds >= target roots, it empties, OR
+    the frontier passes its peak (count shrinks round-over-round).
+
+    The peak-stop is what makes walker engagement robust: a fixed
+    stop_count larger than the workload's peak BFS frontier would
+    otherwise let breeding run the whole problem to completion in f64
+    and the walker would never see a single root (measured: the round-2
+    engine silently degraded to a pure bag run whenever
+    roots_per_lane * lanes exceeded the peak frontier)."""
+    def cond(carry):
+        s, prev = carry
+        ok = jnp.logical_and(s.count > 0, jnp.logical_not(s.overflow))
+        ok = jnp.logical_and(ok, s.iters < (1 << 20))
+        ok = jnp.logical_and(ok, s.count < target)
+        return jnp.logical_and(ok, s.count >= prev)
+
+    def body(carry):
+        s, _ = carry
+        return (bag_step(s, f_theta, eps, Rule.TRAPEZOID, chunk, capacity),
+                s.count)
+
+    out, _ = lax.while_loop(cond, body, (bag, jnp.int32(0)))
+    return out
+
+
+def _bank_and_refill(c: _WalkCarry, f_ds: Callable, m: int,
                      lanes: int) -> _WalkCarry:
     """Credit finished lanes' accumulators to their families and hand
-    them fresh roots (one monotone gather from the root queue)."""
+    them fresh roots (one monotone gather from the root queue).
+
+    Root-endpoint integrand values are computed in ds (the kernel's own
+    working precision), not emulated f64: the f64 transcendental on
+    2 x lanes points cost more than the whole segment kernel (measured
+    ~2.6 ms vs 2.1 ms at lanes=2^15 on v5e)."""
     s = c.lanes
     parked = ((s.flags & _PARKED) != 0).reshape(-1)
     has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
@@ -272,7 +311,7 @@ def _bank_and_refill(c: _WalkCarry, f64_f: Callable, m: int,
         s.acc_h.astype(jnp.float64).reshape(-1)
         + s.acc_l.astype(jnp.float64).reshape(-1),
         0.0)
-    acc = c.acc + exact_segment_sum(s.fam.reshape(-1), contrib, m, lanes)
+    acc = c.acc + segment_sum_auto(s.fam.reshape(-1), contrib, m, lanes)
 
     rows = lanes // 128
     # refill: parked lanes take queue entries in lane order — EXCEPT
@@ -282,14 +321,37 @@ def _bank_and_refill(c: _WalkCarry, f64_f: Callable, m: int,
     rank = jnp.cumsum(refillable, dtype=jnp.int32) - 1
     avail = c.bag.count - c.cursor
     take = jnp.logical_and(refillable, rank < avail)
-    idx = jnp.clip(c.cursor + rank, 0, c.bag.count - 1)
 
-    rl = c.bag.bag_l[idx]
-    rr = c.bag.bag_r[idx]
-    rth = c.bag.bag_th[idx]
-    rmeta = c.bag.bag_meta[idx]
-    f_l = f64_f(rl, rth)
-    f_r = f64_f(rr, rth)
+    # Consume from the TOP of the bred bag (cursor counts consumed
+    # roots), so the unconsumed remainder [0, count - cursor) remains a
+    # valid bag prefix that _expand_pending can reuse in place — and the
+    # taken roots are a CONTIGUOUS window, fetched with one dynamic
+    # slice and routed to the scattered parked lanes by two small sorts.
+    # The obvious per-lane gather (bag[count-1-cursor-rank]) costs
+    # ~4.8 ms per refill at lanes=2^15 on v5e (computed-index gathers
+    # from HBM serialize); slice + route measures ~50x cheaper.
+    top = c.bag.count - c.cursor
+    start = jnp.maximum(top - lanes, 0)
+    span_len = top - start           # = min(lanes, top)
+
+    def top_window(col):
+        # w[p] = col[top - 1 - p] for p < span_len (top-of-bag, reversed),
+        # realized as contiguous slices only: reverse the slice, then
+        # rotate by (lanes - span_len) via a doubled dynamic slice.
+        sl_ = lax.dynamic_slice(col, (start,), (lanes,))[::-1]
+        dbl = jnp.concatenate([sl_, sl_])
+        return lax.dynamic_slice(dbl, (lanes - span_len,), (lanes,))
+
+    lane_ids = jnp.arange(lanes, dtype=jnp.int32)
+    take_key = jnp.where(take, rank, jnp.int32(lanes))
+    _, lane_perm = lax.sort((take_key, lane_ids), dimension=0,
+                            is_stable=True, num_keys=1)
+    # position p (root p-from-top) belongs to lane lane_perm[p]; sorting
+    # by lane_perm restores lane order with the root payload alongside.
+    _, rl, rr, rth, rmeta = lax.sort(
+        (lane_perm, top_window(c.bag.bag_l), top_window(c.bag.bag_r),
+         top_window(c.bag.bag_th), top_window(c.bag.bag_meta)),
+        dimension=0, is_stable=True, num_keys=1)
 
     def to_ds(x):
         hi = x.astype(jnp.float32)
@@ -299,8 +361,15 @@ def _bank_and_refill(c: _WalkCarry, f64_f: Callable, m: int,
     a_h, a_l = to_ds(rl)
     w_h, w_l = to_ds(rr - rl)
     th_h, th_l = to_ds(rth)
-    flh, fll = to_ds(f_l)
-    frh, frl = to_ds(f_r)
+    # This runs at XLA level, so the FENCED ds module is mandatory: the
+    # fence-free kernel twin degrades to f32 accuracy under XLA's
+    # algebraic simplifier (measured 3.8e-8 per endpoint -> 1.3e-5 area
+    # error on the oscillatory workload when this used dsm=ds_kernel).
+    from ppls_tpu.ops import ds as ds_xla
+    flh, fll = f_ds((a_h, a_l), (th_h, th_l), dsm=ds_xla)
+    flh, fll = flh.reshape(rows, 128), fll.reshape(rows, 128)
+    frh, frl = f_ds(to_ds(rr), (th_h, th_l), dsm=ds_xla)
+    frh, frl = frh.reshape(rows, 128), frl.reshape(rows, 128)
     fam_new = (rmeta >> DEPTH_BITS).reshape(rows, 128)
     based_new = (rmeta & DEPTH_MASK).reshape(rows, 128)
 
@@ -320,7 +389,7 @@ def _bank_and_refill(c: _WalkCarry, f64_f: Callable, m: int,
     flags = jnp.where(retire.reshape(rows, 128),
                       jnp.int32(_PARKED | _NO_ROOT), flags)
 
-    lanes = WalkState(
+    new_lanes = WalkState(
         a_h=pick(a_h, s.a_h), a_l=pick(a_l, s.a_l),
         w_h=pick(w_h, s.w_h), w_l=pick(w_l, s.w_l),
         th_h=pick(th_h, s.th_h), th_l=pick(th_l, s.th_l),
@@ -334,7 +403,7 @@ def _bank_and_refill(c: _WalkCarry, f64_f: Callable, m: int,
         tasks=s.tasks, splits=s.splits, maxd=s.maxd,
     )
     n_taken = jnp.sum(take, dtype=jnp.int32)
-    return _WalkCarry(lanes=lanes, bag=c.bag,
+    return _WalkCarry(lanes=new_lanes, bag=c.bag,
                       cursor=c.cursor + n_taken, acc=acc,
                       segs=c.segs + 1)
 
@@ -343,7 +412,7 @@ def _idle_lanes(s: WalkState):
     return jnp.sum((s.flags & _PARKED) != 0, dtype=jnp.int32)
 
 
-def _run_walk(bag: BagState, *, f_ds: Callable, f64_f: Callable, eps: float,
+def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
               m: int, seg_iters: int, max_segments: int,
               min_active_frac: float, interpret: bool,
               lanes: int) -> _WalkCarry:
@@ -365,7 +434,7 @@ def _run_walk(bag: BagState, *, f_ds: Callable, f64_f: Callable, eps: float,
     # so `segs` counts executed kernel segments only.
     carry = _WalkCarry(lanes=lane0, bag=bag, cursor=jnp.int32(0),
                        acc=jnp.zeros(m, jnp.float64), segs=jnp.int32(-1))
-    carry = _bank_and_refill(carry, f64_f, m, lanes)   # initial seeding
+    carry = _bank_and_refill(carry, f_ds, m, lanes)   # initial seeding
     min_active = jnp.int32(int(lanes * min_active_frac))
 
     def cond(c: _WalkCarry):
@@ -380,7 +449,7 @@ def _run_walk(bag: BagState, *, f_ds: Callable, f64_f: Callable, eps: float,
 
     def body(c: _WalkCarry):
         new_lanes = run_segment(c.lanes)
-        return _bank_and_refill(c._replace(lanes=new_lanes), f64_f, m, lanes)
+        return _bank_and_refill(c._replace(lanes=new_lanes), f_ds, m, lanes)
 
     out = lax.while_loop(cond, body, carry)
     # Final credit: lanes still mid-walk (suspended) hold accepted-leaf
@@ -395,17 +464,26 @@ def _run_walk(bag: BagState, *, f_ds: Callable, f64_f: Callable, eps: float,
         s.acc_h.astype(jnp.float64).reshape(-1)
         + s.acc_l.astype(jnp.float64).reshape(-1),
         0.0)
-    acc = out.acc + exact_segment_sum(s.fam.reshape(-1), contrib, m, lanes)
+    acc = out.acc + segment_sum_auto(s.fam.reshape(-1), contrib, m, lanes)
     return out._replace(acc=acc)
 
 
-def _expand_pending(c: _WalkCarry, capacity: int, chunk: int,
-                    m: int) -> BagState:
-    """Convert un-walked state back into explicit bag tasks:
+def _expand_pending(c: _WalkCarry, capacity: int, m: int) -> BagState:
+    """Convert un-walked state back into explicit bag tasks.
 
-    * roots never consumed: queue entries [cursor, count)
-    * suspended lanes: the current node (i, d) plus the pending right
-      sibling (i >> k) + 1 at depth d - k for every zero bit k < d.
+    Roots were consumed from the TOP of the bred bag (_bank_and_refill),
+    so the never-consumed remainder [0, count - cursor) is already a
+    valid bag prefix and is reused in place. Only the suspended lanes'
+    pending sets — the current node (i, d) plus the right sibling
+    (i >> k) + 1 at depth d - k for every zero bit k < d — go through a
+    sort-compaction, a static (MAX_REL_DEPTH + 1) * lanes rows, and are
+    pushed on top of the remainder. (The previous design concatenated
+    the whole bag store into the sort: ~9 M rows for ~1 M of payload at
+    the flagship config — the sort dominated the cycle cost.)
+
+    The caller guarantees (MAX_REL_DEPTH + 1) * lanes <= 2 * breed_chunk
+    (the bag's slack region), so the push window never clamps even when
+    the remainder fills the whole capacity.
     """
     s = c.lanes
     has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
@@ -451,44 +529,32 @@ def _expand_pending(c: _WalkCarry, capacity: int, chunk: int,
               + jnp.minimum(based[None, :] + node_d, DEPTH_MASK))
     th_n = jnp.broadcast_to(th[None, :], ln.shape)
 
-    # plus the unconsumed roots
-    qvalid = jnp.arange(c.bag.bag_l.shape[0], dtype=jnp.int32)
-    qvalid = jnp.logical_and(qvalid >= c.cursor, qvalid < c.bag.count)
-
+    # compact the pending grid to a dense prefix (the engine's standard
+    # sort-compaction) and push it on top of the unconsumed remainder.
     flat = lambda x: x.reshape(-1)
-    all_l = jnp.concatenate([flat(ln), c.bag.bag_l])
-    all_r = jnp.concatenate([flat(rn), c.bag.bag_r])
-    all_th = jnp.concatenate([flat(th_n), c.bag.bag_th])
-    all_meta = jnp.concatenate([flat(meta_n), c.bag.bag_meta])
-    all_valid = jnp.concatenate([flat(valid), qvalid])
-
-    # compact valid tasks to a dense prefix (the engine's standard
-    # sort-compaction), then lay them into a fresh bag.
-    key = jnp.logical_not(all_valid).astype(jnp.int32)
+    key = jnp.logical_not(flat(valid)).astype(jnp.int32)
     key, sl, sr, sth, smeta = lax.sort(
-        (key, all_l, all_r, all_th, all_meta), dimension=0, is_stable=True,
-        num_keys=1)
-    n_tasks = jnp.sum(all_valid, dtype=jnp.int32)
+        (key, flat(ln), flat(rn), flat(th_n), flat(meta_n)),
+        dimension=0, is_stable=True, num_keys=1)
+    n_pend = jnp.sum(valid, dtype=jnp.int32)
+    remain = c.bag.count - c.cursor
 
-    store = capacity + 2 * chunk
+    # Rows beyond n_pend land past the new count (dead slots) but inside
+    # later pop windows; they must hold benign in-domain data (see
+    # initial_bag's dead-slot note). Fill with the first compacted row.
+    # (If n_pend == 0 the fill is garbage but those rows stay dead.)
     ns = sl.shape[0]
-    # Dead slots (beyond n_tasks) must hold benign in-domain data — they
-    # are still evaluated under the mask (see initial_bag's dead-slot
-    # note). Overwrite them with the first valid task's values. (If
-    # n_tasks == 0 the fill is garbage but the bag loop never runs.)
-    live = jnp.arange(ns, dtype=jnp.int32) < n_tasks
+    live_row = jnp.arange(ns, dtype=jnp.int32) < n_pend
+    sl = jnp.where(live_row, sl, sl[0])
+    sr = jnp.where(live_row, sr, sr[0])
+    sth = jnp.where(live_row, sth, sth[0])
+    smeta = jnp.where(live_row, smeta, jnp.int32(0))
 
-    def fit(x, fill):
-        x = jnp.where(live, x, fill)
-        if ns >= store:
-            return x[:store]
-        return jnp.concatenate(
-            [x, jnp.broadcast_to(fill, (store - ns,)).astype(x.dtype)])
-
-    bag_l = fit(sl, sl[0])
-    bag_r = fit(sr, sr[0])
-    bag_th = fit(sth, sth[0])
-    bag_meta = fit(smeta, jnp.int32(0))
+    bag_l = lax.dynamic_update_slice(c.bag.bag_l, sl, (remain,))
+    bag_r = lax.dynamic_update_slice(c.bag.bag_r, sr, (remain,))
+    bag_th = lax.dynamic_update_slice(c.bag.bag_th, sth, (remain,))
+    bag_meta = lax.dynamic_update_slice(c.bag.bag_meta, smeta, (remain,))
+    n_tasks = remain + n_pend
 
     return BagState(
         bag_l=bag_l, bag_r=bag_r, bag_th=bag_th, bag_meta=bag_meta,
@@ -549,25 +615,36 @@ def _run_cycles(bag: BagState, *, f_theta: Callable, f_ds: Callable,
             jnp.logical_not(c.overflow))
 
     def body(c: _CycleCarry):
-        bred = _run_bag(c.bag, f_theta=f_theta, eps=eps,
-                        rule=Rule.TRAPEZOID, chunk=breed_chunk,
-                        capacity=capacity, max_iters=1 << 20,
-                        stop_count=target)
-        walk = _run_walk(bred, f_ds=f_ds, f64_f=f_theta, eps=eps, m=m,
+        # Two-phase breed: the BFS sort-compaction costs O(chunk log chunk)
+        # per round regardless of the live frontier, so grow the tiny
+        # early frontier with a narrow chunk before switching to the
+        # full-width one (saves ~8 full-width sorts per cycle).
+        small_chunk = min(breed_chunk, 1 << 14)
+        bred = _breed(c.bag, f_theta=f_theta, eps=eps, chunk=small_chunk,
+                      capacity=capacity, target=min(small_chunk // 2, target))
+        bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=breed_chunk,
+                      capacity=capacity, target=target)
+        walk = _run_walk(bred, f_ds=f_ds, eps=eps, m=m,
                          seg_iters=seg_iters, max_segments=max_segments,
                          min_active_frac=min_active_frac,
                          interpret=interpret, lanes=lanes)
-        bag2 = _expand_pending(walk, capacity, breed_chunk, m)
+        bag2 = _expand_pending(walk, capacity, m)
 
-        # small remainders: straight to the f64 engine (guarantees
-        # progress when count < the walker occupancy threshold).
+        # Drain in f64 ONLY below the walker's own engagement threshold
+        # (walk's cond would refuse to run there, so the cycle loop could
+        # not make progress). Anything larger goes back around: re-bred
+        # into fresh roots and re-walked. A `count < lanes` gate here
+        # measured fraction 0.31 on the flagship workload — a small
+        # *count* of suspended deep-tail nodes carries most of the
+        # remaining *work* (115 M of 166 M tasks drained in f64).
         def drain(b: BagState):
             return _run_bag(b, f_theta=f_theta, eps=eps,
                             rule=Rule.TRAPEZOID, chunk=breed_chunk,
                             capacity=capacity, max_iters=1 << 20,
                             stop_count=None)
 
-        bag3 = lax.cond(bag2.count < lanes, drain, lambda b: b, bag2)
+        min_active = max(1, int(lanes * min_active_frac))
+        bag3 = lax.cond(bag2.count < min_active, drain, lambda b: b, bag2)
 
         wt = jnp.sum(walk.lanes.tasks.astype(jnp.int64))
         ws = jnp.sum(walk.lanes.splits.astype(jnp.int64))
@@ -625,9 +702,9 @@ def integrate_family_walker(
         capacity: int = 1 << 23,
         lanes: int = DEFAULT_LANES,
         roots_per_lane: int = 12,
-        seg_iters: int = 512,
-        max_segments: int = 1 << 16,
-        min_active_frac: float = 0.25,
+        seg_iters: int = 32,
+        max_segments: int = 1 << 18,
+        min_active_frac: float = 0.1,
         max_cycles: int = 64,
         interpret: Optional[bool] = None) -> WalkerResult:
     """Flagship integration: cycles of breed (f64 bag, BFS) -> walk
@@ -654,9 +731,16 @@ def integrate_family_walker(
     # the walker's subtree sizes.
     target = min(roots_per_lane * lanes, capacity // 2)
     breed_chunk = max(1 << int(target - 1).bit_length(), chunk)
+    # The bag store needs slack for BOTH bag_step's push windows
+    # (2 * breed_chunk) and _expand_pending's static pending-grid window
+    # ((MAX_REL_DEPTH + 1) * lanes rows pushed on top of a remainder that
+    # can fill the whole capacity) — otherwise the dynamic_update_slice
+    # would clamp its start and corrupt live entries. Slack is memory
+    # only; bag_step never pops past `capacity`.
+    slack_chunk = max(breed_chunk, -(-(MAX_REL_DEPTH + 1) * lanes // 2))
 
     t0 = time.perf_counter()
-    state = initial_bag(bounds, capacity, m, breed_chunk, theta=theta)
+    state = initial_bag(bounds, capacity, m, slack_chunk, theta=theta)
     out = _run_cycles(state, f_theta=f_theta, f_ds=f_ds, eps=float(eps),
                       m=m, seg_iters=int(seg_iters),
                       max_segments=int(max_segments),
@@ -677,6 +761,12 @@ def integrate_family_walker(
         raise RuntimeError(
             f"walker did not converge in {int(cycles)} cycles "
             f"({int(left)} tasks left); raise max_cycles")
+    acc = np.asarray(acc)
+    if not np.all(np.isfinite(acc)):
+        bad = int(np.sum(~np.isfinite(acc)))
+        raise FloatingPointError(
+            f"walker produced {bad}/{acc.size} non-finite areas "
+            f"(NaN/inf) — refusing to report garbage")
 
     tasks = int(tasks)
     wtasks = int(wtasks)
@@ -687,11 +777,16 @@ def integrate_family_walker(
         leaves=tasks - int(splits),
         rounds=int(rounds) + segs,
         max_depth=int(maxd),
-        # the walker evaluates 1 new point per TEST step, 1 per ADVANCE
-        # reload (one per accepted non-final leaf), and 2 per consumed
-        # root; the f64 bag phases evaluate 3 per task.
+        # The walker evaluates 1 new point per TEST step (= wtasks), 1
+        # per ADVANCE reload — one per accepted leaf EXCEPT each root's
+        # final leaf, which parks instead of reloading (= leaves - roots)
+        # — and 2 refill endpoints per consumed root: total
+        # wtasks + (wtasks - wsplits - roots) + 2*roots. Suspended roots
+        # never reach their final leaf, so this overstates by at most
+        # one eval per lane suspended at phase end (~1e-4 relative).
+        # The f64 bag phases evaluate 3 per task.
         integrand_evals=3 * int(btasks)
-        + 2 * wtasks - int(wsplits) + 2 * int(roots),
+        + 2 * wtasks - int(wsplits) + int(roots),
         wall_time_s=wall,
         n_chips=1,
         tasks_per_chip=[tasks],

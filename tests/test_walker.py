@@ -57,14 +57,22 @@ def test_walker_actually_walks():
 
 
 def test_walker_small_workload_falls_back():
-    # Shallow run: breeding satisfies the whole problem before the root
-    # target is reached — the walker must still return correct areas with
-    # fraction 0 (everything done by the exact f64 bag).
+    # Trivial run (huge eps): the seed tasks accept in the first breed
+    # round, the bag empties before any frontier peak, and the walker
+    # must return the exact f64 result with fraction 0.
+    eps = 10.0
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps, **KW)
+    b = _bag(eps)
+    assert np.max(np.abs(w.areas - b.areas)) < 1e-15
+    assert w.metrics.tasks == b.metrics.tasks
+    assert w.walker_fraction == 0.0
+
+    # Shallow-but-nontrivial run: breeding peak-stops early, the walker
+    # takes part, and areas agree within the ds contract.
     eps = 1e-3
     w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps, **KW)
     b = _bag(eps)
-    assert np.max(np.abs(w.areas - b.areas)) < 1e-12
-    assert w.metrics.tasks == b.metrics.tasks
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9
 
 
 def test_walker_mopup_via_forced_suspension():
