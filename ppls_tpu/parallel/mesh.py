@@ -8,9 +8,11 @@ coordinator chip (coordination is collectives, not a role).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh
 
 FRONTIER_AXIS = "d"
@@ -31,3 +33,49 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = FRONTIER_AXIS) -
             )
         devs = devs[:n_devices]
     return jax.make_mesh((len(devs),), (axis_name,), devices=devs)
+
+
+def strided_reshard(axis: str, cols: Sequence[jnp.ndarray],
+                    n_valid: jnp.ndarray, fills: Sequence,
+                    out_width: int) -> Tuple[tuple, jnp.ndarray,
+                                             jnp.ndarray]:
+    """Deal every chip's dense prefix round-robin across the mesh.
+
+    The demand-driven farmer dispatch (``aquadPartA.c:156-165``) at batch
+    granularity, shared by the sharded wavefront (``sharded.py``) and
+    sharded bag (``sharded_bag.py``) engines: all_gather each chip's
+    ``cols`` (dense prefixes of ``n_valid`` valid rows each), scatter
+    into one global dense buffer, and give chip d the strided rows
+    d, d + n_dev, d + 2*n_dev, ... — deterministic, and perfectly
+    balanced within one row.
+
+    Returns ``(out_cols, mine, total)``: per-chip (out_width,) columns
+    (invalid rows set to the matching ``fills`` value), the validity
+    mask of this chip's rows, and the replicated global row count
+    (callers derive overflow from it — a REPLICATED predicate, safe to
+    gate a collective while_loop; a per-chip flag would let chips exit
+    on different rounds and desynchronize the collectives).
+    """
+    n_dev = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    width = cols[0].shape[0]
+    counts = lax.all_gather(n_valid, axis)               # (n_dev,)
+    offsets = jnp.cumsum(counts) - counts
+    total = jnp.sum(counts)
+
+    local_pos = jnp.arange(width, dtype=jnp.int32)
+    glob_size = n_dev * width
+    valid = local_pos[None, :] < counts[:, None]
+    slot = jnp.where(valid, offsets[:, None] + local_pos[None, :],
+                     jnp.asarray(glob_size, jnp.int32))
+    flat_slot = slot.reshape(-1)
+    take = my + jnp.arange(out_width, dtype=jnp.int32) * n_dev
+    mine = take < total
+
+    outs = []
+    for col, fill in zip(cols, fills):
+        g = jnp.full(glob_size, fill, dtype=col.dtype)
+        g = g.at[flat_slot].set(lax.all_gather(col, axis).reshape(-1),
+                                mode="drop")
+        outs.append(jnp.where(mine, g[take], jnp.asarray(fill, col.dtype)))
+    return tuple(outs), mine, total

@@ -35,7 +35,7 @@ from ppls_tpu.models.integrands import get_integrand
 from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
 from ppls_tpu.ops.reduction import kahan_add
 from ppls_tpu.parallel.device_engine import compact_children
-from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh
+from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh, strided_reshard
 from ppls_tpu.utils.metrics import RunMetrics
 
 
@@ -58,7 +58,6 @@ def _shard_round(state: ShardState, f, eps: float, rule: Rule,
                  cap: int, axis: str, fill: float = 1.0) -> ShardState:
     """One sharded wavefront round. ``cap`` is capacity per chip."""
     n_dev = lax.axis_size(axis)
-    my = lax.axis_index(axis)
 
     # --- evaluate local shard (the worker step, aquadPartA.c:183-202) ---
     value, _err, split = eval_batch(state.l, state.r, f, eps, rule)
@@ -76,34 +75,14 @@ def _shard_round(state: ShardState, f, eps: float, rule: Rule,
         state.l, state.r, split, 2 * cap, fill)  # 2*cap slots: never drops
 
     # --- global rebalance: the demand-driven farmer dispatch recreated at
-    # batch granularity (SURVEY.md §7 "load balance across chips").
-    # all_gather every chip's dense children prefix + counts, compact the
-    # concatenation globally, then chip d takes the strided slice d::n_dev
-    # (perfect balance within one interval, deterministic order). ---
-    all_l = lax.all_gather(ch_l, axis)        # (n_dev, 2*cap)
-    all_r = lax.all_gather(ch_r, axis)
-    counts = lax.all_gather(n_children_local, axis)   # (n_dev,)
-    offsets = jnp.cumsum(counts) - counts             # exclusive prefix
-    total = jnp.sum(counts)
+    # batch granularity (SURVEY.md §7 "load balance across chips"); the
+    # all_gather + dense scatter + strided re-shard lives in
+    # mesh.strided_reshard (shared with the sharded bag engine). ---
+    (new_l, new_r), new_active, total = strided_reshard(
+        axis, (ch_l, ch_r), n_children_local, (fill, fill), cap)
 
-    # Scatter each chip's children into a global dense buffer of
-    # n_dev * 2*cap slots at offset[chip] + local position.
-    local_pos = jnp.arange(2 * cap, dtype=jnp.int32)
-    glob_size = n_dev * 2 * cap
-    valid = local_pos[None, :] < counts[:, None]
-    glob_slot = jnp.where(valid, offsets[:, None] + local_pos[None, :],
-                          jnp.asarray(glob_size, jnp.int32))
-    g_l = jnp.full(glob_size, fill, dtype=state.l.dtype)
-    g_r = jnp.full(glob_size, fill, dtype=state.r.dtype)
-    g_l = g_l.at[glob_slot.reshape(-1)].set(all_l.reshape(-1), mode="drop")
-    g_r = g_r.at[glob_slot.reshape(-1)].set(all_r.reshape(-1), mode="drop")
-
-    # Chip `my` takes global children my, my+n_dev, my+2*n_dev, ...
-    take = my + jnp.arange(cap, dtype=jnp.int32) * n_dev
-    new_l = g_l[take]
-    new_r = g_r[take]
-    new_active = take < total
-
+    # `total` is replicated, so this overflow predicate is too — safe in
+    # the collective while_loop cond.
     overflow = jnp.logical_or(state.overflow, total > n_dev * cap)
 
     return ShardState(

@@ -1,0 +1,310 @@
+"""Multi-chip family/bag engine: per-chip LIFO bags under shard_map.
+
+The flagship workload (BASELINE.json configs #2+#3) sharded over a
+``jax.sharding.Mesh``, per SURVEY.md §5's MPI-replacement table:
+
+* each chip owns a private chunked-LIFO bag (the farmer's bag,
+  ``aquadPartA.c:52-70``, one per chip instead of one globally);
+* every round each chip pops its own chunk, evaluates, and the round's
+  CHILDREN are rebalanced across the mesh — all_gather of the compacted
+  per-chip child lists, deterministic strided re-shard, push onto each
+  local bag. This is the demand-driven farmer dispatch
+  (``aquadPartA.c:156-165``) at chunk granularity: a chip whose
+  subdomain stopped refining automatically receives children bred by
+  busier chips, so spatially-clustered refinement (sin(1/x) near 0)
+  cannot starve the mesh;
+* per-family leaf areas accumulate into per-chip exact partials
+  (``ops.reduction.segment_sum_auto``) and reduce with ONE psum at the
+  end (``MPI_Reduce`` analog, cf. ``aquadPartA.c:149``);
+* termination is a psum of per-chip bag counts inside the loop
+  (``aquadPartA.c:166``'s bag-empty ∧ all-idle test, collectivized).
+
+Everything runs in one ``lax.while_loop`` under ``shard_map`` — zero
+host round-trips, collectives on ICI. Task totals are conserved exactly
+versus the single-chip engine (split decisions are pointwise f64,
+independent of placement); areas differ only by summation order
+(tested <= 1e-9 on the virtual 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ppls_tpu.config import Rule
+from ppls_tpu.models.integrands import FAMILIES
+from ppls_tpu.ops.reduction import segment_sum_auto
+from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
+from ppls_tpu.parallel.bag_engine import (
+    ACCEPT_BIT,
+    DEPTH_BITS,
+    DEPTH_MASK,
+    FamilyResult,
+    MAX_FAMILIES,
+)
+from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh, strided_reshard
+from ppls_tpu.utils.metrics import RunMetrics
+
+
+class _ShardBag(NamedTuple):
+    """Per-chip loop carry (local shard views inside shard_map)."""
+
+    bag_l: jnp.ndarray      # (store,) local bag columns
+    bag_r: jnp.ndarray
+    bag_th: jnp.ndarray
+    bag_meta: jnp.ndarray
+    count: jnp.ndarray      # local live-entry count
+    acc: jnp.ndarray        # (m,) per-chip exact partials
+    tasks: jnp.ndarray      # per-chip counters (the parity histogram,
+    splits: jnp.ndarray     #  cf. tasks_per_process, aquadPartA.c:162)
+    iters: jnp.ndarray
+    max_depth: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+def _shard_bag_round(s: _ShardBag, f_theta: Callable, eps: float,
+                     rule: Rule, chunk: int, capacity: int, m: int,
+                     axis: str, fill_l: float, fill_th: float) -> _ShardBag:
+    """One sharded bag round: local pop/eval + cross-chip child re-shard."""
+    # --- local pop + eval (identical semantics to bag_engine.bag_step) ---
+    n_take = jnp.minimum(s.count, chunk)
+    start = s.count - n_take
+    l = lax.dynamic_slice(s.bag_l, (start,), (chunk,))
+    r = lax.dynamic_slice(s.bag_r, (start,), (chunk,))
+    th = lax.dynamic_slice(s.bag_th, (start,), (chunk,))
+    meta = lax.dynamic_slice(s.bag_meta, (start,), (chunk,))
+    lane = jnp.arange(chunk, dtype=jnp.int32)
+    active = lane < n_take
+
+    fam = meta >> DEPTH_BITS
+    depth = meta & DEPTH_MASK
+    value, _err, split = eval_batch(l, r, lambda x: f_theta(x, th), eps, rule)
+    split = jnp.logical_and(split, active)
+    accept = jnp.logical_and(active, jnp.logical_not(split))
+
+    leaf = jnp.where(accept, value, 0.0)
+    acc = s.acc + segment_sum_auto(fam, leaf, m, chunk)
+    max_depth = jnp.maximum(s.max_depth,
+                            jnp.max(jnp.where(active, depth, 0)))
+
+    # --- compact local children to a dense 2*n_split prefix: the same
+    # one-sort compaction as bag_step, then left/right windows stacked
+    # back-to-back ([left children | right children | dead]) ---
+    skey = jnp.where(split, meta, meta | ACCEPT_BIT)
+    skey, sl, sr, sth = lax.sort((skey, l, r, th), dimension=0,
+                                 is_stable=True, num_keys=1)
+    smid = (sl + sr) * 0.5
+    ch_meta1 = (skey & ~ACCEPT_BIT) + 1
+    n_split = jnp.sum(split, dtype=jnp.int32)
+
+    # (2*chunk,) child columns as [left block | right block], each block
+    # valid on its first n_split lanes; a second small sort compacts the
+    # two valid runs into one dense 2*n_split prefix for the all_gather.
+    ch_l = jnp.concatenate([sl, smid])
+    ch_r = jnp.concatenate([smid, sr])
+    ch_th = jnp.concatenate([sth, sth])
+    ch_m = jnp.concatenate([ch_meta1, ch_meta1])
+    p2 = jnp.arange(2 * chunk, dtype=jnp.int32)
+    ch_valid = jnp.logical_or(p2 < n_split,
+                              jnp.logical_and(p2 >= chunk,
+                                              p2 < chunk + n_split))
+
+    # compact [left prefix | right prefix] into one dense 2*n_split
+    # prefix with a second small sort (key: invalid to the tail)
+    ckey = jnp.logical_not(ch_valid).astype(jnp.int32)
+    _, dl, dr, dth, dm = lax.sort((ckey, ch_l, ch_r, ch_th, ch_m),
+                                  dimension=0, is_stable=True, num_keys=1)
+    n_children = 2 * n_split
+
+    # --- cross-chip rebalance: shared strided re-shard (mesh.py) ---
+    (tk_l, tk_r, tk_th, tk_m), mine, total = strided_reshard(
+        axis, (dl, dr, dth, dm), n_children,
+        (fill_l, fill_l, fill_th, 0), 2 * chunk)
+    n_mine = jnp.sum(mine, dtype=jnp.int32)
+
+    # --- push my share onto the local bag top (window never clamps: the
+    # store carries 2*chunk slack past capacity) ---
+    bag_l = lax.dynamic_update_slice(s.bag_l, tk_l, (start,))
+    bag_r = lax.dynamic_update_slice(s.bag_r, tk_r, (start,))
+    bag_th = lax.dynamic_update_slice(s.bag_th, tk_th, (start,))
+    bag_meta = lax.dynamic_update_slice(s.bag_meta, tk_m, (start,))
+    new_count_raw = start + n_mine
+    # REPLICATED overflow predicate: the while_loop cond gates collectives,
+    # so every chip must agree on it. A chip's local count after the
+    # strided deal can exceed capacity only in the round where the global
+    # total first exceeds ~n_dev * capacity-ish; gate on the precise
+    # condition via a psum of the per-chip flags instead of trusting that.
+    local_ovf = new_count_raw > jnp.asarray(capacity, jnp.int32)
+    any_ovf = lax.psum(local_ovf.astype(jnp.int32), axis) > 0
+    overflow = jnp.logical_or(s.overflow, any_ovf)
+
+    return _ShardBag(
+        bag_l=bag_l, bag_r=bag_r, bag_th=bag_th, bag_meta=bag_meta,
+        count=jnp.minimum(new_count_raw, jnp.asarray(capacity, jnp.int32)),
+        acc=acc,
+        tasks=s.tasks + n_take.astype(jnp.int64),
+        splits=s.splits + jnp.sum(split.astype(jnp.int64)),
+        iters=s.iters + 1,
+        max_depth=max_depth,
+        overflow=overflow,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def build_sharded_family_run(mesh: Mesh, family: str, eps: float,
+                             rule: Rule, chunk: int, capacity: int,
+                             m: int, max_iters: int,
+                             fill_l: float, fill_th: float):
+    """Jitted sharded family integrator, memoized so repeated calls with
+    the same (mesh, family, eps, ...) reuse one compiled program. State
+    arrays are globally shaped with the leading axis sharded over the
+    mesh; per-chip scalars travel as (n_dev,) arrays."""
+    f_theta = FAMILIES[family]
+    axis = FRONTIER_AXIS
+
+    def shard_body(bag_l, bag_r, bag_th, bag_meta, count, acc, tasks,
+                   splits, iters, max_depth, overflow):
+        s = _ShardBag(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
+                      bag_meta=bag_meta, count=count[0], acc=acc[0],
+                      tasks=tasks[0], splits=splits[0], iters=iters[0],
+                      max_depth=max_depth[0], overflow=overflow[0])
+
+        def cond(s: _ShardBag):
+            pending = lax.psum(s.count, axis)
+            return jnp.logical_and(
+                jnp.logical_and(pending > 0,
+                                jnp.logical_not(s.overflow)),
+                s.iters < max_iters)
+
+        def body(s: _ShardBag):
+            return _shard_bag_round(s, f_theta, eps, rule, chunk,
+                                    capacity, m, axis, fill_l, fill_th)
+
+        out = lax.while_loop(cond, body, s)
+        return (out.bag_l, out.bag_r, out.bag_th, out.bag_meta,
+                out.count[None], out.acc[None], out.tasks[None],
+                out.splits[None], out.iters[None], out.max_depth[None],
+                out.overflow[None])
+
+    sharded = P(axis)
+    return jax.jit(jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(sharded,) * 4 + (sharded,) * 7,
+        out_specs=(sharded,) * 4 + (sharded,) * 7,
+    ))
+
+
+def integrate_family_sharded(
+        family: str, theta: Sequence[float], bounds, eps: float,
+        rule: Rule = Rule.TRAPEZOID,
+        chunk: int = 1 << 12,
+        capacity: int = 1 << 18,
+        max_iters: int = 1 << 20,
+        mesh: Optional[Mesh] = None,
+        n_devices: Optional[int] = None) -> FamilyResult:
+    """Integrate a parameterized family across the mesh.
+
+    ``chunk`` and ``capacity`` are PER CHIP. Families are seeded round-
+    robin; from the first round on, children are rebalanced across the
+    mesh every round (module docstring). ``family`` is the registry name
+    (the jitted shard program is cached per (mesh, family, eps, ...)).
+    """
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    n_dev = mesh.devices.size
+
+    theta = np.asarray(theta, dtype=np.float64)
+    m = theta.shape[0]
+    if m > MAX_FAMILIES:
+        raise ValueError(f"m={m} exceeds {MAX_FAMILIES}")
+    bounds = np.asarray(bounds, dtype=np.float64)
+    if bounds.ndim == 1:
+        bounds = np.tile(bounds.reshape(1, 2), (m, 1))
+    if chunk > capacity:
+        raise ValueError(f"chunk={chunk} exceeds capacity={capacity}")
+
+    store = capacity + 2 * chunk
+    fill_l = float(0.5 * (bounds[0, 0] + bounds[0, 1]))
+    fill_th = float(theta[0])
+
+    # Seed family j on chip j % n_dev, at the bottom of its local bag.
+    seeds_per = -(-m // n_dev)
+    if seeds_per > capacity:
+        raise ValueError(f"{m} seeds exceed mesh capacity")
+    bag_l = np.full((n_dev, store), fill_l)
+    bag_r = np.full((n_dev, store), fill_l)
+    bag_th = np.full((n_dev, store), fill_th)
+    bag_meta = np.zeros((n_dev, store), dtype=np.int32)
+    count0 = np.zeros(n_dev, dtype=np.int32)
+    for j in range(m):
+        c = j % n_dev
+        k = count0[c]
+        bag_l[c, k] = bounds[j, 0]
+        bag_r[c, k] = bounds[j, 1]
+        bag_th[c, k] = theta[j]
+        bag_meta[c, k] = j << DEPTH_BITS
+        count0[c] = k + 1
+
+    run = build_sharded_family_run(
+        mesh, family, float(eps), Rule(rule), int(chunk), int(capacity),
+        int(m), int(max_iters), fill_l, fill_th)
+
+    t0 = time.perf_counter()
+    out = run(jnp.asarray(bag_l.reshape(-1)), jnp.asarray(bag_r.reshape(-1)),
+              jnp.asarray(bag_th.reshape(-1)),
+              jnp.asarray(bag_meta.reshape(-1)),
+              jnp.asarray(count0),
+              jnp.zeros((n_dev, m), dtype=jnp.float64),
+              jnp.zeros(n_dev, dtype=jnp.int64),
+              jnp.zeros(n_dev, dtype=jnp.int64),
+              jnp.zeros(n_dev, dtype=jnp.int64),
+              jnp.zeros(n_dev, dtype=jnp.int32),
+              jnp.zeros(n_dev, dtype=bool))
+    (_, _, _, _, count, acc, tasks_c, splits_c, iters_c, maxd_c,
+     ovf_c) = out
+    # one host pull of the small fields only
+    count, acc, tasks_c, splits_c, iters_c, maxd_c, ovf_c = jax.device_get(
+        (count, acc, tasks_c, splits_c, iters_c, maxd_c, ovf_c))
+    wall = time.perf_counter() - t0
+
+    if bool(np.any(ovf_c)):
+        raise RuntimeError(
+            f"sharded bag overflowed per-chip capacity={capacity}")
+    if int(np.sum(count)) > 0:
+        raise RuntimeError(f"max_iters={max_iters} exceeded with "
+                           f"{int(np.sum(count))} tasks pending")
+
+    # Deterministic cross-chip reduction on host: fixed chip order.
+    areas = np.sum(np.asarray(acc, dtype=np.float64), axis=0)
+    if not np.all(np.isfinite(areas)):
+        bad = int(np.sum(~np.isfinite(areas)))
+        raise FloatingPointError(
+            f"sharded bag produced {bad}/{areas.size} non-finite areas")
+
+    tasks_per_chip = [int(t) for t in np.asarray(tasks_c)]
+    tasks = sum(tasks_per_chip)
+    splits = int(np.sum(np.asarray(splits_c)))
+    metrics = RunMetrics(
+        tasks=tasks,
+        splits=splits,
+        leaves=tasks - splits,
+        rounds=int(np.max(np.asarray(iters_c))),
+        max_depth=int(np.max(np.asarray(maxd_c))),
+        integrand_evals=tasks * EVALS_PER_TASK[Rule(rule)],
+        wall_time_s=wall,
+        n_chips=n_dev,
+        tasks_per_chip=tasks_per_chip,
+    )
+    return FamilyResult(
+        areas=areas,
+        metrics=metrics,
+        lane_efficiency=(tasks / (int(np.sum(np.asarray(iters_c))) * chunk)
+                         if np.sum(iters_c) else 0.0),
+    )
