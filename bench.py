@@ -166,5 +166,60 @@ def main():
     return 0
 
 
+def main_2d():
+    """Secondary bench mode (``python bench.py 2d``): BASELINE config #4,
+    tensor-product cubature on the peaked 2D Gaussian.
+
+    Correctness gate: Simpson+Richardson at eps=1e-8 meets ~1e-7 global
+    error (the config's operating point; Simpson's O(h^6) convergence
+    makes that workload tiny, by design). The TIMED section then runs
+    the order-2 trapezoid twin at eps=1e-10 — a ~53k-cell adaptive tree,
+    the throughput-meaningful variant — with its own convergence gate.
+    """
+    from ppls_tpu.config import Rule
+    from ppls_tpu.models.integrands import get_integrand_2d
+    from ppls_tpu.parallel.cubature import integrate_2d
+
+    entry = get_integrand_2d("gauss2d_peak")
+    bounds = (0.0, 1.0, 0.0, 1.0)
+    exact = entry.exact(*bounds)
+
+    def fail2d(msg):
+        print(json.dumps({"metric": "2d cells evaluated/sec/chip",
+                          "value": 0.0, "unit": "cells/s/chip",
+                          "vs_baseline": 0.0, "error": msg}))
+        return 1
+
+    log("[bench-2d] warmup/compile ...")
+    simpson = integrate_2d(entry.fn, bounds, 1e-8, exact=exact,
+                           chunk=1 << 12, capacity=1 << 21)
+    if not (simpson.global_error <= 1e-6):
+        return fail2d(f"simpson global error {simpson.global_error:.3e}")
+
+    kw = dict(chunk=1 << 13, capacity=1 << 22, rule=Rule.TRAPEZOID)
+    eps = 1e-10
+    res = integrate_2d(entry.fn, bounds, eps, exact=exact, **kw)
+    if not (res.global_error <= 1e-5):
+        return fail2d(f"trapezoid global error {res.global_error:.3e}")
+    t0 = time.perf_counter()
+    tasks = 0
+    for _ in range(REPEATS):
+        r = integrate_2d(entry.fn, bounds, eps, exact=exact, **kw)
+        tasks += r.metrics.tasks
+    wall = time.perf_counter() - t0
+    value = tasks / wall
+    log(f"[bench-2d] {value/1e6:.2f} M cells/s/chip ({r.metrics.tasks} "
+        f"cells/run); simpson err {simpson.global_error:.2e} @ 1e-8, "
+        f"trapezoid err {res.global_error:.2e} @ {eps}")
+    print(json.dumps({"metric": "2d cells evaluated/sec/chip",
+                      "value": round(value, 1), "unit": "cells/s/chip",
+                      "vs_baseline": 0.0,
+                      "abs_error_simpson_1e-8": simpson.global_error,
+                      "abs_error_trapezoid": res.global_error, "eps": eps}))
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "2d":
+        sys.exit(main_2d())
     sys.exit(main())

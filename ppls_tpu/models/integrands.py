@@ -268,3 +268,71 @@ def _sin_scaled_ds(x, th, dsm=None):
 
 register_family_ds("sin_recip_scaled", _sin_recip_scaled_ds)
 register_family_ds("sin_scaled", _sin_scaled_ds)
+
+
+# --- 2D integrands (BASELINE config #4: adaptive tensor-product
+# cubature; consumed by parallel.cubature.integrate_2d) -------------------
+
+@dataclasses.dataclass(frozen=True)
+class Integrand2D:
+    name: str
+    fn: Callable                      # f(x, y) -> z, elementwise
+    exact: Optional[Callable] = None  # exact(ax, bx, ay, by) -> float
+    doc: str = ""
+
+
+INTEGRANDS_2D: Dict[str, Integrand2D] = {}
+
+
+def register_integrand_2d(name: str, fn: Callable,
+                          exact: Optional[Callable] = None,
+                          doc: str = "") -> Integrand2D:
+    entry = Integrand2D(name=name, fn=fn, exact=exact, doc=doc)
+    INTEGRANDS_2D[name] = entry
+    return entry
+
+
+def get_integrand_2d(name: str) -> Integrand2D:
+    try:
+        return INTEGRANDS_2D[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown 2D integrand {name!r}; registered: "
+            f"{sorted(INTEGRANDS_2D)}") from None
+
+
+_G2_S = 0.05  # gauss2d_peak sigma
+
+
+def _gauss2d(x, y):
+    return jnp.exp(-0.5 * (((x - 0.5) / _G2_S) ** 2
+                           + ((y - 0.5) / _G2_S) ** 2))
+
+
+def _gauss2d_exact(ax, bx, ay, by):
+    # separable: product of 1D Gaussian integrals (erf closed form)
+    def g1(a, b):
+        s = _G2_S
+        return s * math.sqrt(math.pi / 2.0) * (
+            math.erf((b - 0.5) / (s * math.sqrt(2.0)))
+            - math.erf((a - 0.5) / (s * math.sqrt(2.0))))
+    return g1(ax, bx) * g1(ay, by)
+
+
+register_integrand_2d(
+    "gauss2d_peak", _gauss2d, _gauss2d_exact,
+    doc="Sharply peaked 2D Gaussian at (0.5, 0.5), sigma=0.05: the "
+        "clustered-refinement stress case of BASELINE config #4.")
+
+register_integrand_2d(
+    "cos_prod", lambda x, y: jnp.cos(x) * jnp.cos(y),
+    lambda ax, bx, ay, by: ((math.sin(bx) - math.sin(ax))
+                            * (math.sin(by) - math.sin(ay))),
+    doc="cos(x)cos(y): smooth separable benchmark with closed form.")
+
+register_integrand_2d(
+    "poly_xy", lambda x, y: x * x * y + x * y * y,
+    lambda ax, bx, ay, by: (
+        (bx ** 3 - ax ** 3) / 3.0 * (by ** 2 - ay ** 2) / 2.0
+        + (bx ** 2 - ax ** 2) / 2.0 * (by ** 3 - ay ** 3) / 3.0),
+    doc="x^2 y + x y^2: low-order polynomial sanity check.")

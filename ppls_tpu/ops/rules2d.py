@@ -1,0 +1,114 @@
+"""2D tensor-product cubature rules + refinement tests (BASELINE #4).
+
+The 1D reference rule compares one estimate against its composite
+refinement and splits when they disagree (``aquadPartA.c:185-191``).
+Both 2D tensor-product analogs follow that shape:
+
+* TRAPEZOID (9-point 3x3 grid): coarse = corner-average x area; refined
+  = sum of the four half-size sub-cell trapezoids; split when
+  |refined - coarse| > eps. The reference-semantics twin.
+* SIMPSON (25-point 5x5 grid): coarse = one tensor-product Simpson
+  panel on the 3x3 even sub-grid; refined = four Simpson panels on the
+  quadrant 3x3 grids; the standard |S2 - S1|/15 error estimate and the
+  Richardson-extrapolated accepted value S2 + (S2 - S1)/15 — the same
+  quality upgrade the 1D engine offers (``ops/rules.py:59-85``), and
+  the rule BASELINE config #4 names.
+
+Every grid point is evaluated once (the reference evaluates points
+redundantly, 5 for 3 — ``aquadPartA.c:185-190``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax.numpy as jnp
+
+from ppls_tpu.config import Rule
+
+EVALS_PER_TASK_2D = {Rule.TRAPEZOID: 9, Rule.SIMPSON: 25}
+
+
+def trapezoid_rect_batch(lx: jnp.ndarray, rx: jnp.ndarray,
+                         ly: jnp.ndarray, ry: jnp.ndarray,
+                         f: Callable, eps: float
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Evaluate a batch of rectangles; returns (value, err, split).
+
+    ``value`` is the refined (four sub-cell) estimate — accepted when
+    ``split`` is False, mirroring the reference's accept of the refined
+    sum (``aquadPartA.c:199``); the split test is strict ``>`` like the
+    reference's (``aquadPartA.c:191``).
+    """
+    mx = 0.5 * (lx + rx)
+    my = 0.5 * (ly + ry)
+    f00 = f(lx, ly)
+    f01 = f(lx, my)
+    f02 = f(lx, ry)
+    f10 = f(mx, ly)
+    f11 = f(mx, my)
+    f12 = f(mx, ry)
+    f20 = f(rx, ly)
+    f21 = f(rx, my)
+    f22 = f(rx, ry)
+
+    area = (rx - lx) * (ry - ly)
+    coarse = 0.25 * (f00 + f02 + f20 + f22) * area
+    # four sub-cell trapezoids, each corner-average x area/4
+    q = (f00 + f01 + f10 + f11) + (f01 + f02 + f11 + f12) \
+        + (f10 + f11 + f20 + f21) + (f11 + f12 + f21 + f22)
+    refined = 0.0625 * q * area
+    err = jnp.abs(refined - coarse)
+    return refined, err, err > eps
+
+
+def simpson_rect_batch(lx: jnp.ndarray, rx: jnp.ndarray,
+                       ly: jnp.ndarray, ry: jnp.ndarray,
+                       f: Callable, eps: float
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Tensor-product Simpson with Richardson extrapolation on the 5x5
+    grid; the rule BASELINE config #4 names. O(h^6) per accepted cell."""
+    hx = 0.25 * (rx - lx)
+    hy = 0.25 * (ry - ly)
+    # g[i][j] = f(lx + i*hx, ly + j*hy), 5x5
+    g = [[f(lx + i * hx, ly + j * hy) for j in range(5)] for i in range(5)]
+
+    def panel(i0, j0):
+        # one tensor-product Simpson panel on the 3x3 sub-grid starting
+        # at (i0, j0) with stride s in grid steps; weights (1,4,1)^2/36
+        # times the panel area.
+        w = (1.0, 4.0, 1.0)
+        tot = 0.0
+        for a in range(3):
+            for b in range(3):
+                tot = tot + w[a] * w[b] * g[i0 + a][j0 + b]
+        return tot
+
+    area = (rx - lx) * (ry - ly)
+    # coarse: one panel over the whole cell (even-index 3x3, stride 2)
+    w = (1.0, 4.0, 1.0)
+    tot_c = 0.0
+    for a in range(3):
+        for b in range(3):
+            tot_c = tot_c + w[a] * w[b] * g[2 * a][2 * b]
+    s1 = tot_c * area / 36.0
+    # refined: four quadrant panels, each area/4
+    s2 = (panel(0, 0) + panel(2, 0) + panel(0, 2) + panel(2, 2)) \
+        * area / 144.0
+    err = jnp.abs(s2 - s1) / 15.0
+    value = s2 + (s2 - s1) / 15.0
+    return value, err, err > eps
+
+
+_RULES_2D = {
+    Rule.TRAPEZOID: trapezoid_rect_batch,
+    Rule.SIMPSON: simpson_rect_batch,
+}
+
+
+def eval_rect_batch(lx: jnp.ndarray, rx: jnp.ndarray,
+                    ly: jnp.ndarray, ry: jnp.ndarray,
+                    f: Callable, eps: float, rule: Rule = Rule.SIMPSON
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Score a batch of rectangles: (value, err_est, split_mask)."""
+    return _RULES_2D[Rule(rule)](lx, rx, ly, ry, f, eps)

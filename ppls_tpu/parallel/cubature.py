@@ -1,0 +1,192 @@
+"""2D adaptive cubature engine: a chunked-LIFO bag of rectangles.
+
+The 1D bag engine (``bag_engine.py``) generalized to BASELINE config #4:
+tasks are rectangles (4 f64 coordinate columns instead of 2), a split
+produces FOUR quadrant children, and the push writes four overlapping
+chunk-wide windows at stride n_split (the 1D engine's two-window
+contiguous push, ``bag_engine.py`` push comment, extended — later
+windows' garbage tails land on dead slots past the children block).
+Everything else is the same TPU-native design: fixed-width chunk pops
+via dynamic_slice, one multi-operand compaction sort, masked evaluation
+with benign in-domain fill, device-resident while_loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ppls_tpu.config import Rule
+from ppls_tpu.ops.rules2d import EVALS_PER_TASK_2D, eval_rect_batch
+from ppls_tpu.utils.metrics import RunMetrics
+
+# meta word: | accept/dead sort bit 30 | depth 13..0 | (single problem)
+DEPTH_MASK_2D = (1 << 14) - 1
+ACCEPT_BIT_2D = jnp.int32(1 << 30)
+
+
+class RectBag(NamedTuple):
+    lx: jnp.ndarray         # (store,)
+    rx: jnp.ndarray
+    ly: jnp.ndarray
+    ry: jnp.ndarray
+    meta: jnp.ndarray       # int32 depth (+ transient sort bit)
+    count: jnp.ndarray
+    acc: jnp.ndarray        # f64 Kahan-free scalar (deterministic order)
+    tasks: jnp.ndarray
+    splits: jnp.ndarray
+    iters: jnp.ndarray
+    max_depth: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+def rect_bag_step(s: RectBag, f: Callable, eps: float, rule: Rule,
+                  chunk: int, capacity: int) -> RectBag:
+    n_take = jnp.minimum(s.count, chunk)
+    start = s.count - n_take
+    lx = lax.dynamic_slice(s.lx, (start,), (chunk,))
+    rx = lax.dynamic_slice(s.rx, (start,), (chunk,))
+    ly = lax.dynamic_slice(s.ly, (start,), (chunk,))
+    ry = lax.dynamic_slice(s.ry, (start,), (chunk,))
+    meta = lax.dynamic_slice(s.meta, (start,), (chunk,))
+    active = jnp.arange(chunk, dtype=jnp.int32) < n_take
+
+    value, _err, split = eval_rect_batch(lx, rx, ly, ry, f, eps, rule)
+    split = jnp.logical_and(split, active)
+    accept = jnp.logical_and(active, jnp.logical_not(split))
+    acc = s.acc + jnp.sum(jnp.where(accept, value, 0.0))
+    depth = meta & DEPTH_MASK_2D
+    max_depth = jnp.maximum(s.max_depth,
+                            jnp.max(jnp.where(active, depth, 0)))
+
+    # compaction sort: split lanes to a dense prefix, payload alongside
+    skey = jnp.where(split, meta, meta | ACCEPT_BIT_2D)
+    skey, slx, srx, sly, sry = lax.sort(
+        (skey, lx, rx, ly, ry), dimension=0, is_stable=True, num_keys=1)
+    smx = 0.5 * (slx + srx)
+    smy = 0.5 * (sly + sry)
+    ch_meta = (skey & ~ACCEPT_BIT_2D) + 1
+    n_split = jnp.sum(split, dtype=jnp.int32)
+
+    # push 4 quadrant windows at stride n_split:
+    #   k=0: [lx,mx]x[ly,my]   k=1: [mx,rx]x[ly,my]
+    #   k=2: [lx,mx]x[my,ry]   k=3: [mx,rx]x[my,ry]
+    quads = ((slx, smx, sly, smy), (smx, srx, sly, smy),
+             (slx, smx, smy, sry), (smx, srx, smy, sry))
+    blx, brx, bly, bry, bmeta = s.lx, s.rx, s.ly, s.ry, s.meta
+    for k, (qlx, qrx, qly, qry) in enumerate(quads):
+        off = start + k * n_split
+        blx = lax.dynamic_update_slice(blx, qlx, (off,))
+        brx = lax.dynamic_update_slice(brx, qrx, (off,))
+        bly = lax.dynamic_update_slice(bly, qly, (off,))
+        bry = lax.dynamic_update_slice(bry, qry, (off,))
+        bmeta = lax.dynamic_update_slice(bmeta, ch_meta, (off,))
+
+    new_count_raw = start + 4 * n_split
+    overflow = jnp.logical_or(
+        s.overflow, new_count_raw > jnp.asarray(capacity, jnp.int32))
+    return RectBag(
+        lx=blx, rx=brx, ly=bly, ry=bry, meta=bmeta,
+        count=jnp.minimum(new_count_raw, jnp.asarray(capacity, jnp.int32)),
+        acc=acc,
+        tasks=s.tasks + n_take.astype(jnp.int64),
+        splits=s.splits + jnp.sum(split.astype(jnp.int64)),
+        iters=s.iters + 1,
+        max_depth=max_depth,
+        overflow=overflow,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("f", "eps", "rule", "chunk",
+                                             "capacity", "max_iters"))
+def _run_rect_bag(state: RectBag, *, f: Callable, eps: float, rule: Rule,
+                  chunk: int, capacity: int, max_iters: int) -> RectBag:
+    def cond(s: RectBag):
+        return jnp.logical_and(
+            jnp.logical_and(s.count > 0, jnp.logical_not(s.overflow)),
+            s.iters < max_iters)
+
+    def body(s: RectBag):
+        return rect_bag_step(s, f, eps, rule, chunk, capacity)
+
+    return lax.while_loop(cond, body, state)
+
+
+@dataclasses.dataclass
+class CubatureResult:
+    area: float
+    metrics: RunMetrics
+    exact: Optional[float] = None
+
+    @property
+    def global_error(self) -> Optional[float]:
+        return None if self.exact is None else abs(self.area - self.exact)
+
+
+def integrate_2d(f: Callable, bounds, eps: float,
+                 rule: Rule = Rule.SIMPSON,
+                 chunk: int = 1 << 12,
+                 capacity: int = 1 << 20,
+                 max_iters: int = 1 << 20,
+                 exact: Optional[float] = None) -> CubatureResult:
+    """Adaptively integrate ``f(x, y)`` over the rectangle
+    ``bounds = (ax, bx, ay, by)`` with per-cell tolerance ``eps``."""
+    ax, bx, ay, by = (float(v) for v in bounds)
+    if chunk > capacity:
+        raise ValueError(f"chunk={chunk} exceeds capacity={capacity}")
+    # 4 windows of slack: the k=3 window ends at start + 3*n_split + chunk
+    # <= capacity + 4*chunk, so pushes never clamp.
+    store = capacity + 4 * chunk
+    fx = 0.5 * (ax + bx)
+    fy = 0.5 * (ay + by)
+    state = RectBag(
+        lx=jnp.full(store, fx).at[0].set(ax),
+        rx=jnp.full(store, fx).at[0].set(bx),
+        ly=jnp.full(store, fy).at[0].set(ay),
+        ry=jnp.full(store, fy).at[0].set(by),
+        meta=jnp.zeros(store, jnp.int32),
+        count=jnp.asarray(1, jnp.int32),
+        acc=jnp.zeros((), jnp.float64),
+        tasks=jnp.zeros((), jnp.int64),
+        splits=jnp.zeros((), jnp.int64),
+        iters=jnp.zeros((), jnp.int64),
+        max_depth=jnp.zeros((), jnp.int32),
+        overflow=jnp.zeros((), bool),
+    )
+    t0 = time.perf_counter()
+    out = _run_rect_bag(state, f=f, eps=float(eps), rule=Rule(rule),
+                        chunk=int(chunk), capacity=int(capacity),
+                        max_iters=int(max_iters))
+    acc, count, tasks, splits, iters, maxd, overflow = jax.device_get(
+        (out.acc, out.count, out.tasks, out.splits, out.iters,
+         out.max_depth, out.overflow))
+    wall = time.perf_counter() - t0
+
+    if bool(overflow):
+        raise RuntimeError(f"rect bag overflowed capacity={capacity}")
+    if int(count) > 0:
+        raise RuntimeError(f"max_iters={max_iters} exceeded")
+    area = float(acc)
+    if not np.isfinite(area):
+        raise FloatingPointError("2D cubature produced a non-finite area")
+
+    tasks = int(tasks)
+    metrics = RunMetrics(
+        tasks=tasks,
+        splits=int(splits),
+        leaves=tasks - int(splits),
+        rounds=int(iters),
+        max_depth=int(maxd),
+        integrand_evals=tasks * EVALS_PER_TASK_2D[Rule(rule)],
+        wall_time_s=wall,
+        n_chips=1,
+        tasks_per_chip=[tasks],
+    )
+    return CubatureResult(area=area, metrics=metrics, exact=exact)

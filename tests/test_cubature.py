@@ -1,0 +1,67 @@
+"""2D adaptive tensor-product cubature tests (BASELINE config #4)."""
+
+import numpy as np
+import pytest
+
+from ppls_tpu.config import Rule
+from ppls_tpu.models.integrands import get_integrand_2d
+from ppls_tpu.parallel.cubature import integrate_2d
+
+
+def _run(name, bounds, eps, **kw):
+    entry = get_integrand_2d(name)
+    exact = entry.exact(*bounds) if entry.exact else None
+    return integrate_2d(entry.fn, bounds, eps, exact=exact, **kw)
+
+
+def test_smooth_separable_converges():
+    r = _run("cos_prod", (0.0, 1.0, 0.0, 2.0), 1e-8)
+    assert r.global_error < 1e-8, r.global_error
+    assert r.metrics.leaves == r.metrics.tasks - r.metrics.splits
+
+
+def test_polynomial_exact_under_simpson():
+    # x^2 y + x y^2 is cubic per axis: tensor-product Simpson integrates
+    # it exactly — the first cell accepts with err ~ rounding.
+    r = _run("poly_xy", (0.0, 1.0, 0.0, 1.0), 1e-9)
+    assert r.global_error < 1e-12, r.global_error
+    assert r.metrics.tasks <= 5
+
+
+def test_trapezoid_rule_converges():
+    # The reference-semantics twin: order-2, so the per-cell tolerance
+    # leaves a larger (but bounded) global error: ~leaves * eps.
+    r = _run("cos_prod", (0.0, 1.0, 0.0, 2.0), 1e-8, rule=Rule.TRAPEZOID)
+    assert r.global_error < 5e-5, r.global_error
+    r2 = _run("cos_prod", (0.0, 1.0, 0.0, 2.0), 1e-6, rule=Rule.TRAPEZOID)
+    # order-2 convergence: tightening eps 100x cuts global error
+    assert r.global_error < r2.global_error / 10.0
+
+
+def test_peaked_gaussian_deep_refinement():
+    # BASELINE config #4's stress case: refinement clusters around the
+    # peak; Simpson at per-cell eps=1e-8 meets ~1e-8 global error.
+    r = _run("gauss2d_peak", (0.0, 1.0, 0.0, 1.0), 1e-8,
+             capacity=1 << 21)
+    assert r.global_error < 1e-7, r.global_error
+    assert r.metrics.max_depth >= 3
+    assert r.metrics.tasks > 100
+
+
+def test_anisotropic_bounds():
+    # Non-square domain, off-center peak: closed form still matched.
+    r = _run("gauss2d_peak", (0.25, 1.5, -0.5, 0.75), 1e-8,
+             capacity=1 << 21)
+    assert r.global_error < 1e-7, r.global_error
+
+
+def test_deterministic():
+    a1 = _run("gauss2d_peak", (0.0, 1.0, 0.0, 1.0), 1e-6).area
+    a2 = _run("gauss2d_peak", (0.0, 1.0, 0.0, 1.0), 1e-6).area
+    assert a1 == a2
+
+
+def test_overflow_detected():
+    with pytest.raises(RuntimeError, match="overflow"):
+        _run("gauss2d_peak", (0.0, 1.0, 0.0, 1.0), 1e-12,
+             chunk=64, capacity=128, rule=Rule.TRAPEZOID)
