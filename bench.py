@@ -219,7 +219,61 @@ def main_2d():
     return 0
 
 
+def main_qmc():
+    """Secondary bench mode (``python bench.py qmc``): BASELINE config
+    #5 — all six 8D Genz families on a 2^20-point shifted lattice;
+    reports points/sec/chip and the worst relative error."""
+    from ppls_tpu.models.genz import GENZ, genz_params
+    from ppls_tpu.parallel.mesh import make_mesh
+    from ppls_tpu.parallel.qmc import integrate_qmc
+
+    mesh = make_mesh()
+    n = 1 << 20
+    shifts = 8
+    worst_rel = 0.0
+    total_evals = 0
+    log("[bench-qmc] warmup/compile + accuracy over 6 Genz families ...")
+    results = {}
+    for name, fam in sorted(GENZ.items()):
+        a, u = genz_params(name, 8, seed=0)
+        exact = fam.exact(a, u)
+        integrate_qmc(fam.fn, a, u, n_points=n, n_shifts=shifts,
+                      mesh=mesh, fn_name=name)   # compile
+        r = integrate_qmc(fam.fn, a, u, n_points=n, n_shifts=shifts,
+                          mesh=mesh, fn_name=name, exact=exact)
+        rel = abs(r.value - exact) / max(abs(exact), 1e-300)
+        results[name] = (r, rel)
+        worst_rel = max(worst_rel, rel)
+        total_evals += r.metrics.integrand_evals
+    if not (worst_rel <= 1e-2):
+        print(json.dumps({"metric": "qmc points evaluated/sec/chip",
+                          "value": 0.0, "unit": "points/s/chip",
+                          "vs_baseline": 0.0,
+                          "error": f"worst rel error {worst_rel:.3e}"}))
+        return 1
+
+    t0 = time.perf_counter()
+    evals = 0
+    for name, fam in sorted(GENZ.items()):
+        a, u = genz_params(name, 8, seed=0)
+        r = integrate_qmc(fam.fn, a, u, n_points=n, n_shifts=shifts,
+                          mesh=mesh, fn_name=name)
+        evals += r.metrics.integrand_evals
+    wall = time.perf_counter() - t0
+    value = evals / wall / mesh.devices.size
+    log(f"[bench-qmc] {value/1e6:.1f} M points/s/chip over 6 families "
+        f"(worst rel err {worst_rel:.2e}, {shifts} shifts, N=2^20)")
+    print(json.dumps({"metric": "qmc points evaluated/sec/chip",
+                      "value": round(value, 1), "unit": "points/s/chip",
+                      "vs_baseline": 0.0,
+                      "worst_rel_error": worst_rel,
+                      "n_points": n, "n_shifts": shifts, "dim": 8}))
+    return 0
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "2d":
         sys.exit(main_2d())
+    if len(sys.argv) > 1 and sys.argv[1] == "qmc":
+        sys.exit(main_qmc())
     sys.exit(main())

@@ -1,0 +1,153 @@
+"""Quasi-Monte-Carlo integrator: device-generated lattice, psum reduce.
+
+BASELINE config #5 ("8D Genz test-suite integrals via quasi-Monte-Carlo,
+psum across a mesh") built TPU-first:
+
+* Points are a rank-1 Korobov lattice x_k = frac(k * z / N + shift),
+  z = (1, a, a^2, ...) mod N — generated ON DEVICE from two integers,
+  so nothing is shipped over PCIe/tunnel (a Sobol table would be host
+  state; the lattice is arithmetic). Generating vectors were selected
+  by the P_2 worst-case criterion in the Korobov space (host search,
+  hardcoded below).
+* Each chip generates and evaluates its own k-stripe of the sequence
+  under ``shard_map`` and reduces with ONE ``lax.psum`` — the
+  ``MPI_Reduce`` analog (``aquadPartA.c:149``), with no point-to-point
+  traffic at all.
+* Error estimation: M independent random shifts (seeded, deterministic)
+  give M unbiased estimates; the reported value is their mean and the
+  spread their standard error — the standard shifted-lattice estimator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh
+from ppls_tpu.utils.metrics import RunMetrics
+
+# Korobov generators selected by P_2 criterion, d=8, product weights
+# (host search over odd candidates, seed 42; see module docstring).
+KOROBOV_A = {1 << 16: 48557, 1 << 18: 172995, 1 << 20: 604413}
+
+
+def lattice_block(n_total: int, a_gen: int, start, count: int, d: int,
+                  shift) -> jnp.ndarray:
+    """Device-side generation of lattice points k = start..start+count-1.
+
+    x_k = frac((k * z mod N) / N + shift) with z_j = a^j mod N. The
+    product k * z_j is taken mod N in int64 (exact: both < 2^63 after
+    reducing k and z_j mod N), so coordinates are exact rationals k'/N
+    before the shift.
+    """
+    z = np.empty(d, dtype=np.int64)
+    zj = 1
+    for j in range(d):
+        z[j] = zj
+        zj = (zj * a_gen) % n_total
+    k = start + jnp.arange(count, dtype=jnp.int64)
+    kz = (k[:, None] % n_total) * jnp.asarray(z)[None, :]
+    frac = (kz % n_total).astype(jnp.float64) / float(n_total)
+    return (frac + shift[None, :]) % 1.0
+
+
+@functools.lru_cache(maxsize=64)
+def _build_qmc_run(mesh: Mesh, fn_name: str, fn: Callable, n_total: int,
+                   a_gen: int, d: int, n_shifts: int):
+    axis = FRONTIER_AXIS
+    n_dev = mesh.devices.size
+    per_chip = n_total // n_dev
+
+    def shard_body(a_vec, u_vec, shifts):
+        # a_vec, u_vec replicated (d,); shifts replicated (n_shifts, d)
+        my = lax.axis_index(axis)
+        start = (my * per_chip).astype(jnp.int64)
+
+        def one_shift(shift):
+            x = lattice_block(n_total, a_gen, start, per_chip, d, shift)
+            vals = fn(x, a_vec, u_vec)
+            return jnp.sum(vals)
+
+        partial = jax.vmap(one_shift)(shifts)          # (n_shifts,)
+        total = lax.psum(partial, axis)                # ONE collective
+        return (total / n_total)[None, :]              # (1, n_shifts)
+
+    return jax.jit(jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(), P(), P()),
+        out_specs=P(axis, None),
+    ))
+
+
+@dataclasses.dataclass
+class QMCResult:
+    value: float                 # mean over shifts
+    std_error: float             # std of shift estimates / sqrt(M)
+    estimates: np.ndarray        # (n_shifts,)
+    metrics: RunMetrics
+    exact: Optional[float] = None
+
+    @property
+    def abs_error(self) -> Optional[float]:
+        return None if self.exact is None else abs(self.value - self.exact)
+
+
+def integrate_qmc(fn: Callable, a: np.ndarray, u: np.ndarray,
+                  n_points: int = 1 << 18,
+                  n_shifts: int = 8,
+                  seed: int = 17,
+                  mesh: Optional[Mesh] = None,
+                  n_devices: Optional[int] = None,
+                  fn_name: Optional[str] = None,
+                  exact: Optional[float] = None) -> QMCResult:
+    """Integrate ``fn(x, a, u)`` over [0,1]^d with a shifted rank-1
+    lattice sharded across the mesh.
+
+    ``n_points`` must be one of the precomputed ``KOROBOV_A`` sizes and
+    divisible by the mesh size. ``fn_name`` keys the compiled-program
+    cache (defaults to the function's __name__).
+    """
+    if n_points not in KOROBOV_A:
+        raise ValueError(f"n_points must be one of {sorted(KOROBOV_A)}")
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    n_dev = mesh.devices.size
+    if n_points % n_dev:
+        raise ValueError(f"n_points={n_points} not divisible by mesh "
+                         f"size {n_dev}")
+    a = np.asarray(a, dtype=np.float64)
+    u = np.asarray(u, dtype=np.float64)
+    d = a.shape[0]
+    rng = np.random.default_rng(seed)
+    shifts = rng.random((n_shifts, d))
+
+    run = _build_qmc_run(mesh, fn_name or getattr(fn, "__name__", "fn"),
+                         fn, int(n_points), KOROBOV_A[n_points], int(d),
+                         int(n_shifts))
+    t0 = time.perf_counter()
+    out = run(jnp.asarray(a), jnp.asarray(u), jnp.asarray(shifts))
+    est = np.asarray(jax.device_get(out))[0]           # (n_shifts,)
+    wall = time.perf_counter() - t0
+
+    if not np.all(np.isfinite(est)):
+        raise FloatingPointError("QMC produced non-finite estimates")
+    value = float(np.mean(est))
+    std_err = float(np.std(est, ddof=1) / np.sqrt(n_shifts)) \
+        if n_shifts > 1 else 0.0
+
+    evals = n_points * n_shifts
+    metrics = RunMetrics(
+        tasks=evals, splits=0, leaves=evals, rounds=1, max_depth=0,
+        integrand_evals=evals, wall_time_s=wall, n_chips=n_dev,
+        tasks_per_chip=[evals // n_dev] * n_dev,
+    )
+    return QMCResult(value=value, std_error=std_err, estimates=est,
+                     metrics=metrics, exact=exact)
