@@ -169,18 +169,23 @@ def bag_step(state: BagState, f_theta: Callable, eps: float, rule: Rule,
 @functools.partial(jax.jit,
                    static_argnames=("f_theta", "eps", "rule", "chunk",
                                     "capacity", "max_iters", "stop_count"))
-def _run_bag(state: BagState, *, f_theta: Callable,
+def _run_bag(state: BagState, stop_iters=None, *, f_theta: Callable,
              eps: float, rule: Rule, chunk: int, capacity: int,
              max_iters: int,
              stop_count: Optional[int] = None) -> BagState:
-    """Run the bag to empty (default) or until it holds >= stop_count
-    tasks (the walker's breeding phase — see parallel/walker.py)."""
+    """Run the bag to empty (default), until it holds >= stop_count
+    tasks (the walker's breeding phase — see parallel/walker.py), or
+    until the cumulative iteration count reaches the DYNAMIC
+    ``stop_iters`` (checkpoint leg boundaries — no recompile per leg).
+    """
     def cond(s: BagState):
         live = jnp.logical_and(
             jnp.logical_and(s.count > 0, jnp.logical_not(s.overflow)),
             s.iters < max_iters)
         if stop_count is not None:
             live = jnp.logical_and(live, s.count < stop_count)
+        if stop_iters is not None:
+            live = jnp.logical_and(live, s.iters < stop_iters)
         return live
 
     def body(s: BagState):
@@ -243,17 +248,75 @@ class FamilyResult:
     lane_efficiency: float      # tasks / (iters * chunk)
 
 
+def _family_ckpt_identity(engine: str, f_theta, eps: float, m: int,
+                          theta: np.ndarray, bounds: np.ndarray) -> dict:
+    from ppls_tpu.runtime.checkpoint import _family_identity
+    return _family_identity(engine, getattr(f_theta, "__name__", "f"),
+                            float(eps), m, theta, bounds)
+
+
+def _snapshot_bag(path: str, identity: dict, s: BagState) -> None:
+    """Pull ONLY the live prefix (pow2-bucketed slice to bound the
+    number of compiled slice shapes) and write an atomic snapshot."""
+    from ppls_tpu.runtime.checkpoint import save_family_checkpoint
+
+    n = int(jax.device_get(s.count))
+    b = min(1 << max(n, 1).bit_length(), s.bag_l.shape[0])
+    l, r, th, meta, acc, tasks, splits, iters, maxd = jax.device_get(
+        (s.bag_l[:b], s.bag_r[:b], s.bag_th[:b], s.bag_meta[:b],
+         s.acc, s.tasks, s.splits, s.iters, s.max_depth))
+    save_family_checkpoint(
+        path, identity=identity,
+        bag_cols={"l": l[:n], "r": r[:n], "th": th[:n], "meta": meta[:n]},
+        count=n, acc=np.asarray(acc),
+        totals={"tasks": int(tasks), "splits": int(splits),
+                "iters": int(iters), "max_depth": int(maxd)})
+
+
+def _restore_bag(state: BagState, bag_cols: dict, count: int,
+                 acc: np.ndarray, totals: dict) -> BagState:
+    """Overlay a snapshot's live prefix + counters on a fresh bag."""
+    n = count
+    return state._replace(
+        bag_l=state.bag_l.at[:n].set(bag_cols["l"]) if n else state.bag_l,
+        bag_r=state.bag_r.at[:n].set(bag_cols["r"]) if n else state.bag_r,
+        bag_th=state.bag_th.at[:n].set(bag_cols["th"]) if n
+        else state.bag_th,
+        bag_meta=state.bag_meta.at[:n].set(bag_cols["meta"]) if n
+        else state.bag_meta,
+        count=jnp.asarray(n, jnp.int32),
+        acc=jnp.asarray(acc),
+        tasks=jnp.asarray(totals["tasks"], jnp.int64),
+        splits=jnp.asarray(totals["splits"], jnp.int64),
+        iters=jnp.asarray(totals["iters"], jnp.int64),
+        max_depth=jnp.asarray(totals["max_depth"], jnp.int32),
+    )
+
+
 def integrate_family(f_theta: Callable, theta: Sequence[float],
                      bounds, eps: float,
                      rule: Rule = Rule.TRAPEZOID,
                      chunk: int = 1 << 15,
                      capacity: int = 1 << 22,
-                     max_iters: int = 1 << 20) -> FamilyResult:
+                     max_iters: int = 1 << 20,
+                     checkpoint_path: Optional[str] = None,
+                     checkpoint_every: int = 256,
+                     _state_override: Optional[BagState] = None,
+                     _crash_after_legs: Optional[int] = None
+                     ) -> FamilyResult:
     """Integrate ``n`` independent problems in one device computation.
 
     ``f_theta(x, theta_i)`` is the parameterized integrand;
     ``theta`` the (n,) parameter vector; ``bounds`` either one (a, b) pair
     shared by all problems or an (n, 2) array.
+
+    With ``checkpoint_path`` set, the run executes in legs of
+    ``checkpoint_every`` chunk iterations and atomically snapshots the
+    live bag prefix + accumulator + counters at every leg boundary
+    (resume with :func:`resume_family` — bit-identical to an
+    uninterrupted run, since legs only bound the iteration count and
+    change no per-chunk computation). ``_crash_after_legs`` is a test
+    hook that raises after N snapshot legs.
     """
     theta = np.asarray(theta, dtype=np.float64)
     m = theta.shape[0]
@@ -263,11 +326,33 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
 
     if chunk > capacity:
         raise ValueError(f"chunk={chunk} exceeds capacity={capacity}")
-    state = initial_bag(bounds, capacity, m, chunk, theta=theta)
+    if _state_override is not None:
+        state = _state_override
+    else:
+        state = initial_bag(bounds, capacity, m, chunk, theta=theta)
+    kw = dict(f_theta=f_theta, eps=float(eps), rule=Rule(rule),
+              chunk=int(chunk), capacity=int(capacity),
+              max_iters=int(max_iters))
     t0 = time.perf_counter()
-    out = _run_bag(state, f_theta=f_theta, eps=float(eps),
-                   rule=Rule(rule), chunk=int(chunk), capacity=int(capacity),
-                   max_iters=int(max_iters))
+    if checkpoint_path is None:
+        out = _run_bag(state, **kw)
+    else:
+        identity = _family_ckpt_identity("bag", f_theta, float(eps), m,
+                                         theta, bounds)
+        legs = 0
+        while True:
+            leg_end = int(jax.device_get(state.iters)) + int(checkpoint_every)
+            out = _run_bag(state, jnp.asarray(leg_end, jnp.int64), **kw)
+            count, iters, overflow = (int(x) for x in jax.device_get(
+                (out.count, out.iters, out.overflow)))
+            if count == 0 or overflow or iters >= max_iters:
+                break
+            _snapshot_bag(checkpoint_path, identity, out)
+            legs += 1
+            if _crash_after_legs is not None and legs >= _crash_after_legs:
+                raise RuntimeError(
+                    f"simulated crash after {legs} legs (test hook)")
+            state = out
     # Single host pull of ONLY the small fields: the bag arrays are tens of
     # MB and a remote-tunneled device pays ~8MB/s + ~100ms per sync.
     acc_np, count, tasks, splits, iters, max_depth, overflow = jax.device_get(
@@ -323,3 +408,37 @@ def integrate_bag(config, **kw) -> FamilyResult:
 
 
 _UNPARAMETERIZED_CACHE: dict = {}
+
+
+def resume_family(path: str, f_theta: Callable, theta: Sequence[float],
+                  bounds, eps: float,
+                  rule: Rule = Rule.TRAPEZOID,
+                  chunk: int = 1 << 15,
+                  capacity: int = 1 << 22,
+                  max_iters: int = 1 << 20,
+                  checkpoint_every: int = 256) -> FamilyResult:
+    """Continue an interrupted :func:`integrate_family` run from its last
+    snapshot. The snapshot's problem identity (integrand name, eps, m,
+    theta/bounds hashes) must match or a ValueError is raised; the
+    result is bit-identical to the uninterrupted run (the counters and
+    accumulator resume exactly and the remaining chunk sequence is
+    unchanged). The reported wall time covers this process only.
+    """
+    from ppls_tpu.runtime.checkpoint import load_family_checkpoint
+
+    theta_np = np.asarray(theta, dtype=np.float64)
+    m = theta_np.shape[0]
+    bounds_np = np.asarray(bounds, dtype=np.float64)
+    if bounds_np.ndim == 1:
+        bounds_np = np.tile(bounds_np.reshape(1, 2), (m, 1))
+    identity = _family_ckpt_identity("bag", f_theta, float(eps), m,
+                                     theta_np, bounds_np)
+    bag_cols, count, acc, totals = load_family_checkpoint(path, identity)
+    fresh = initial_bag(bounds_np, capacity, m, chunk, theta=theta_np)
+    state = _restore_bag(fresh, bag_cols, count, acc, totals)
+    return integrate_family(f_theta, theta, bounds, eps, rule=rule,
+                            chunk=chunk, capacity=capacity,
+                            max_iters=max_iters,
+                            checkpoint_path=path,
+                            checkpoint_every=checkpoint_every,
+                            _state_override=state)

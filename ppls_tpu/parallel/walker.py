@@ -590,7 +590,8 @@ class _CycleCarry(NamedTuple):
                      "max_segments", "min_active_frac", "interpret",
                      "lanes", "capacity", "breed_chunk", "target",
                      "max_cycles"))
-def _run_cycles(bag: BagState, *, f_theta: Callable, f_ds: Callable,
+def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
+                f_ds: Callable,
                 eps: float, m: int, seg_iters: int, max_segments: int,
                 min_active_frac: float, interpret: bool, lanes: int,
                 capacity: int, breed_chunk: int, target: int,
@@ -676,8 +677,12 @@ def _run_cycles(bag: BagState, *, f_theta: Callable, f_ds: Callable,
         )
 
     z64 = jnp.zeros((), jnp.int64)
+    # acc0 threads a resumed/previous-leg accumulator through the SAME
+    # device addition chain, so a checkpoint-legged run reassociates
+    # nothing and stays bit-identical to the fused run.
     init = _CycleCarry(
-        bag=bag, acc=jnp.zeros(m, jnp.float64),
+        bag=bag,
+        acc=acc0 if acc0 is not None else jnp.zeros(m, jnp.float64),
         tasks=z64, splits=z64, btasks=z64, wtasks=z64, wsplits=z64,
         roots=z64, rounds=z64, segs=z64,
         maxd=jnp.zeros((), jnp.int32), cycles=jnp.zeros((), jnp.int32),
@@ -706,13 +711,29 @@ def integrate_family_walker(
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
         max_cycles: int = 64,
-        interpret: Optional[bool] = None) -> WalkerResult:
+        interpret: Optional[bool] = None,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 1,
+        _state_override=None,
+        _totals_override: Optional[dict] = None,
+        _crash_after_legs: Optional[int] = None) -> WalkerResult:
     """Flagship integration: cycles of breed (f64 bag, BFS) -> walk
     (Pallas ds kernel) -> expand -> drain, all in one device program.
 
     ``f_theta(x, th)`` is the f64 integrand; ``f_ds(x_ds, th_ds)`` the
     matching ds implementation used inside the kernel
     (``models.integrands.get_family_ds``).
+
+    With ``checkpoint_path`` set, the run executes in legs of
+    ``checkpoint_every`` CYCLES (the engine's natural host boundary: all
+    walker lane state is folded back into the bag by expand-pending at
+    every cycle edge) and snapshots the live bag prefix + per-family
+    accumulator + counters atomically; resume with
+    :func:`resume_family_walker`. Leg boundaries replay the identical
+    per-cycle computation, so on real-f64 hosts the result is
+    bit-identical to an uninterrupted run (on TPU the cross-cycle
+    accumulator additions happen in host f64 instead of emulated-f64 —
+    a <=1-ulp-of-f64 difference per cycle).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -740,19 +761,84 @@ def integrate_family_walker(
     slack_chunk = max(breed_chunk, -(-(MAX_REL_DEPTH + 1) * lanes // 2))
 
     t0 = time.perf_counter()
-    state = initial_bag(bounds, capacity, m, slack_chunk, theta=theta)
-    out = _run_cycles(state, f_theta=f_theta, f_ds=f_ds, eps=float(eps),
-                      m=m, seg_iters=int(seg_iters),
-                      max_segments=int(max_segments),
-                      min_active_frac=float(min_active_frac),
-                      interpret=bool(interpret), lanes=int(lanes),
-                      capacity=int(capacity), breed_chunk=int(breed_chunk),
-                      target=int(target), max_cycles=int(max_cycles))
-    (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
-     maxd, cycles, overflow, left) = jax.device_get(
-         (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
-          out.wsplits, out.roots, out.rounds, out.segs, out.maxd,
-          out.cycles, out.overflow, out.bag.count))
+    if _state_override is not None:
+        state = _state_override
+    else:
+        state = initial_bag(bounds, capacity, m, slack_chunk, theta=theta)
+    kw = dict(f_theta=f_theta, f_ds=f_ds, eps=float(eps),
+              m=m, seg_iters=int(seg_iters),
+              max_segments=int(max_segments),
+              min_active_frac=float(min_active_frac),
+              interpret=bool(interpret), lanes=int(lanes),
+              capacity=int(capacity), breed_chunk=int(breed_chunk),
+              target=int(target))
+    if checkpoint_path is None:
+        out = _run_cycles(state, max_cycles=int(max_cycles), **kw)
+        (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
+         maxd, cycles, overflow, left) = jax.device_get(
+             (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
+              out.wsplits, out.roots, out.rounds, out.segs, out.maxd,
+              out.cycles, out.overflow, out.bag.count))
+        acc = np.asarray(acc)
+    else:
+        from ppls_tpu.parallel.bag_engine import _family_ckpt_identity
+        from ppls_tpu.runtime.checkpoint import save_family_checkpoint
+
+        identity = _family_ckpt_identity("walker", f_theta, float(eps), m,
+                                         theta, bounds)
+        tot = dict(tasks=0, splits=0, btasks=0, wtasks=0, wsplits=0,
+                   roots=0, rounds=0, segs=0, max_depth=0, cycles=0)
+        if _totals_override is not None:
+            # the accumulator re-enters the DEVICE addition chain via
+            # acc0, so legging/resuming reassociates nothing
+            acc_dev = jnp.asarray(
+                np.array(_totals_override.pop("acc"), dtype=np.float64))
+            tot.update(_totals_override)
+        else:
+            acc_dev = jnp.zeros(m, jnp.float64)
+        legs = 0
+        bag = state
+        while True:
+            out = _run_cycles(bag, acc_dev,
+                              max_cycles=int(checkpoint_every), **kw)
+            (l_tasks, l_splits, l_bt, l_wt, l_ws, l_roots,
+             l_rounds, l_segs, l_maxd, l_cycles, l_ovf,
+             left) = jax.device_get(
+                 (out.tasks, out.splits, out.btasks, out.wtasks,
+                  out.wsplits, out.roots, out.rounds, out.segs, out.maxd,
+                  out.cycles, out.overflow, out.bag.count))
+            acc_dev = out.acc
+            for k, v in (("tasks", l_tasks), ("splits", l_splits),
+                         ("btasks", l_bt), ("wtasks", l_wt),
+                         ("wsplits", l_ws), ("roots", l_roots),
+                         ("rounds", l_rounds), ("segs", l_segs),
+                         ("cycles", l_cycles)):
+                tot[k] += int(v)
+            tot["max_depth"] = max(tot["max_depth"], int(l_maxd))
+            overflow = bool(l_ovf)
+            if overflow or int(left) == 0 or tot["cycles"] >= max_cycles:
+                break
+            n = int(left)
+            b = min(1 << max(n, 1).bit_length(), out.bag.bag_l.shape[0])
+            bl, br, bth, bmeta, acc_now = jax.device_get(
+                (out.bag.bag_l[:b], out.bag.bag_r[:b],
+                 out.bag.bag_th[:b], out.bag.bag_meta[:b], out.acc))
+            save_family_checkpoint(
+                checkpoint_path, identity=identity,
+                bag_cols={"l": bl[:n], "r": br[:n], "th": bth[:n],
+                          "meta": bmeta[:n]},
+                count=n, acc=np.asarray(acc_now), totals=dict(tot))
+            legs += 1
+            if _crash_after_legs is not None and legs >= _crash_after_legs:
+                raise RuntimeError(
+                    f"simulated crash after {legs} legs (test hook)")
+            bag = out.bag
+        acc = np.asarray(jax.device_get(acc_dev))
+        (tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
+         maxd, cycles) = (tot["tasks"], tot["splits"], tot["btasks"],
+                          tot["wtasks"], tot["wsplits"], tot["roots"],
+                          tot["rounds"], tot["segs"], tot["max_depth"],
+                          tot["cycles"])
     wall = time.perf_counter() - t0
 
     if bool(overflow):
@@ -799,3 +885,51 @@ def integrate_family_walker(
         walker_fraction=wtasks / tasks if tasks else 0.0,
         cycles=int(cycles),
     )
+
+
+def resume_family_walker(
+        path: str, f_theta: Callable, f_ds: Callable,
+        theta: Sequence[float], bounds, eps: float,
+        chunk: int = 1 << 15,
+        capacity: int = 1 << 23,
+        lanes: int = DEFAULT_LANES,
+        roots_per_lane: int = 12,
+        seg_iters: int = 32,
+        max_segments: int = 1 << 18,
+        min_active_frac: float = 0.1,
+        max_cycles: int = 64,
+        interpret: Optional[bool] = None,
+        checkpoint_every: int = 1) -> WalkerResult:
+    """Continue an interrupted checkpointed walker run from its last
+    cycle-boundary snapshot (identity-checked; see
+    :func:`integrate_family_walker`). Wall time covers this process."""
+    from ppls_tpu.parallel.bag_engine import (_family_ckpt_identity,
+                                              _restore_bag)
+    from ppls_tpu.runtime.checkpoint import load_family_checkpoint
+
+    theta_np = np.asarray(theta, dtype=np.float64)
+    m = theta_np.shape[0]
+    bounds_np = np.asarray(bounds, dtype=np.float64)
+    if bounds_np.ndim == 1:
+        bounds_np = np.tile(bounds_np.reshape(1, 2), (m, 1))
+    identity = _family_ckpt_identity("walker", f_theta, float(eps), m,
+                                     theta_np, bounds_np)
+    bag_cols, count, acc, totals = load_family_checkpoint(path, identity)
+
+    # same store sizing as integrate_family_walker
+    target = min(roots_per_lane * lanes, capacity // 2)
+    breed_chunk = max(1 << int(target - 1).bit_length(), chunk)
+    slack_chunk = max(breed_chunk, -(-(MAX_REL_DEPTH + 1) * lanes // 2))
+    fresh = initial_bag(bounds_np, capacity, m, slack_chunk, theta=theta_np)
+    state = _restore_bag(
+        fresh, bag_cols, count, acc=np.zeros(m, np.float64),
+        totals={"tasks": 0, "splits": 0, "iters": 0, "max_depth": 0})
+    totals = dict(totals)
+    totals["acc"] = acc
+    return integrate_family_walker(
+        f_theta, f_ds, theta, bounds, eps, chunk=chunk, capacity=capacity,
+        lanes=lanes, roots_per_lane=roots_per_lane, seg_iters=seg_iters,
+        max_segments=max_segments, min_active_frac=min_active_frac,
+        max_cycles=max_cycles, interpret=interpret,
+        checkpoint_path=path, checkpoint_every=checkpoint_every,
+        _state_override=state, _totals_override=totals)

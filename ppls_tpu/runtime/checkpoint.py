@@ -100,6 +100,72 @@ class Checkpointer:
                             config=self.config)
 
 
+# --- device-resident engines (bag / walker): leg-boundary snapshots --------
+#
+# The device engines run as one XLA program; checkpointing splits the run
+# into legs (a bounded number of chunk iterations, or one walker cycle)
+# and snapshots the LIVE BAG PREFIX + accumulator + counters at each leg
+# boundary. The live prefix is a few MB; the full bag store (hundreds of
+# MB of mostly dead slots) never leaves the device.
+
+
+def _family_identity(engine: str, fname: str, eps: float, m: int,
+                     theta: np.ndarray, bounds: np.ndarray) -> dict:
+    import hashlib
+    return {
+        "engine": engine, "fname": fname, "eps": eps, "m": m,
+        "theta_sha": hashlib.sha256(
+            np.ascontiguousarray(theta).tobytes()).hexdigest()[:16],
+        "bounds_sha": hashlib.sha256(
+            np.ascontiguousarray(bounds).tobytes()).hexdigest()[:16],
+    }
+
+
+def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
+                           count: int, acc: np.ndarray,
+                           totals: dict) -> None:
+    """Atomically snapshot a device family run at a leg boundary.
+
+    ``bag_cols`` maps column name -> live-prefix array (host); ``totals``
+    are the accumulated integer counters (tasks, splits, ...).
+    """
+    meta = {"identity": identity, "count": int(count), "totals": totals}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez(
+                fh,
+                acc=np.asarray(acc, dtype=np.float64),
+                meta=np.frombuffer(json.dumps(meta).encode(),
+                                   dtype=np.uint8),
+                **{f"bag_{k}": np.asarray(v) for k, v in bag_cols.items()},
+            )
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_family_checkpoint(path: str, identity: dict):
+    """Returns (bag_cols, count, acc, totals); raises ValueError when the
+    snapshot belongs to a different problem identity."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        acc = np.asarray(z["acc"], dtype=np.float64)
+        bag_cols = {k[len("bag_"):]: np.asarray(z[k])
+                    for k in z.files if k.startswith("bag_")}
+    stored = meta["identity"]
+    if stored != identity:
+        diff = {k: (stored.get(k), identity[k]) for k in identity
+                if stored.get(k) != identity.get(k)}
+        raise ValueError(
+            f"checkpoint {path!r} belongs to a different run; refusing "
+            f"to blend (stored vs requested): {diff}")
+    return bag_cols, int(meta["count"]), acc, meta["totals"]
+
+
 def resume(path: str, config: QuadConfig,
            on_round: Optional[callable] = None):
     """Continue an interrupted run from its last snapshot.
