@@ -123,3 +123,23 @@ def test_walker_deterministic():
 def test_walker_rejects_bad_lanes():
     with pytest.raises(ValueError, match="multiple of 128"):
         integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-6, lanes=100)
+
+
+def test_walker_sharded_matches_single_chip():
+    # Family-sharded walkers on the virtual 8-device mesh: same per-
+    # family computations up to banking-order/borderline-flip ds noise.
+    from ppls_tpu.parallel.mesh import make_mesh
+    from ppls_tpu.parallel.walker import integrate_family_walker_sharded
+
+    theta = 1.0 + np.arange(12) / 12.0
+    eps = 1e-7
+    s = integrate_family_walker_sharded(F, F_DS, theta, BOUNDS, eps,
+                                        mesh=make_mesh(8), **KW)
+    b = integrate_family_walker(F, F_DS, theta, BOUNDS, eps, **KW)
+    assert np.max(np.abs(s.areas - b.areas)) < 3e-9
+    drift = abs(s.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 1e-3
+    assert s.metrics.n_chips == 8
+    assert len(s.metrics.tasks_per_chip) == 8
+    assert sum(s.metrics.tasks_per_chip) == s.metrics.tasks
+    assert s.walker_fraction > 0.3
