@@ -45,11 +45,221 @@ def build_parser() -> argparse.ArgumentParser:
                         "(host engine only)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="print one JSON line instead of the table")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into "
+                        "DIR (TensorBoard/Perfetto)")
+
+    sub = p.add_subparsers(
+        dest="mode",
+        description="additional problem modes (default: single 1D "
+                    "integral with the flags above)")
+
+    fam = sub.add_parser(
+        "family", help="batch of independent 1D integrals "
+                       "(BASELINE config #3)")
+    fam.add_argument("--family", default="sin_recip_scaled",
+                     help="registered family name f(x, theta)")
+    fam.add_argument("--m", type=int, default=64, help="family size")
+    fam.add_argument("--theta0", type=float, default=1.0)
+    fam.add_argument("--theta1", type=float, default=2.0)
+    fam.add_argument("-a", type=float, default=1e-4)
+    fam.add_argument("-b", type=float, default=1.0)
+    fam.add_argument("--eps", type=float, default=1e-8)
+    fam.add_argument("--engine",
+                     choices=["bag", "walker", "sharded-bag",
+                              "sharded-walker"],
+                     default="bag",
+                     help="bag: chunked-LIFO f64; walker: Pallas ds "
+                          "flagship; sharded-*: multi-chip variants")
+    fam.add_argument("--chunk", type=int, default=1 << 13)
+    fam.add_argument("--capacity", type=int, default=1 << 20)
+    fam.add_argument("--n-devices", type=int, default=None)
+    fam.add_argument("--checkpoint", default=None,
+                     help="snapshot path (bag/walker engines); resumes "
+                          "from it if it exists")
+    fam.add_argument("--json", action="store_true", dest="as_json")
+
+    t2d = sub.add_parser(
+        "2d", help="2D adaptive tensor-product cubature "
+                   "(BASELINE config #4)")
+    t2d.add_argument("--integrand", default="gauss2d_peak",
+                     help="registered 2D integrand name")
+    t2d.add_argument("--bounds", type=float, nargs=4,
+                     default=[0.0, 1.0, 0.0, 1.0],
+                     metavar=("AX", "BX", "AY", "BY"))
+    t2d.add_argument("--eps", type=float, default=1e-8)
+    t2d.add_argument("--rule", choices=["trapezoid", "simpson"],
+                     default="simpson")
+    t2d.add_argument("--chunk", type=int, default=1 << 12)
+    t2d.add_argument("--capacity", type=int, default=1 << 20)
+    t2d.add_argument("--json", action="store_true", dest="as_json")
+
+    qmc = sub.add_parser(
+        "qmc", help="8D Genz suite via shifted-lattice QMC "
+                    "(BASELINE config #5)")
+    qmc.add_argument("--genz", default="all",
+                     help="Genz family name, or 'all'")
+    qmc.add_argument("--n", type=int, default=1 << 18,
+                     help="lattice size (2^16/2^18/2^20)")
+    qmc.add_argument("--shifts", type=int, default=8)
+    qmc.add_argument("--dim", type=int, default=8)
+    qmc.add_argument("--seed", type=int, default=0,
+                     help="Genz parameter draw seed")
+    qmc.add_argument("--n-devices", type=int, default=None)
+    qmc.add_argument("--json", action="store_true", dest="as_json")
     return p
+
+
+def _main_family(args) -> int:
+    import os
+
+    import numpy as np
+
+    from ppls_tpu.models.integrands import (family_exact, get_family,
+                                            get_family_ds)
+
+    theta = np.linspace(args.theta0, args.theta1, args.m, endpoint=False)
+    bounds = (args.a, args.b)
+    f = get_family(args.family)
+    kw = dict(chunk=args.chunk, capacity=args.capacity)
+
+    if args.engine == "bag":
+        from ppls_tpu.parallel.bag_engine import (integrate_family,
+                                                  resume_family)
+        if args.checkpoint and os.path.exists(args.checkpoint):
+            res = resume_family(args.checkpoint, f, theta, bounds,
+                                args.eps, **kw)
+        else:
+            res = integrate_family(f, theta, bounds, args.eps,
+                                   checkpoint_path=args.checkpoint, **kw)
+    elif args.engine == "walker":
+        from ppls_tpu.parallel.walker import (integrate_family_walker,
+                                              resume_family_walker)
+        fds = get_family_ds(args.family)
+        wkw = dict(capacity=args.capacity)
+        if args.checkpoint and os.path.exists(args.checkpoint):
+            res = resume_family_walker(args.checkpoint, f, fds, theta,
+                                       bounds, args.eps, **wkw)
+        else:
+            res = integrate_family_walker(f, fds, theta, bounds, args.eps,
+                                          checkpoint_path=args.checkpoint,
+                                          **wkw)
+    elif args.engine == "sharded-bag":
+        from ppls_tpu.parallel.sharded_bag import integrate_family_sharded
+        res = integrate_family_sharded(args.family, theta, bounds,
+                                       args.eps, chunk=args.chunk,
+                                       capacity=args.capacity,
+                                       n_devices=args.n_devices)
+    else:
+        from ppls_tpu.parallel.walker import integrate_family_walker_sharded
+        res = integrate_family_walker_sharded(
+            f, get_family_ds(args.family), theta, bounds, args.eps,
+            capacity=args.capacity, n_devices=args.n_devices)
+
+    m = res.metrics
+    exact = family_exact(args.family, args.a, args.b, theta)
+    abs_err = (float(np.max(np.abs(res.areas - np.asarray(exact))))
+               if exact is not None else None)
+    if args.as_json:
+        print(json.dumps({
+            "engine": args.engine, "m": args.m, "eps": args.eps,
+            "areas_head": [float(v) for v in res.areas[:4]],
+            "abs_error": abs_err,
+            "tasks": m.tasks, "splits": m.splits, "rounds": m.rounds,
+            "max_depth": m.max_depth, "wall_time_s": m.wall_time_s,
+            "tasks_per_sec": m.tasks / m.wall_time_s if m.wall_time_s
+            else None,
+            "tasks_per_chip": m.tasks_per_chip,
+            "walker_fraction": getattr(res, "walker_fraction", None),
+        }))
+    else:
+        print(f"{args.m} x {args.family} on [{args.a}, {args.b}] "
+              f"@ eps={args.eps} ({args.engine})")
+        print(f"areas[:4] = {[round(float(v), 9) for v in res.areas[:4]]}")
+        if abs_err is not None:
+            print(f"max abs error vs exact: {abs_err:.3e}")
+        print(m.histogram_str())
+        print(f"Tasks: {m.tasks} in {m.rounds} rounds, depth "
+              f"{m.max_depth}, {m.wall_time_s:.3f}s "
+              f"({m.tasks / max(m.wall_time_s, 1e-12) / 1e6:.1f} M "
+              f"tasks/s)")
+    return 0
+
+
+def _main_2d(args) -> int:
+    from ppls_tpu.config import Rule
+    from ppls_tpu.models.integrands import get_integrand_2d
+    from ppls_tpu.parallel.cubature import integrate_2d
+
+    entry = get_integrand_2d(args.integrand)
+    exact = entry.exact(*args.bounds) if entry.exact else None
+    res = integrate_2d(entry.fn, args.bounds, args.eps,
+                       rule=Rule(args.rule), chunk=args.chunk,
+                       capacity=args.capacity, exact=exact)
+    m = res.metrics
+    if args.as_json:
+        print(json.dumps({
+            "area": res.area, "exact": res.exact,
+            "global_error": res.global_error, "rule": args.rule,
+            "eps": args.eps, "tasks": m.tasks, "max_depth": m.max_depth,
+            "wall_time_s": m.wall_time_s}))
+    else:
+        print(f"Area={res.area:.12f}  ({args.rule}, eps={args.eps})")
+        if res.global_error is not None:
+            print(f"Global error: {res.global_error:.3e} "
+                  f"(exact {res.exact:.12f})")
+        print(f"Cells: {m.tasks} ({m.splits} splits) in {m.rounds} "
+              f"rounds, depth {m.max_depth}, {m.wall_time_s:.3f}s")
+    return 0
+
+
+def _main_qmc(args) -> int:
+    from ppls_tpu.models.genz import GENZ, genz_params, get_genz
+    from ppls_tpu.parallel.qmc import integrate_qmc
+
+    names = sorted(GENZ) if args.genz == "all" else [args.genz]
+    rows = []
+    for name in names:
+        fam = get_genz(name)
+        a, u = genz_params(name, args.dim, seed=args.seed)
+        exact = fam.exact(a, u)
+        r = integrate_qmc(fam.fn, a, u, n_points=args.n,
+                          n_shifts=args.shifts, fn_name=name,
+                          n_devices=args.n_devices, exact=exact)
+        rel = abs(r.value - exact) / max(abs(exact), 1e-300)
+        rows.append((name, r, rel))
+    if args.as_json:
+        print(json.dumps({
+            "n_points": args.n, "shifts": args.shifts, "dim": args.dim,
+            "families": {name: {"value": r.value, "exact": r.exact,
+                                "rel_error": rel,
+                                "std_error": r.std_error}
+                         for name, r, rel in rows}}))
+    else:
+        print(f"Genz 8D via shifted lattice: N={args.n}, "
+              f"{args.shifts} shifts")
+        for name, r, rel in rows:
+            print(f"  {name:14s} value={r.value:+.8e} "
+                  f"rel_err={rel:.2e} stderr={r.std_error:.2e}")
+    return 0
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    from ppls_tpu.utils.tracing import trace
+
+    with trace(getattr(args, "trace", None)):
+        return _dispatch(args)
+
+
+def _dispatch(args) -> int:
+    if getattr(args, "mode", None) == "family":
+        return _main_family(args)
+    if getattr(args, "mode", None) == "2d":
+        return _main_2d(args)
+    if getattr(args, "mode", None) == "qmc":
+        return _main_qmc(args)
 
     from ppls_tpu.config import Backend, QuadConfig, Rule
 

@@ -210,6 +210,9 @@ def device_integrate(config: QuadConfig = QuadConfig(),
         splits=int(splits_n),
         leaves=tasks - int(splits_n),
         rounds=int(rounds_n),
+        # EXACT for a breadth-first wavefront (round r = the depth-r
+        # frontier), not an approximation; the LIFO bag engines
+        # interleave depths and track it directly instead.
         max_depth=max(int(rounds_n) - 1, 0),
         integrand_evals=tasks * EVALS_PER_TASK[Rule(config.rule)],
         wall_time_s=wall,

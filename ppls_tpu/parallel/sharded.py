@@ -218,6 +218,10 @@ def sharded_integrate(config: QuadConfig = QuadConfig(),
         splits=splits,
         leaves=tasks - splits,
         rounds=rounds,
+        # EXACT for a breadth-first wavefront, not an approximation:
+        # round r evaluates precisely the depth-r frontier (children of
+        # round r-1), so the deepest task evaluated has depth rounds-1.
+        # (The LIFO bag engines interleave depths and track it directly.)
         max_depth=max(rounds - 1, 0),
         integrand_evals=tasks * EVALS_PER_TASK[Rule(config.rule)],
         wall_time_s=wall,

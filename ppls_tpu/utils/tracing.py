@@ -1,0 +1,38 @@
+"""Profiler tracing — the observability the reference lacks entirely
+(its only artifact is the task-count histogram, ``aquadPartA.c:109-118``).
+
+``trace(dir)`` wraps ``jax.profiler`` so any engine run can be captured
+and inspected in TensorBoard/Perfetto (kernel timelines, HBM traffic,
+per-op costs on the real chip):
+
+    with trace("/tmp/ppls-trace"):
+        integrate_family_walker(...)
+
+Exposed on the CLI as ``--trace DIR`` (all modes). Complements the
+host-side per-round ``RoundStats`` (utils/metrics.py) and the loop-body
+microbenchmarks in ``tools/profile_bag.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``trace_dir`` (no-op if None)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-span inside a trace (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -49,3 +49,32 @@ def test_mpi_gated():
         res = run_mpi(REFERENCE_CONFIG, n_workers=4)
         assert f"{res.area:.6f}" == "7583461.801486"
         assert res.metrics.tasks == 6567
+
+
+def test_cli_family_mode(capsys):
+    from ppls_tpu.__main__ import main
+    rc = main(["family", "--m", "4", "--eps", "1e-5", "--chunk", "512",
+               "--capacity", "32768", "-a", "1e-2", "--json"])
+    assert rc == 0
+    import json as _json
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["tasks"] > 0
+    assert out["abs_error"] is not None and out["abs_error"] < 1e-3
+
+
+def test_cli_2d_mode(capsys):
+    from ppls_tpu.__main__ import main
+    rc = main(["2d", "--eps", "1e-6", "--json"])
+    assert rc == 0
+    import json as _json
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["global_error"] < 1e-5
+
+
+def test_cli_qmc_mode(capsys):
+    from ppls_tpu.__main__ import main
+    rc = main(["qmc", "--n", "65536", "--genz", "continuous", "--json"])
+    assert rc == 0
+    import json as _json
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["families"]["continuous"]["rel_error"] < 1e-3
