@@ -190,3 +190,197 @@ def integrate_2d(f: Callable, bounds, eps: float,
         tasks_per_chip=[tasks],
     )
     return CubatureResult(area=area, metrics=metrics, exact=exact)
+
+
+def _shard_rect_round(s: RectBag, f: Callable, eps: float, rule: Rule,
+                      chunk: int, capacity: int, axis: str,
+                      fx: float, fy: float) -> RectBag:
+    """One sharded 2D round: local pop/eval + cross-chip child re-shard
+    (the sharded_bag.py design with 4 coordinate columns and 4 children
+    per split)."""
+    from ppls_tpu.parallel.mesh import strided_reshard
+
+    n_take = jnp.minimum(s.count, chunk)
+    start = s.count - n_take
+    lx = lax.dynamic_slice(s.lx, (start,), (chunk,))
+    rx = lax.dynamic_slice(s.rx, (start,), (chunk,))
+    ly = lax.dynamic_slice(s.ly, (start,), (chunk,))
+    ry = lax.dynamic_slice(s.ry, (start,), (chunk,))
+    meta = lax.dynamic_slice(s.meta, (start,), (chunk,))
+    active = jnp.arange(chunk, dtype=jnp.int32) < n_take
+
+    value, _err, split = eval_rect_batch(lx, rx, ly, ry, f, eps, rule)
+    split = jnp.logical_and(split, active)
+    accept = jnp.logical_and(active, jnp.logical_not(split))
+    acc = s.acc + jnp.sum(jnp.where(accept, value, 0.0))
+    depth = meta & DEPTH_MASK_2D
+    max_depth = jnp.maximum(s.max_depth,
+                            jnp.max(jnp.where(active, depth, 0)))
+
+    skey = jnp.where(split, meta, meta | ACCEPT_BIT_2D)
+    skey, slx, srx, sly, sry = lax.sort(
+        (skey, lx, rx, ly, ry), dimension=0, is_stable=True, num_keys=1)
+    smx = 0.5 * (slx + srx)
+    smy = 0.5 * (sly + sry)
+    ch_meta = (skey & ~ACCEPT_BIT_2D) + 1
+    n_split = jnp.sum(split, dtype=jnp.int32)
+
+    # (4*chunk,) child columns: four quadrant blocks, each valid on its
+    # first n_split lanes; one sort compacts them to a dense prefix.
+    quads = ((slx, smx, sly, smy), (smx, srx, sly, smy),
+             (slx, smx, smy, sry), (smx, srx, smy, sry))
+    ch_lx = jnp.concatenate([q[0] for q in quads])
+    ch_rx = jnp.concatenate([q[1] for q in quads])
+    ch_ly = jnp.concatenate([q[2] for q in quads])
+    ch_ry = jnp.concatenate([q[3] for q in quads])
+    ch_m = jnp.concatenate([ch_meta] * 4)
+    p4 = jnp.arange(4 * chunk, dtype=jnp.int32)
+    ch_valid = (p4 % chunk) < n_split
+    ckey = jnp.logical_not(ch_valid).astype(jnp.int32)
+    _, dlx, drx, dly, dry, dm = lax.sort(
+        (ckey, ch_lx, ch_rx, ch_ly, ch_ry, ch_m), dimension=0,
+        is_stable=True, num_keys=1)
+    n_children = 4 * n_split
+
+    (tk_lx, tk_rx, tk_ly, tk_ry, tk_m), mine, _total = strided_reshard(
+        axis, (dlx, drx, dly, dry, dm), n_children,
+        (fx, fx, fy, fy, 0), 4 * chunk)
+    n_mine = jnp.sum(mine, dtype=jnp.int32)
+
+    blx = lax.dynamic_update_slice(s.lx, tk_lx, (start,))
+    brx = lax.dynamic_update_slice(s.rx, tk_rx, (start,))
+    bly = lax.dynamic_update_slice(s.ly, tk_ly, (start,))
+    bry = lax.dynamic_update_slice(s.ry, tk_ry, (start,))
+    bmeta = lax.dynamic_update_slice(s.meta, tk_m, (start,))
+    new_count_raw = start + n_mine
+    # replicated overflow predicate (psum of local flags) — the cond of
+    # a collective loop must agree across chips
+    local_ovf = new_count_raw > jnp.asarray(capacity, jnp.int32)
+    any_ovf = lax.psum(local_ovf.astype(jnp.int32), axis) > 0
+    return RectBag(
+        lx=blx, rx=brx, ly=bly, ry=bry, meta=bmeta,
+        count=jnp.minimum(new_count_raw, jnp.asarray(capacity, jnp.int32)),
+        acc=acc,
+        tasks=s.tasks + n_take.astype(jnp.int64),
+        splits=s.splits + jnp.sum(split.astype(jnp.int64)),
+        iters=s.iters + 1,
+        max_depth=max_depth,
+        overflow=jnp.logical_or(s.overflow, any_ovf),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sharded_2d_run(mesh, fn_name: str, f: Callable, eps: float,
+                          rule: Rule, chunk: int, capacity: int,
+                          max_iters: int, fx: float, fy: float):
+    from jax.sharding import PartitionSpec as P
+
+    from ppls_tpu.parallel.mesh import FRONTIER_AXIS
+
+    axis = FRONTIER_AXIS
+
+    def shard_body(lx, rx, ly, ry, meta, count, acc, tasks, splits,
+                   iters, max_depth, overflow):
+        s = RectBag(lx=lx, rx=rx, ly=ly, ry=ry, meta=meta,
+                    count=count[0], acc=acc[0], tasks=tasks[0],
+                    splits=splits[0], iters=iters[0],
+                    max_depth=max_depth[0], overflow=overflow[0])
+
+        def cond(s: RectBag):
+            pending = lax.psum(s.count, axis)
+            return jnp.logical_and(
+                jnp.logical_and(pending > 0,
+                                jnp.logical_not(s.overflow)),
+                s.iters < max_iters)
+
+        def body(s: RectBag):
+            return _shard_rect_round(s, f, eps, rule, chunk, capacity,
+                                     axis, fx, fy)
+
+        out = lax.while_loop(cond, body, s)
+        return (out.lx, out.rx, out.ly, out.ry, out.meta,
+                out.count[None], out.acc[None], out.tasks[None],
+                out.splits[None], out.iters[None], out.max_depth[None],
+                out.overflow[None])
+
+    sharded = P(axis)
+    return jax.jit(jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(sharded,) * 12, out_specs=(sharded,) * 12))
+
+
+def integrate_2d_sharded(f: Callable, bounds, eps: float,
+                         rule: Rule = Rule.SIMPSON,
+                         chunk: int = 1 << 10,
+                         capacity: int = 1 << 18,
+                         max_iters: int = 1 << 20,
+                         mesh=None, n_devices: Optional[int] = None,
+                         fn_name: Optional[str] = None,
+                         exact: Optional[float] = None) -> CubatureResult:
+    """2D cubature across the mesh: per-chip rectangle bags with the
+    children dealt round-robin every round (demand-driven balancing —
+    refinement clustered on one chip's subdomain spreads out), psum
+    termination, deterministic final reduction. ``chunk``/``capacity``
+    are PER CHIP. Cell totals are conserved exactly vs
+    :func:`integrate_2d` (split decisions are placement-independent).
+    """
+    from ppls_tpu.parallel.mesh import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    n_dev = mesh.devices.size
+    ax, bx, ay, by = (float(v) for v in bounds)
+    if chunk > capacity:
+        raise ValueError(f"chunk={chunk} exceeds capacity={capacity}")
+    store = capacity + 4 * chunk
+    fx = 0.5 * (ax + bx)
+    fy = 0.5 * (ay + by)
+
+    lx = np.full((n_dev, store), fx)
+    rx = np.full((n_dev, store), fx)
+    ly = np.full((n_dev, store), fy)
+    ry = np.full((n_dev, store), fy)
+    meta = np.zeros((n_dev, store), dtype=np.int32)
+    lx[0, 0], rx[0, 0], ly[0, 0], ry[0, 0] = ax, bx, ay, by
+    count0 = np.zeros(n_dev, dtype=np.int32)
+    count0[0] = 1
+
+    run = _build_sharded_2d_run(
+        mesh, fn_name or getattr(f, "__name__", "f"), f, float(eps),
+        Rule(rule), int(chunk), int(capacity), int(max_iters), fx, fy)
+    t0 = time.perf_counter()
+    out = run(jnp.asarray(lx.reshape(-1)), jnp.asarray(rx.reshape(-1)),
+              jnp.asarray(ly.reshape(-1)), jnp.asarray(ry.reshape(-1)),
+              jnp.asarray(meta.reshape(-1)), jnp.asarray(count0),
+              jnp.zeros(n_dev), jnp.zeros(n_dev, dtype=np.int64),
+              jnp.zeros(n_dev, dtype=np.int64),
+              jnp.zeros(n_dev, dtype=np.int64),
+              jnp.zeros(n_dev, dtype=np.int32),
+              jnp.zeros(n_dev, dtype=bool))
+    (count, acc, tasks_c, splits_c, iters_c, maxd_c, ovf_c) = \
+        jax.device_get(out[5:])
+    wall = time.perf_counter() - t0
+
+    if bool(np.any(ovf_c)):
+        raise RuntimeError(
+            f"sharded rect bag overflowed per-chip capacity={capacity}")
+    if int(np.sum(count)) > 0:
+        raise RuntimeError(f"max_iters={max_iters} exceeded")
+    area = float(np.sum(np.asarray(acc, dtype=np.float64)))
+    if not np.isfinite(area):
+        raise FloatingPointError("sharded 2D produced a non-finite area")
+
+    tasks_per_chip = [int(t) for t in np.asarray(tasks_c)]
+    tasks = sum(tasks_per_chip)
+    metrics = RunMetrics(
+        tasks=tasks,
+        splits=int(np.sum(splits_c)),
+        leaves=tasks - int(np.sum(splits_c)),
+        rounds=int(np.max(iters_c)),
+        max_depth=int(np.max(maxd_c)),
+        integrand_evals=tasks * EVALS_PER_TASK_2D[Rule(rule)],
+        wall_time_s=wall,
+        n_chips=n_dev,
+        tasks_per_chip=tasks_per_chip,
+    )
+    return CubatureResult(area=area, metrics=metrics, exact=exact)

@@ -65,3 +65,29 @@ def test_overflow_detected():
     with pytest.raises(RuntimeError, match="overflow"):
         _run("gauss2d_peak", (0.0, 1.0, 0.0, 1.0), 1e-12,
              chunk=64, capacity=128, rule=Rule.TRAPEZOID)
+
+
+def test_sharded_2d_conserves_cells_and_area():
+    # Split decisions are placement-independent: cell totals match the
+    # single-chip engine exactly, the area to summation-order noise.
+    from ppls_tpu.config import Rule
+    from ppls_tpu.parallel.cubature import integrate_2d_sharded
+    from ppls_tpu.parallel.mesh import make_mesh
+
+    entry = get_integrand_2d("gauss2d_peak")
+    bounds = (0.0, 1.0, 0.0, 1.0)
+    eps = 1e-9
+    kw = dict(rule=Rule.TRAPEZOID)
+    s = integrate_2d_sharded(entry.fn, bounds, eps, chunk=1 << 8,
+                             capacity=1 << 15, mesh=make_mesh(8),
+                             fn_name="gauss2d_peak",
+                             exact=entry.exact(*bounds), **kw)
+    b = integrate_2d(entry.fn, bounds, eps, chunk=1 << 10,
+                     capacity=1 << 17, exact=entry.exact(*bounds), **kw)
+    assert s.metrics.tasks == b.metrics.tasks
+    assert abs(s.area - b.area) < 1e-12
+    assert s.metrics.n_chips == 8
+    assert sum(s.metrics.tasks_per_chip) == s.metrics.tasks
+    # clustered refinement spreads across the mesh
+    per = np.asarray(s.metrics.tasks_per_chip, dtype=np.float64)
+    assert per.min() > 0
