@@ -92,6 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
                      default="simpson")
     t2d.add_argument("--chunk", type=int, default=1 << 12)
     t2d.add_argument("--capacity", type=int, default=1 << 20)
+    t2d.add_argument("--n-devices", type=int, default=None,
+                     help="run the sharded engine over this many chips "
+                          "(default: single-chip engine)")
     t2d.add_argument("--json", action="store_true", dest="as_json")
 
     qmc = sub.add_parser(
@@ -189,13 +192,19 @@ def _main_family(args) -> int:
 def _main_2d(args) -> int:
     from ppls_tpu.config import Rule
     from ppls_tpu.models.integrands import get_integrand_2d
-    from ppls_tpu.parallel.cubature import integrate_2d
+    from ppls_tpu.parallel.cubature import integrate_2d, integrate_2d_sharded
 
     entry = get_integrand_2d(args.integrand)
     exact = entry.exact(*args.bounds) if entry.exact else None
-    res = integrate_2d(entry.fn, args.bounds, args.eps,
-                       rule=Rule(args.rule), chunk=args.chunk,
-                       capacity=args.capacity, exact=exact)
+    if args.n_devices:
+        res = integrate_2d_sharded(entry.fn, args.bounds, args.eps,
+                                   rule=Rule(args.rule), chunk=args.chunk,
+                                   capacity=args.capacity, exact=exact,
+                                   n_devices=args.n_devices)
+    else:
+        res = integrate_2d(entry.fn, args.bounds, args.eps,
+                           rule=Rule(args.rule), chunk=args.chunk,
+                           capacity=args.capacity, exact=exact)
     m = res.metrics
     if args.as_json:
         print(json.dumps({

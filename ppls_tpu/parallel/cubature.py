@@ -47,8 +47,12 @@ class RectBag(NamedTuple):
     overflow: jnp.ndarray
 
 
-def rect_bag_step(s: RectBag, f: Callable, eps: float, rule: Rule,
-                  chunk: int, capacity: int) -> RectBag:
+def _pop_eval_compact(s: RectBag, f: Callable, eps: float, rule: Rule,
+                      chunk: int):
+    """Shared pop/eval/accept/compaction core of the single-chip and
+    sharded round functions: returns (start, n_take, acc, max_depth,
+    n_split, quads, ch_meta, split) where ``quads`` are the four sorted
+    quadrant-child coordinate tuples (dense n_split prefix each)."""
     n_take = jnp.minimum(s.count, chunk)
     start = s.count - n_take
     lx = lax.dynamic_slice(s.lx, (start,), (chunk,))
@@ -74,12 +78,19 @@ def rect_bag_step(s: RectBag, f: Callable, eps: float, rule: Rule,
     smy = 0.5 * (sly + sry)
     ch_meta = (skey & ~ACCEPT_BIT_2D) + 1
     n_split = jnp.sum(split, dtype=jnp.int32)
-
-    # push 4 quadrant windows at stride n_split:
     #   k=0: [lx,mx]x[ly,my]   k=1: [mx,rx]x[ly,my]
     #   k=2: [lx,mx]x[my,ry]   k=3: [mx,rx]x[my,ry]
     quads = ((slx, smx, sly, smy), (smx, srx, sly, smy),
              (slx, smx, smy, sry), (smx, srx, smy, sry))
+    return start, n_take, acc, max_depth, n_split, quads, ch_meta, split
+
+
+def rect_bag_step(s: RectBag, f: Callable, eps: float, rule: Rule,
+                  chunk: int, capacity: int) -> RectBag:
+    start, n_take, acc, max_depth, n_split, quads, ch_meta, split = \
+        _pop_eval_compact(s, f, eps, rule, chunk)
+
+    # push 4 quadrant windows at stride n_split:
     blx, brx, bly, bry, bmeta = s.lx, s.rx, s.ly, s.ry, s.meta
     for k, (qlx, qrx, qly, qry) in enumerate(quads):
         off = start + k * n_split
@@ -200,35 +211,11 @@ def _shard_rect_round(s: RectBag, f: Callable, eps: float, rule: Rule,
     per split)."""
     from ppls_tpu.parallel.mesh import strided_reshard
 
-    n_take = jnp.minimum(s.count, chunk)
-    start = s.count - n_take
-    lx = lax.dynamic_slice(s.lx, (start,), (chunk,))
-    rx = lax.dynamic_slice(s.rx, (start,), (chunk,))
-    ly = lax.dynamic_slice(s.ly, (start,), (chunk,))
-    ry = lax.dynamic_slice(s.ry, (start,), (chunk,))
-    meta = lax.dynamic_slice(s.meta, (start,), (chunk,))
-    active = jnp.arange(chunk, dtype=jnp.int32) < n_take
-
-    value, _err, split = eval_rect_batch(lx, rx, ly, ry, f, eps, rule)
-    split = jnp.logical_and(split, active)
-    accept = jnp.logical_and(active, jnp.logical_not(split))
-    acc = s.acc + jnp.sum(jnp.where(accept, value, 0.0))
-    depth = meta & DEPTH_MASK_2D
-    max_depth = jnp.maximum(s.max_depth,
-                            jnp.max(jnp.where(active, depth, 0)))
-
-    skey = jnp.where(split, meta, meta | ACCEPT_BIT_2D)
-    skey, slx, srx, sly, sry = lax.sort(
-        (skey, lx, rx, ly, ry), dimension=0, is_stable=True, num_keys=1)
-    smx = 0.5 * (slx + srx)
-    smy = 0.5 * (sly + sry)
-    ch_meta = (skey & ~ACCEPT_BIT_2D) + 1
-    n_split = jnp.sum(split, dtype=jnp.int32)
+    start, n_take, acc, max_depth, n_split, quads, ch_meta, split = \
+        _pop_eval_compact(s, f, eps, rule, chunk)
 
     # (4*chunk,) child columns: four quadrant blocks, each valid on its
     # first n_split lanes; one sort compacts them to a dense prefix.
-    quads = ((slx, smx, sly, smy), (smx, srx, sly, smy),
-             (slx, smx, smy, sry), (smx, srx, smy, sry))
     ch_lx = jnp.concatenate([q[0] for q in quads])
     ch_rx = jnp.concatenate([q[1] for q in quads])
     ch_ly = jnp.concatenate([q[2] for q in quads])
@@ -270,7 +257,7 @@ def _shard_rect_round(s: RectBag, f: Callable, eps: float, rule: Rule,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_sharded_2d_run(mesh, fn_name: str, f: Callable, eps: float,
+def _build_sharded_2d_run(mesh, f: Callable, eps: float,
                           rule: Rule, chunk: int, capacity: int,
                           max_iters: int, fx: float, fy: float):
     from jax.sharding import PartitionSpec as P
@@ -315,7 +302,6 @@ def integrate_2d_sharded(f: Callable, bounds, eps: float,
                          capacity: int = 1 << 18,
                          max_iters: int = 1 << 20,
                          mesh=None, n_devices: Optional[int] = None,
-                         fn_name: Optional[str] = None,
                          exact: Optional[float] = None) -> CubatureResult:
     """2D cubature across the mesh: per-chip rectangle bags with the
     children dealt round-robin every round (demand-driven balancing —
@@ -346,7 +332,7 @@ def integrate_2d_sharded(f: Callable, bounds, eps: float,
     count0[0] = 1
 
     run = _build_sharded_2d_run(
-        mesh, fn_name or getattr(f, "__name__", "f"), f, float(eps),
+        mesh, f, float(eps),
         Rule(rule), int(chunk), int(capacity), int(max_iters), fx, fy)
     t0 = time.perf_counter()
     out = run(jnp.asarray(lx.reshape(-1)), jnp.asarray(rx.reshape(-1)),

@@ -80,7 +80,6 @@ def test_sharded_2d_conserves_cells_and_area():
     kw = dict(rule=Rule.TRAPEZOID)
     s = integrate_2d_sharded(entry.fn, bounds, eps, chunk=1 << 8,
                              capacity=1 << 15, mesh=make_mesh(8),
-                             fn_name="gauss2d_peak",
                              exact=entry.exact(*bounds), **kw)
     b = integrate_2d(entry.fn, bounds, eps, chunk=1 << 10,
                      capacity=1 << 17, exact=entry.exact(*bounds), **kw)
