@@ -17,6 +17,10 @@ Public API (stable):
     integrate           — one-call adaptive integration (host- or device-driven)
     device_integrate    — fully-on-device lax.while_loop integrator
     sharded_integrate   — multi-chip shard_map integrator
+    integrate_family    — batched independent integrals (chunked-LIFO bag)
+    integrate_family_walker — the Pallas subtree-walker flagship engine
+    integrate_2d        — adaptive tensor-product cubature
+    integrate_qmc       — shifted-lattice QMC (Genz suite)
     QuadConfig          — runtime configuration
     get_integrand       — integrand registry lookup
 """
@@ -34,6 +38,15 @@ from ppls_tpu.ops.rules import eval_batch, eval_interval  # noqa: E402
 from ppls_tpu.runtime.host_frontier import integrate, IntegrationResult  # noqa: E402
 from ppls_tpu.parallel.device_engine import device_integrate  # noqa: E402
 from ppls_tpu.parallel.sharded import sharded_integrate  # noqa: E402
+from ppls_tpu.parallel.bag_engine import integrate_family, resume_family  # noqa: E402
+from ppls_tpu.parallel.walker import (  # noqa: E402
+    integrate_family_walker,
+    integrate_family_walker_sharded,
+    resume_family_walker,
+)
+from ppls_tpu.parallel.sharded_bag import integrate_family_sharded  # noqa: E402
+from ppls_tpu.parallel.cubature import integrate_2d, integrate_2d_sharded  # noqa: E402
+from ppls_tpu.parallel.qmc import integrate_qmc  # noqa: E402
 
 __version__ = "0.1.0"
 
@@ -50,5 +63,14 @@ __all__ = [
     "IntegrationResult",
     "device_integrate",
     "sharded_integrate",
+    "integrate_family",
+    "resume_family",
+    "integrate_family_walker",
+    "integrate_family_walker_sharded",
+    "resume_family_walker",
+    "integrate_family_sharded",
+    "integrate_2d",
+    "integrate_2d_sharded",
+    "integrate_qmc",
     "__version__",
 ]
