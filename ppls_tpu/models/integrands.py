@@ -18,6 +18,7 @@ from typing import Callable, Dict, Optional
 import math
 
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
@@ -266,8 +267,19 @@ def _sin_scaled_ds(x, th, dsm=None):
     return dsm.ds_sin(dsm.ds_mul(th, x))
 
 
+def _gauss_center_ds(x, c, dsm=None):
+    # exp(-0.5 ((x-c)/1e-3)^2) = exp(-500000 (x-c)^2); the scale is an
+    # integer < 2^24, exact in f32.
+    if dsm is None:
+        from ppls_tpu.ops import ds_kernel as dsm
+    d = dsm.ds_sub(x, c)
+    z = dsm.ds_mul_f32(dsm.ds_mul(d, d), np.float32(-500000.0))
+    return dsm.ds_exp(z)
+
+
 register_family_ds("sin_recip_scaled", _sin_recip_scaled_ds)
 register_family_ds("sin_scaled", _sin_scaled_ds)
+register_family_ds("gauss_center", _gauss_center_ds)
 
 
 # --- 2D integrands (BASELINE config #4: adaptive tensor-product

@@ -28,6 +28,8 @@ from typing import Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ppls_tpu.ops.pow2 import pow2_f32
+
 DS = Tuple[jnp.ndarray, jnp.ndarray]
 
 _F32 = jnp.float32
@@ -282,3 +284,60 @@ def ds_sin(x: DS) -> DS:
 def ds_cos(x: DS) -> DS:
     half_pi = (jnp.full_like(x[0], _PIO2_1), jnp.full_like(x[0], _PIO2_2))
     return ds_sin(ds_add(x, half_pi))
+
+
+# --- exp -- Cody-Waite ln2 reduction + ds-leading Taylor ---------------------
+
+_LN2_1 = np.float32(0.6931471805599453)
+_LN2_2 = np.float32(0.6931471805599453 - float(np.float32(0.6931471805599453)))
+_LN2_3 = np.float32(
+    0.6931471805599453
+    - float(np.float32(0.6931471805599453))
+    - float(_LN2_2)
+)
+_LOG2E = np.float32(1.4426950408889634)
+
+_E3 = _c(1.0 / 6.0)
+_E4 = _c(1.0 / 24.0)
+_E5 = _c(1.0 / 120.0)
+_E6 = _c(1.0 / 720.0)
+_E7 = _c(1.0 / 5040.0)
+_E8 = _c(1.0 / 40320.0)
+_E9 = _c(1.0 / 362880.0)
+_E10 = np.float32(1.0 / 3628800.0)
+_E11 = np.float32(1.0 / 39916800.0)
+_E12 = np.float32(1.0 / 479001600.0)
+
+
+def _exp_poly(r: DS) -> DS:
+    """exp(r) - requires |r| <= ln2/2 (post-reduction)."""
+    tail = _E10 + r[0] * (_E11 + r[0] * _E12)
+    p = ds_add(_E9, ds_mul_f32(r, tail))
+    p = ds_add(_E8, ds_mul(r, p))
+    p = ds_add(_E7, ds_mul(r, p))
+    p = ds_add(_E6, ds_mul(r, p))
+    p = ds_add(_E5, ds_mul(r, p))
+    p = ds_add(_E4, ds_mul(r, p))
+    p = ds_add(_E3, ds_mul(r, p))
+    half = (jnp.full_like(r[0], 0.5), jnp.zeros_like(r[0]))
+    p = ds_add(half, ds_mul(r, p))
+    one = (jnp.ones_like(r[0]), jnp.zeros_like(r[0]))
+    return ds_add(ds_add(one, r), ds_mul(ds_mul(r, r), p))
+
+
+def ds_exp(x: DS) -> DS:
+    """exp(x) in ds precision; results below the f32 subnormal range
+    flush to 0 (the argument range of interest is |x| <= ~88)."""
+    k = jnp.round(x[0] * _LOG2E)
+    t1, e1 = two_prod(k, _LN2_1)
+    h = x[0] - t1            # exact by Sterbenz (k = round(x/ln2))
+    t2, e2 = two_prod(k, _LN2_2)
+    y = (h, jnp.zeros_like(h))
+    y = ds_add_f32(y, -e1)
+    y = ds_add_f32(y, x[1])
+    y = ds_add_f32(y, -t2)
+    y = ds_add_f32(y, -e2)
+    y = ds_add_f32(y, -(k * _LN2_3))
+    e = _exp_poly(y)
+    s = pow2_f32(k)          # exact power of two; 0 on deep underflow
+    return e[0] * s, e[1] * s

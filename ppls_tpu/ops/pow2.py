@@ -1,0 +1,44 @@
+"""Exact powers of two.
+
+``jnp.exp2`` is a polynomial approximation on every XLA backend and is
+NOT exact even at integer arguments (measured: ~1e-6 relative error in
+f32 and ~1-ulp error in f64 at small integer exponents, on both XLA:CPU
+and XLA:TPU; only Mosaic's in-kernel lowering is exact). Several core
+invariants here assume exact power-of-two scaling — the digit-plane
+reduction's scale/weights (``ops/reduction.py``), the walker's dyadic
+node geometry (``parallel/walker.py``), and ds_exp's final scaling — so
+these helpers construct 2^k exactly from the exponent bits.
+
+Works at XLA level, in Pallas kernel interiors, and in interpret mode
+(plain bitcasts).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def pow2_f32(k) -> jnp.ndarray:
+    """Exact 2^k (f32) for integer-valued ``k`` in [-126, 127]; flushes
+    to 0 below (subnormals are not constructed) and clamps to 2^127
+    above. Uses only ops Mosaic lowers directly (minimum/maximum/shift/
+    bitcast — ``jnp.clip`` recursed in the Mosaic lowering)."""
+    ki = k.astype(jnp.int32)
+    biased = jnp.maximum(jnp.minimum(ki + 127, 254), 1)
+    v = lax.bitcast_convert_type(biased << 23, jnp.float32)
+    return jnp.where(ki < -126, jnp.zeros_like(v), v)
+
+
+def pow2_f64(k) -> jnp.ndarray:
+    """Exact 2^k (f64) for integer-valued ``k`` in [-252, 252].
+
+    Built as a product of two exact f32 powers so it also works under
+    the TPU's double-f32 f64 emulation, where bitcasting an int64
+    exponent word would not produce the emulated representation.
+    """
+    ki = jnp.asarray(k).astype(jnp.int32)
+    a = ki // 2
+    b = ki - a
+    return (pow2_f32(a).astype(jnp.float64)
+            * pow2_f32(b).astype(jnp.float64))

@@ -14,6 +14,8 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
+from ppls_tpu.ops.pow2 import pow2_f64
+
 
 def masked_sum(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     """Sum of ``values`` where ``mask``; deterministic for fixed shape."""
@@ -150,8 +152,11 @@ def exact_segment_sum(fam: jnp.ndarray, leaf: jnp.ndarray, m: int,
     # vector yields exactly zero (r = 0/scale = 0), and a leaf smaller than
     # the clamp contributes at most 2^-112 absolute — far below the 1e-9
     # C-parity gate and below one ulp of any accepted area.
-    e = jnp.ceil(jnp.log2(jnp.maximum(amax, jnp.exp2(jnp.float64(-40.0))))) + 1.0
-    scale = jnp.exp2(e)
+    e = jnp.ceil(jnp.log2(jnp.maximum(amax, 2.0 ** -40))) + 1.0
+    # EXACT power of two (jnp.exp2 is approximate even at integers —
+    # ops/pow2.py); an inexact scale would make leaf/scale a rounding
+    # division and silently break the exactness contract.
+    scale = pow2_f64(jnp.clip(e, -120.0, 120.0))
     r = leaf / scale
     digs = []
     for _ in range(planes):
@@ -171,5 +176,5 @@ def exact_segment_sum(fam: jnp.ndarray, leaf: jnp.ndarray, m: int,
     out = jnp.matmul(lhs, oh_b,
                      preferred_element_type=jnp.float32)     # (P*FA, FB)
     out = out.reshape(planes, fa_n, fb_n).astype(jnp.float64)
-    w = jnp.exp2(-bbits * (jnp.arange(planes, dtype=jnp.float64) + 1)) * scale
+    w = pow2_f64(-bbits * (jnp.arange(planes, dtype=jnp.float64) + 1)) * scale
     return jnp.einsum("pab,p->ab", out, w).reshape(fa_n * fb_n)[:m]

@@ -73,6 +73,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ppls_tpu.config import Rule
 from ppls_tpu.ops import ds_kernel as dsk
+from ppls_tpu.ops.pow2 import pow2_f32, pow2_f64
 from ppls_tpu.ops.reduction import segment_sum_auto
 from ppls_tpu.parallel.bag_engine import (
     ACCEPT_BIT,
@@ -127,7 +128,9 @@ def _node_geometry(s: WalkState):
     """Exact-ish dyadic coordinates of the current node from (i, d):
     stateless reconstruction, so coordinate error (~1 ds ulp) does not
     accumulate along the walk."""
-    scale = jnp.exp2(-s.d.astype(jnp.float32))          # exact powers of 2
+    # exact powers of two: Mosaic's exp2 happens to be exact, but the
+    # interpret-mode (XLA) lowering is not (ops/pow2.py)
+    scale = pow2_f32(-s.d.astype(jnp.float32))
     w = (s.w_h * scale, s.w_l * scale)
     il = (s.i & 0x7FFF).astype(jnp.float32)             # two exact limbs
     ih = (s.i >> 15).astype(jnp.float32)
@@ -522,7 +525,7 @@ def _expand_pending(c: _WalkCarry, capacity: int, m: int) -> BagState:
             jnp.logical_and(suspended[None, :], kb < d_l[None, :]),
             ((i_l[None, :] >> kb) & 1) == 0))
 
-    wd = w64[None, :] * jnp.exp2(-node_d.astype(jnp.float64))
+    wd = w64[None, :] * pow2_f64(-node_d.astype(jnp.float64))
     ln = a64[None, :] + node_i.astype(jnp.float64) * wd
     rn = ln + wd
     meta_n = ((fam_l[None, :] << DEPTH_BITS)

@@ -111,3 +111,69 @@ def test_jit_and_vmap_compatible():
     hi, lo = _to_ds(x)
     got = f(hi, lo)
     assert _err(got, np.sin(_rep(x))).max() < 5e-13
+
+
+def test_pow2_exact_where_exp2_is_not():
+    # jnp.exp2 is approximate even at integer arguments on XLA backends
+    # (~1e-6 rel in f32); the pow2 helpers must be bit-exact.
+    import jax
+    import jax.numpy as jnp
+
+    from ppls_tpu.ops.pow2 import pow2_f32, pow2_f64
+
+    k = jnp.asarray(np.arange(-126, 128), jnp.float32)
+    got = np.asarray(jax.jit(pow2_f32)(k), np.float64)
+    assert np.array_equal(got, 2.0 ** np.arange(-126, 128, dtype=np.float64))
+    # flush below the normal range
+    assert float(jax.jit(pow2_f32)(jnp.float32(-127.0))) == 0.0
+
+    k64 = jnp.asarray(np.arange(-250, 251), jnp.float64)
+    got64 = np.asarray(jax.jit(pow2_f64)(k64))
+    assert np.array_equal(got64, 2.0 ** np.arange(-250, 251, dtype=np.float64))
+
+
+def test_ds_exp_accuracy_both_modules():
+    # exp over the gauss-relevant range; the fenced (XLA-level) module
+    # must hold ds precision, which requires the exact pow2 scaling
+    # (jnp.exp2's ~1e-6 integer-argument error was the dominant term).
+    import jax
+    import jax.numpy as jnp
+
+    from ppls_tpu.ops import ds
+
+    x = np.concatenate([np.linspace(-50.0, 5.0, 8192),
+                        np.linspace(-1e-3, 1e-3, 512)])
+    hi, lo = jax.jit(lambda v: ds.ds_exp(ds.ds_from_f64(v)))(jnp.asarray(x))
+    got = np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+    ref = np.exp(x)
+    rel = np.abs(got - ref) / np.abs(ref)
+    # |x| amplifies the ds argument error (rel ~ |x| * 2^-48)
+    assert rel.max() < 1e-12, rel.max()
+
+    # Below exp(-50) the ds PAIR cannot hold 2^-49 relative precision
+    # (the lo limb needs hi * 2^-49 >= 2^-126): graceful degradation to
+    # f32-hi accuracy, absolutely tiny for any quadrature use.
+    xt = np.linspace(-85.0, -50.0, 1024)
+    hi, lo = jax.jit(lambda v: ds.ds_exp(ds.ds_from_f64(v)))(jnp.asarray(xt))
+    got = np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+    assert np.abs(got - np.exp(xt)).max() < 1e-28
+
+
+def test_gauss_center_ds_twin_matches_f64():
+    import jax
+    import jax.numpy as jnp
+
+    from ppls_tpu.models.integrands import get_family, get_family_ds
+    from ppls_tpu.ops import ds
+
+    f = get_family("gauss_center")
+    fds = get_family_ds("gauss_center")
+    xs = np.linspace(0.49, 0.51, 8192)
+    c = np.full_like(xs, 0.5)
+    hi, lo = jax.jit(lambda v, cc: fds(ds.ds_from_f64(v),
+                                       ds.ds_from_f64(cc), dsm=ds))(
+        jnp.asarray(xs), jnp.asarray(c))
+    got = np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+    ref = np.asarray(jax.jit(f)(jnp.asarray(xs), jnp.asarray(c)))
+    # rel error ~ |z| * 2^-48 with z = -500000 (x-c)^2 down to -50
+    assert np.abs(got - ref).max() < 1e-11

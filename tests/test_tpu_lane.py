@@ -133,3 +133,24 @@ def test_walker_parity_on_device():
     assert np.max(np.abs(w.areas - b.areas)) < 1e-9
     assert abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks < 1e-4
     assert w.walker_fraction > 0.5, w.walker_fraction
+
+
+def test_walker_gauss_family_on_device():
+    # ds_exp inside real Mosaic codegen (exact pow2 scaling + fence-free
+    # transforms), on the clustered-refinement Gaussian family.
+    from ppls_tpu.models.integrands import get_family, get_family_ds
+    from ppls_tpu.parallel.bag_engine import integrate_family
+    from ppls_tpu.parallel.walker import integrate_family_walker
+
+    f = get_family("gauss_center")
+    fds = get_family_ds("gauss_center")
+    theta = np.array([0.4995, 0.5, 0.5005])
+    eps = 1e-9
+    w = integrate_family_walker(f, fds, theta, (0.4, 0.6), eps,
+                                capacity=1 << 16, lanes=256,
+                                roots_per_lane=1, seg_iters=32,
+                                min_active_frac=0.05)
+    b = integrate_family(f, theta, (0.4, 0.6), eps,
+                         chunk=1 << 10, capacity=1 << 16)
+    assert np.all(b.areas > 1e-3)
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9

@@ -143,3 +143,24 @@ def test_walker_sharded_matches_single_chip():
     assert len(s.metrics.tasks_per_chip) == 8
     assert sum(s.metrics.tasks_per_chip) == s.metrics.tasks
     assert s.walker_fraction > 0.3
+
+
+def test_walker_gauss_family():
+    # The ds_exp-based family twin: sharply peaked Gaussians (sigma=1e-3)
+    # — the clustered-refinement stress case — through the walker kernel.
+    # Peaks sit near the dyadic sample points: a sigma=1e-3 peak at an
+    # arbitrary offset is invisible to the first few trapezoid tests and
+    # BOTH engines consistently accept 0 (inherent adaptive-quadrature
+    # behavior, not an engine property).
+    f = get_family("gauss_center")
+    fds = get_family_ds("gauss_center")
+    theta = np.array([0.4995, 0.5, 0.5005])
+    eps = 1e-9
+    w = integrate_family_walker(f, fds, theta, (0.4, 0.6), eps, **KW)
+    b = integrate_family(f, theta, (0.4, 0.6), eps,
+                         chunk=1 << 10, capacity=1 << 16)
+    assert np.all(b.areas > 1e-3)          # every peak actually resolved
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9
+    drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 1e-2
+    assert w.walker_fraction > 0.2, w.walker_fraction
