@@ -139,7 +139,7 @@ def _main_family(args) -> int:
         from ppls_tpu.parallel.walker import (integrate_family_walker,
                                               resume_family_walker)
         fds = get_family_ds(args.family)
-        wkw = dict(capacity=args.capacity)
+        wkw = dict(chunk=args.chunk, capacity=args.capacity)
         if args.checkpoint and os.path.exists(args.checkpoint):
             res = resume_family_walker(args.checkpoint, f, fds, theta,
                                        bounds, args.eps, **wkw)
@@ -157,7 +157,8 @@ def _main_family(args) -> int:
         from ppls_tpu.parallel.walker import integrate_family_walker_sharded
         res = integrate_family_walker_sharded(
             f, get_family_ds(args.family), theta, bounds, args.eps,
-            capacity=args.capacity, n_devices=args.n_devices)
+            chunk=args.chunk, capacity=args.capacity,
+            n_devices=args.n_devices)
 
     m = res.metrics
     exact = family_exact(args.family, args.a, args.b, theta)

@@ -155,8 +155,12 @@ def exact_segment_sum(fam: jnp.ndarray, leaf: jnp.ndarray, m: int,
     e = jnp.ceil(jnp.log2(jnp.maximum(amax, 2.0 ** -40))) + 1.0
     # EXACT power of two (jnp.exp2 is approximate even at integers —
     # ops/pow2.py); an inexact scale would make leaf/scale a rounding
-    # division and silently break the exactness contract.
-    scale = pow2_f64(jnp.clip(e, -120.0, 120.0))
+    # division and silently break the exactness contract. The clip stays
+    # at pow2_f64's full supported range: e >= -39 by the amax clamp, and
+    # e <= 250 covers every representable emulated-f64 magnitude (and any
+    # physically plausible leaf on real f64 — beyond 2^250 the |r| <= 1/2
+    # precondition would quietly fail).
+    scale = pow2_f64(jnp.clip(e, -250.0, 250.0))
     r = leaf / scale
     digs = []
     for _ in range(planes):

@@ -1005,11 +1005,16 @@ def integrate_family_walker_sharded(
     counts = np.zeros(n_dev, dtype=np.int32)
     for c in range(n_dev):
         mine = np.arange(c, m, n_dev)
-        fill = float(0.5 * (bounds[mine[0], 0] + bounds[mine[0], 1])) \
-            if mine.size else 1.0
+        # chips with no families fall back to global family 0's domain:
+        # fills must be IN-DOMAIN for some family (dead/dummy lanes still
+        # evaluate the integrand — initial_bag's dead-slot note; an
+        # out-of-domain point can NaN or hit the emulated-f64
+        # transcendental slow path).
+        f0 = int(mine[0]) if mine.size else 0
+        fill = float(0.5 * (bounds[f0, 0] + bounds[f0, 1]))
         bag_l[c, :] = fill
         bag_r[c, :] = fill
-        bag_th[c, :] = float(theta[mine[0]]) if mine.size else 0.0
+        bag_th[c, :] = float(theta[f0])
         for jj in range(m_local):
             g = c + jj * n_dev
             if g < m:

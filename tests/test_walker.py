@@ -164,3 +164,16 @@ def test_walker_gauss_family():
     drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
     assert drift < 1e-2
     assert w.walker_fraction > 0.2, w.walker_fraction
+
+
+def test_walker_sharded_more_chips_than_families():
+    # Chips with no assigned families idle on in-domain dummy seeds.
+    from ppls_tpu.parallel.mesh import make_mesh
+    from ppls_tpu.parallel.walker import integrate_family_walker_sharded
+
+    theta = np.array([1.0, 1.5, 2.0])
+    s = integrate_family_walker_sharded(F, F_DS, theta, BOUNDS, 1e-6,
+                                        mesh=make_mesh(8), **KW)
+    b = integrate_family_walker(F, F_DS, theta, BOUNDS, 1e-6, **KW)
+    assert np.all(np.isfinite(s.areas))
+    assert np.max(np.abs(s.areas - b.areas)) < 3e-9
