@@ -71,6 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
                      default="bag",
                      help="bag: chunked-LIFO f64; walker: Pallas ds "
                           "flagship; sharded-*: multi-chip variants")
+    fam.add_argument("--rule", choices=["trapezoid", "simpson"],
+                     default="trapezoid",
+                     help="bag engines only (the walker is the "
+                          "reference-parity trapezoid)")
     fam.add_argument("--chunk", type=int, default=1 << 13)
     fam.add_argument("--capacity", type=int, default=1 << 20)
     fam.add_argument("--n-devices", type=int, default=None)
@@ -127,8 +131,10 @@ def _main_family(args) -> int:
     kw = dict(chunk=args.chunk, capacity=args.capacity)
 
     if args.engine == "bag":
+        from ppls_tpu.config import Rule
         from ppls_tpu.parallel.bag_engine import (integrate_family,
                                                   resume_family)
+        kw["rule"] = Rule(args.rule)
         if args.checkpoint and os.path.exists(args.checkpoint):
             res = resume_family(args.checkpoint, f, theta, bounds,
                                 args.eps, **kw)

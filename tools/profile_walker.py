@@ -1,0 +1,94 @@
+"""Walker kernel ceiling measurement (the round-3 methodology).
+
+Measures the Pallas segment kernel's raw lane-step rate with ONE device
+dispatch around K restarted segments — the only reliable way to time it
+on this host: per-launch overhead is ~0.07 ms and the tunneled device
+adds ~100 ms per sync, so K separate launches measure dispatch, not
+compute (see the round-3 ceiling analysis in the git log).
+
+Run: ``python tools/profile_walker.py`` (real TPU). Typical v5e output:
+~1.5 G lane-steps/s at full occupancy; at ~1.5 steps per subinterval
+that is a ~1 G subintervals/s kernel ceiling, against which the engine's
+lane efficiency (WalkerResult.lane_efficiency) positions the current
+run.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ppls_tpu.models.integrands import get_family_ds
+from ppls_tpu.parallel.walker import WalkState, make_walk_kernel
+
+
+def kernel_ceiling(lanes: int = 1 << 15, seg_iters: int = 256,
+                   outer: int = 32, eps: float = 1e-10):
+    """All lanes walk deep subtrees forever (restarted each segment)."""
+    fds = get_family_ds("sin_recip_scaled")
+    rows = lanes // 128
+    rng = np.random.default_rng(0)
+    z = np.zeros((rows, 128), np.float32)
+    a64 = 1e-4 * (1.0 + 30.0 * rng.random((rows, 128)))
+    w64 = np.full((rows, 128), 2e-6)
+    th64 = 1.0 + rng.random((rows, 128))
+
+    def ds(x):
+        hi = x.astype(np.float32)
+        lo = (x - hi.astype(np.float64)).astype(np.float32)
+        return jnp.array(hi), jnp.array(lo)
+
+    a_h, a_l = ds(a64)
+    w_h, w_l = ds(w64)
+    th_h, th_l = ds(th64)
+    fl = np.sin(th64 / a64).astype(np.float32)
+    fr = np.sin(th64 / (a64 + w64)).astype(np.float32)
+    zi = jnp.zeros((rows, 128), jnp.int32)
+    s0 = WalkState(
+        a_h=a_h, a_l=a_l, w_h=w_h, w_l=w_l, th_h=th_h, th_l=th_l,
+        fl_h=jnp.array(fl), fl_l=jnp.array(z),
+        fr_h=jnp.array(fr), fr_l=jnp.array(z),
+        acc_h=jnp.array(z), acc_l=jnp.array(z),
+        i=zi, d=zi, base_d=zi, fam=zi, flags=zi,
+        tasks=zi, splits=zi, maxd=zi)
+
+    seg = make_walk_kernel(fds, eps, seg_iters, interpret=False)
+
+    @jax.jit
+    def many(s_init):
+        def body(_, s):
+            out = seg(s)
+            # restart the walk so no lane ever parks
+            return out._replace(i=s_init.i, d=s_init.d,
+                                flags=s_init.flags,
+                                fl_h=s_init.fl_h, fl_l=s_init.fl_l,
+                                fr_h=s_init.fr_h, fr_l=s_init.fr_l)
+        return lax.fori_loop(0, outer, body, s_init)
+
+    out = many(s0)
+    int(jax.device_get(jnp.sum(out.tasks)))   # warm + true sync
+    t0 = time.perf_counter()
+    out = many(s0)
+    # time through a HOST DATA PULL: on this tunneled device
+    # block_until_ready sometimes acknowledges before execution
+    # completes (measured "740 G lane-steps/s"), so only a value
+    # dependency gives a true completion time.
+    tasks = int(jax.device_get(jnp.sum(out.tasks)))
+    dt = time.perf_counter() - t0
+    steps = outer * seg_iters * lanes
+    return {
+        "lane_steps_per_sec": steps / dt,
+        "tasks_per_sec_full_occupancy": tasks / dt,
+        "wall_s": dt,
+        "lanes": lanes,
+        "seg_iters": seg_iters,
+    }
+
+
+if __name__ == "__main__":
+    r = kernel_ceiling()
+    print(f"kernel: {r['lane_steps_per_sec']/1e9:.2f} G lane-steps/s, "
+          f"{r['tasks_per_sec_full_occupancy']/1e6:.0f} M subintervals/s "
+          f"at full occupancy ({r['wall_s']*1e3:.0f} ms, one dispatch)")
