@@ -158,6 +158,10 @@ def main():
             r.metrics.integrand_evals / r.metrics.tasks, 3),
         "engine": "walker",
         "walker_fraction": round(r.walker_fraction, 4),
+        # the tunneled device shows bursty slowdowns; the per-run rates
+        # document the spread behind the median (167-414 M measured for
+        # identical binaries across one day)
+        "per_run_rates": [round(v, 1) for v in rates],
     }
     if cpu_rate:
         out["evals_per_task_cpu"] = round(cpu_evals_rate / cpu_rate, 3)
