@@ -264,7 +264,9 @@ class _WalkCarry(NamedTuple):
     bag: BagState           # the root queue (phase-1 output, read-only here)
     cursor: jnp.ndarray     # int32 — next unconsumed root in [0, bag.count)
     acc: jnp.ndarray        # (m,) f64 per-family banked areas
-    segs: jnp.ndarray       # int32 segments executed
+    segs: jnp.ndarray       # int32 segments (bank/refill boundaries)
+    steps: jnp.ndarray      # int32 kernel iterations executed (adaptive
+                            # segment lengths make this != segs*seg_iters)
 
 
 def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
@@ -408,7 +410,7 @@ def _bank_and_refill(c: _WalkCarry, f_ds: Callable, m: int,
     n_taken = jnp.sum(take, dtype=jnp.int32)
     return _WalkCarry(lanes=new_lanes, bag=c.bag,
                       cursor=c.cursor + n_taken, acc=acc,
-                      segs=c.segs + 1)
+                      segs=c.segs + 1, steps=c.steps)
 
 
 def _idle_lanes(s: WalkState):
@@ -419,8 +421,20 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
               m: int, seg_iters: int, max_segments: int,
               min_active_frac: float, interpret: bool,
               lanes: int) -> _WalkCarry:
-    """One walk phase (traced inline inside :func:`_run_cycles`)."""
+    """One walk phase (traced inline inside :func:`_run_cycles`).
+
+    Adaptive segment length: at high occupancy (>= 3/4 of lanes live —
+    early/mid walk, when most lanes are deep inside their subtrees) an
+    8x longer kernel segment runs between bank/refill boundaries,
+    cutting the per-boundary costs (the refill routing sorts + the
+    per-family segment sum, ~200 us at lanes=2^15/m=1024) by ~4x over
+    the phase; when occupancy decays the short segment keeps refill
+    latency low so parked lanes get fresh roots quickly.
+    """
     run_segment = make_walk_kernel(f_ds, eps, seg_iters, interpret=interpret)
+    big_mult = 8
+    run_segment_big = make_walk_kernel(f_ds, eps, seg_iters * big_mult,
+                                       interpret=interpret)
 
     rows = lanes // 128
     z32 = jnp.zeros((rows, 128), jnp.float32)
@@ -436,9 +450,11 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
     # segs starts at -1: the initial seeding call below increments it,
     # so `segs` counts executed kernel segments only.
     carry = _WalkCarry(lanes=lane0, bag=bag, cursor=jnp.int32(0),
-                       acc=jnp.zeros(m, jnp.float64), segs=jnp.int32(-1))
+                       acc=jnp.zeros(m, jnp.float64), segs=jnp.int32(-1),
+                       steps=jnp.int32(0))
     carry = _bank_and_refill(carry, f_ds, m, lanes)   # initial seeding
     min_active = jnp.int32(int(lanes * min_active_frac))
+    big_active = jnp.int32((3 * lanes) // 4)
 
     def cond(c: _WalkCarry):
         idle = _idle_lanes(c.lanes)
@@ -451,8 +467,13 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
         return jnp.logical_and(useful, c.segs < max_segments)
 
     def body(c: _WalkCarry):
-        new_lanes = run_segment(c.lanes)
-        return _bank_and_refill(c._replace(lanes=new_lanes), f_ds, m, lanes)
+        active = lanes - _idle_lanes(c.lanes)
+        use_big = active >= big_active
+        new_lanes = lax.cond(use_big, run_segment_big, run_segment, c.lanes)
+        si_used = jnp.where(use_big, jnp.int32(seg_iters * big_mult),
+                            jnp.int32(seg_iters))
+        out = _bank_and_refill(c._replace(lanes=new_lanes), f_ds, m, lanes)
+        return out._replace(steps=out.steps + si_used)
 
     out = lax.while_loop(cond, body, carry)
     # Final credit: lanes still mid-walk (suspended) hold accepted-leaf
@@ -581,7 +602,8 @@ class _CycleCarry(NamedTuple):
     wsplits: jnp.ndarray    # i64
     roots: jnp.ndarray      # i64 roots consumed by the walker
     rounds: jnp.ndarray     # i64 bag iterations (breed + drain)
-    segs: jnp.ndarray       # i64 walker segments
+    segs: jnp.ndarray       # i64 walker segments (boundaries)
+    wsteps: jnp.ndarray     # i64 walker kernel iterations
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32
     overflow: jnp.ndarray   # bool
@@ -678,6 +700,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             roots=c.roots + walk.cursor.astype(jnp.int64),
             rounds=c.rounds + bred.iters + bag3.iters,
             segs=c.segs + walk.segs.astype(jnp.int64),
+            wsteps=c.wsteps + walk.steps.astype(jnp.int64),
             maxd=jnp.maximum(
                 jnp.maximum(c.maxd, jnp.max(walk.lanes.maxd)),
                 jnp.maximum(bred.max_depth, bag3.max_depth)),
@@ -693,7 +716,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         bag=bag,
         acc=acc0 if acc0 is not None else jnp.zeros(m, jnp.float64),
         tasks=z64, splits=z64, btasks=z64, wtasks=z64, wsplits=z64,
-        roots=z64, rounds=z64, segs=z64,
+        roots=z64, rounds=z64, segs=z64, wsteps=z64,
         maxd=jnp.zeros((), jnp.int32), cycles=jnp.zeros((), jnp.int32),
         overflow=jnp.zeros((), bool),
     )
@@ -784,10 +807,10 @@ def integrate_family_walker(
     if checkpoint_path is None:
         out = _run_cycles(state, max_cycles=int(max_cycles), **kw)
         (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
-         maxd, cycles, overflow, left) = jax.device_get(
+         wsteps, maxd, cycles, overflow, left) = jax.device_get(
              (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
-              out.wsplits, out.roots, out.rounds, out.segs, out.maxd,
-              out.cycles, out.overflow, out.bag.count))
+              out.wsplits, out.roots, out.rounds, out.segs, out.wsteps,
+              out.maxd, out.cycles, out.overflow, out.bag.count))
         acc = np.asarray(acc)
     else:
         from ppls_tpu.parallel.bag_engine import _family_ckpt_identity
@@ -796,7 +819,8 @@ def integrate_family_walker(
         identity = _family_ckpt_identity("walker", f_theta, float(eps), m,
                                          theta, bounds)
         tot = dict(tasks=0, splits=0, btasks=0, wtasks=0, wsplits=0,
-                   roots=0, rounds=0, segs=0, max_depth=0, cycles=0)
+                   roots=0, rounds=0, segs=0, wsteps=0, max_depth=0,
+                   cycles=0)
         if _totals_override is not None:
             # the accumulator re-enters the DEVICE addition chain via
             # acc0, so legging/resuming reassociates nothing
@@ -811,16 +835,18 @@ def integrate_family_walker(
             out = _run_cycles(bag, acc_dev,
                               max_cycles=int(checkpoint_every), **kw)
             (l_tasks, l_splits, l_bt, l_wt, l_ws, l_roots,
-             l_rounds, l_segs, l_maxd, l_cycles, l_ovf,
+             l_rounds, l_segs, l_wst, l_maxd, l_cycles, l_ovf,
              left) = jax.device_get(
                  (out.tasks, out.splits, out.btasks, out.wtasks,
-                  out.wsplits, out.roots, out.rounds, out.segs, out.maxd,
+                  out.wsplits, out.roots, out.rounds, out.segs,
+                  out.wsteps, out.maxd,
                   out.cycles, out.overflow, out.bag.count))
             acc_dev = out.acc
             for k, v in (("tasks", l_tasks), ("splits", l_splits),
                          ("btasks", l_bt), ("wtasks", l_wt),
                          ("wsplits", l_ws), ("roots", l_roots),
                          ("rounds", l_rounds), ("segs", l_segs),
+                         ("wsteps", l_wst),
                          ("cycles", l_cycles)):
                 tot[k] += int(v)
             tot["max_depth"] = max(tot["max_depth"], int(l_maxd))
@@ -844,10 +870,11 @@ def integrate_family_walker(
             bag = out.bag
         acc = np.asarray(jax.device_get(acc_dev))
         (tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
-         maxd, cycles) = (tot["tasks"], tot["splits"], tot["btasks"],
-                          tot["wtasks"], tot["wsplits"], tot["roots"],
-                          tot["rounds"], tot["segs"], tot["max_depth"],
-                          tot["cycles"])
+         wsteps, maxd, cycles) = (
+             tot["tasks"], tot["splits"], tot["btasks"],
+             tot["wtasks"], tot["wsplits"], tot["roots"],
+             tot["rounds"], tot["segs"], tot["wsteps"],
+             tot["max_depth"], tot["cycles"])
     wall = time.perf_counter() - t0
 
     if bool(overflow):
@@ -886,7 +913,7 @@ def integrate_family_walker(
         n_chips=1,
         tasks_per_chip=[tasks],
     )
-    denom = segs * seg_iters * lanes
+    denom = int(wsteps) * lanes
     return WalkerResult(
         areas=np.asarray(acc),
         metrics=metrics,
@@ -1048,7 +1075,7 @@ def integrate_family_walker_sharded(
         out = _run_cycles(bag, **kw)
         return (out.acc, out.tasks, out.splits,
                 out.btasks, out.wtasks, out.wsplits,
-                out.roots, out.rounds, out.segs,
+                out.roots, out.rounds, out.segs, out.wsteps,
                 out.maxd, out.cycles, out.overflow,
                 out.bag.count)
 
@@ -1064,7 +1091,8 @@ def integrate_family_walker_sharded(
               jnp.asarray(bag_th), jnp.asarray(bag_meta),
               jnp.asarray(counts))
     (acc_c, tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c, rounds_c,
-     segs_c, maxd_c, cycles_c, ovf_c, left_c) = jax.device_get(out)
+     segs_c, wsteps_c, maxd_c, cycles_c, ovf_c, left_c) = \
+        jax.device_get(out)
     wall = time.perf_counter() - t0
 
     if bool(np.any(ovf_c)):
@@ -1101,7 +1129,7 @@ def integrate_family_walker_sharded(
         n_chips=n_dev,
         tasks_per_chip=tasks_per_chip,
     )
-    denom = segs * seg_iters * lanes
+    denom = int(np.sum(wsteps_c)) * lanes
     return WalkerResult(
         areas=areas,
         metrics=metrics,
