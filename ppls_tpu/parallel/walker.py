@@ -455,6 +455,10 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
     carry = _bank_and_refill(carry, f_ds, m, lanes)   # initial seeding
     min_active = jnp.int32(int(lanes * min_active_frac))
     big_active = jnp.int32((3 * lanes) // 4)
+    # max_segments keeps its pre-adaptive WORK semantics: a budget of
+    # max_segments * seg_iters kernel iterations per walk phase (the big
+    # kernel is only selected when it fits the remaining budget).
+    step_budget = jnp.int32(max_segments * seg_iters)
 
     def cond(c: _WalkCarry):
         idle = _idle_lanes(c.lanes)
@@ -464,11 +468,13 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
                                 jnp.logical_and(queue_left > 0,
                                                 active + queue_left
                                                 >= min_active))
-        return jnp.logical_and(useful, c.segs < max_segments)
+        return jnp.logical_and(useful, c.steps < step_budget)
 
     def body(c: _WalkCarry):
         active = lanes - _idle_lanes(c.lanes)
-        use_big = active >= big_active
+        use_big = jnp.logical_and(
+            active >= big_active,
+            c.steps + seg_iters * big_mult <= step_budget)
         new_lanes = lax.cond(use_big, run_segment_big, run_segment, c.lanes)
         si_used = jnp.where(use_big, jnp.int32(seg_iters * big_mult),
                             jnp.int32(seg_iters))
@@ -961,6 +967,10 @@ def resume_family_walker(
         fresh, bag_cols, count, acc=np.zeros(m, np.float64),
         totals={"tasks": 0, "splits": 0, "iters": 0, "max_depth": 0})
     totals = dict(totals)
+    # snapshots from before the adaptive-segment change lack "wsteps";
+    # estimate it as segs * seg_iters (the pre-adaptive identity) so the
+    # reported lane_efficiency stays meaningful instead of inflated.
+    totals.setdefault("wsteps", int(totals.get("segs", 0)) * int(seg_iters))
     totals["acc"] = acc
     return integrate_family_walker(
         f_theta, f_ds, theta, bounds, eps, chunk=chunk, capacity=capacity,
