@@ -21,11 +21,21 @@ Correctness gates, in order:
    real f64 on the CPU: measures the true cross-implementation error),
 3. achieved abs error vs the mpmath closed form (north-star pair).
 
+Infra-vs-numerics failure policy (round-3 lesson: BENCH_r03 recorded
+0.0 for the whole round because one transient tunnel drop during warmup
+— "response body closed" — hit a no-retry path): every device-touching
+section runs under a bounded retry that retries ONLY transient
+infrastructure errors (tunnel/connection/INTERNAL strings). Numerical
+failures — NaN areas, gate misses, non-convergence — still fail fast
+with value 0.0, exactly as before. Attempt diagnostics are recorded in
+the JSON either way.
+
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 """
 
 import json
+import os
 import sys
 import time
 
@@ -37,50 +47,140 @@ BOUNDS = (1e-4, 1.0)
 REPEATS = 5        # median-of-N: the tunneled device shows bursty
                    # ~±30% slowdowns, so a time-weighted mean is noisy
 CPU_SAMPLE = 8     # C-baseline scales actually timed
+CPU_MAX_PASSES = 5  # fastest-of-k passes for a contention-stable C rate
+CPU_TARGET_COV = 0.10
+
+# Substrings that mark an exception as transient INFRASTRUCTURE (the
+# tunneled-device failure modes observed across rounds), never produced
+# by this framework's own numerical guards (those say "non-finite",
+# "did not converge", "overflowed", "mismatch").
+TRANSIENT_MARKERS = (
+    "remote_compile", "response body", "read body", "connection",
+    "Connection", "socket", "tunnel", "INTERNAL:", "UNAVAILABLE",
+    "DEADLINE_EXCEEDED", "ABORTED", "heartbeat", "Broken pipe",
+)
+MAX_ATTEMPTS = 3
+
+
+def is_transient(msg: str) -> bool:
+    """True when an exception message matches a known transient
+    infrastructure failure (retry) rather than a numerical one (fail)."""
+    return any(marker in msg for marker in TRANSIENT_MARKERS)
+
+
+def with_retry(fn, attempts_log, what="device section"):
+    """Run ``fn`` with up to MAX_ATTEMPTS tries, retrying ONLY transient
+    infra errors. FloatingPointError (the engine's NaN guard) and any
+    non-transient exception propagate immediately. Each retried error is
+    appended to ``attempts_log`` for the JSON record."""
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        if attempt == 1 and os.environ.pop("PPLS_BENCH_INJECT_TRANSIENT",
+                                           None):
+            # test hook, consumed on first use so it injects exactly one
+            # failure per process: prove a first-attempt tunnel drop
+            # still yields a valid record (VERDICT r3 #1 criterion)
+            attempts_log.append("injected: INTERNAL: simulated tunnel drop")
+            log(f"[bench] {what}: injected transient error "
+                f"(attempt 1/{MAX_ATTEMPTS}); retrying")
+            continue
+        try:
+            return fn()
+        except FloatingPointError:
+            raise                      # numerical NaN guard: never retry
+        except Exception as e:         # noqa: BLE001 — classified below
+            msg = f"{type(e).__name__}: {e}"
+            if is_transient(msg) and attempt < MAX_ATTEMPTS:
+                attempts_log.append(msg[:300])
+                log(f"[bench] {what}: transient infra error "
+                    f"(attempt {attempt}/{MAX_ATTEMPTS}): "
+                    f"{msg[:120]} ... retrying in 10s")
+                time.sleep(10)
+                continue
+            raise
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def fail(msg):
-    print(json.dumps({"metric": "subintervals evaluated/sec/chip",
-                      "value": 0.0, "unit": "subintervals/s/chip",
-                      "vs_baseline": 0.0, "error": msg}))
+def fail(msg, attempts_log=None):
+    rec = {"metric": "subintervals evaluated/sec/chip",
+           "value": 0.0, "unit": "subintervals/s/chip",
+           "vs_baseline": 0.0, "error": msg}
+    if attempts_log:
+        rec["transient_retries"] = attempts_log
+    print(json.dumps(rec))
     return 1
 
 
 def run_cpu_baseline(theta):
     """Sequential C reference on a sample of the family; returns
-    (tasks_per_sec, evals_per_sec, {scale: area})."""
+    (tasks_per_sec, evals_per_sec, {scale: area}, stability_info).
+
+    The host is shared and bursty (the round-3 driver measured 25.5 M
+    subint/s where a contended rerun saw 12.4 M — a 2x swing in the
+    vs_baseline denominator). Fastest-of-k per scale over up to
+    CPU_MAX_PASSES passes converges on the uncontended rate: the minimum
+    wall time is the one with the least stolen CPU. Stop early once the
+    per-pass aggregate rates' coefficient of variation < CPU_TARGET_COV.
+    """
     from ppls_tpu.backends.mpi_backend import build_seq, run_seq_family
 
     if build_seq() is None:
-        return None, None, {}
-    total_tasks = 0
-    total_evals = 0
-    total_time = 0.0
+        return None, None, {}, {}
+    sample = [float(s) for s in theta[:: max(len(theta) // CPU_SAMPLE, 1)]]
+    best_time = {}           # scale -> fastest wall time seen
+    tasks_by_scale = {}
+    evals_by_scale = {}
     areas = {}
-    for s in theta[:: max(len(theta) // CPU_SAMPLE, 1)]:
-        d = run_seq_family("sin_recip_scaled", float(s), *BOUNDS, EPS)
-        total_tasks += d["tasks"]
-        total_evals += d["evals"]
-        total_time += d["wall_time_s"]
-        areas[float(s)] = d["area"]
-    return total_tasks / total_time, total_evals / total_time, areas
+    pass_rates = []
+    for p in range(CPU_MAX_PASSES):
+        pass_tasks = 0
+        pass_time = 0.0
+        for s in sample:
+            d = run_seq_family("sin_recip_scaled", s, *BOUNDS, EPS)
+            tasks_by_scale[s] = d["tasks"]
+            evals_by_scale[s] = d["evals"]
+            areas[s] = d["area"]
+            best_time[s] = min(best_time.get(s, np.inf), d["wall_time_s"])
+            pass_tasks += d["tasks"]
+            pass_time += d["wall_time_s"]
+        pass_rates.append(pass_tasks / pass_time)
+        cov = (float(np.std(pass_rates) / np.mean(pass_rates))
+               if len(pass_rates) >= 2 else np.inf)
+        log(f"[bench] C pass {p + 1}: {pass_rates[-1]/1e6:.1f} M "
+            f"subint/s (CoV so far: "
+            f"{'n/a' if cov == np.inf else f'{cov:.3f}'})")
+        if len(pass_rates) >= 2 and cov < CPU_TARGET_COV:
+            break
+    total_tasks = sum(tasks_by_scale.values())
+    total_evals = sum(evals_by_scale.values())
+    total_best = sum(best_time.values())
+    stability = {
+        "cpu_passes": len(pass_rates),
+        "cpu_pass_rates": [round(r, 1) for r in pass_rates],
+        "cpu_rate_cov": round(float(np.std(pass_rates)
+                                    / np.mean(pass_rates)), 4),
+        "cpu_count": os.cpu_count(),
+        "cpu_loadavg_1m": round(os.getloadavg()[0], 2),
+    }
+    return (total_tasks / total_best, total_evals / total_best, areas,
+            stability)
 
 
 def main():
     theta = 1.0 + np.arange(M) / M
+    attempts_log = []
 
     log(f"[bench] C baseline: {CPU_SAMPLE} of {M} scales at eps={EPS} ...")
-    cpu_rate, cpu_evals_rate, cpu_areas = run_cpu_baseline(theta)
+    cpu_rate, cpu_evals_rate, cpu_areas, cpu_stability = \
+        run_cpu_baseline(theta)
     if cpu_rate:
-        log(f"[bench] C seq: {cpu_rate/1e6:.1f} M subintervals/s "
+        log(f"[bench] C seq (fastest-of-{cpu_stability['cpu_passes']}): "
+            f"{cpu_rate/1e6:.1f} M subintervals/s "
             f"({cpu_evals_rate/1e6:.1f} M evals/s)")
 
-    from ppls_tpu.models.integrands import family_exact, get_family, \
-        get_family_ds
+    from ppls_tpu.models.integrands import get_family, get_family_ds
     from ppls_tpu.parallel.walker import integrate_family_walker
 
     f_theta = get_family("sin_recip_scaled")
@@ -91,13 +191,16 @@ def main():
 
     log("[bench] TPU warmup/compile ...")
     try:
-        res = integrate_family_walker(f_theta, f_ds, theta, BOUNDS, EPS,
-                                      **kw)
-    except (FloatingPointError, RuntimeError) as e:
+        res = with_retry(
+            lambda: integrate_family_walker(f_theta, f_ds, theta, BOUNDS,
+                                            EPS, **kw),
+            attempts_log, what="warmup")
+    except Exception as e:      # noqa: BLE001 — one JSON line always
         # The engine raises on non-finite areas / overflow; keep the
         # one-JSON-line contract so the driver records the failure
-        # instead of a traceback.
-        return fail(str(e))
+        # instead of a traceback. (Transient infra errors only land here
+        # after MAX_ATTEMPTS retries inside with_retry.)
+        return fail(f"{type(e).__name__}: {e}", attempts_log)
 
     # Gate 2: areas vs the C baseline. NaN-PROOF: the engine raised above
     # on any non-finite area (a NaN slipping into Python's max() silently
@@ -117,24 +220,50 @@ def main():
 
     # North-star metric pair (BASELINE.json): throughput AND achieved abs
     # error @ eps. Exact values from the host-side mpmath closed form
-    # (x*sin(t/x) - t*Ci(t/x)), evaluated for the full family.
-    exact = family_exact("sin_recip_scaled", *BOUNDS, theta)
-    abs_err = float(np.max(np.abs(res.areas - np.asarray(exact))))
-    # Gate 3: eps is a per-interval tolerance so global error accumulates
-    # over leaves; measured 2.7e-5 on this workload. 1e-3 catches any
-    # gross precision regression (and runs even without the C toolchain).
-    if not (abs_err <= 1e-3):
-        return fail(f"achieved abs error vs exact: {abs_err:.3e}")
-    log(f"[bench] achieved abs error vs exact (mpmath, all {M} scales): "
-        f"max = {abs_err:.3e}")
+    # (x*sin(t/x) - t*Ci(t/x)), evaluated for the full family. Guard the
+    # mpmath import (ADVICE r3): a host without it must skip gate 3 with
+    # an explicit flag, not die with a traceback mid-bench.
+    abs_err = None
+    try:
+        from ppls_tpu.models.integrands import family_exact
+        exact = family_exact("sin_recip_scaled", *BOUNDS, theta)
+    except ImportError:
+        log("[bench] mpmath unavailable: skipping the exact-value gate "
+            "(recorded as exact_ungated)")
+    else:
+        abs_err = float(np.max(np.abs(res.areas - np.asarray(exact))))
+        # Gate 3: eps is a per-interval tolerance so global error
+        # accumulates over leaves; measured 2.7e-5 on this workload. 1e-3
+        # catches any gross precision regression (and runs even without
+        # the C toolchain).
+        if not (abs_err <= 1e-3):
+            return fail(f"achieved abs error vs exact: {abs_err:.3e}")
+        log(f"[bench] achieved abs error vs exact (mpmath, all {M} "
+            f"scales): max = {abs_err:.3e}")
 
     log(f"[bench] timing {REPEATS} runs (median) ...")
     rates = []
     eval_rates = []
-    for _ in range(REPEATS):
+
+    def timed_run():
         t0 = time.perf_counter()
         r = integrate_family_walker(f_theta, f_ds, theta, BOUNDS, EPS, **kw)
         dt = time.perf_counter() - t0
+        return r, dt
+
+    for _ in range(REPEATS):
+        try:
+            r, dt = with_retry(timed_run, attempts_log, what="timing run")
+        except Exception as e:      # noqa: BLE001 — one JSON line always
+            msg = f"{type(e).__name__}: {e}"
+            if rates and is_transient(msg):
+                # partial data beats a zero — but ONLY for infra errors;
+                # a numerical failure (NaN guard, non-convergence) must
+                # zero the record even with timing runs in hand.
+                attempts_log.append(f"timing aborted: {msg[:300]}")
+                log(f"[bench] timing aborted after {len(rates)} runs: {e}")
+                break
+            return fail(msg, attempts_log)
         rates.append(r.metrics.tasks / dt)
         eval_rates.append(r.metrics.integrand_evals / dt)
     value = float(np.median(rates))  # one chip
@@ -158,11 +287,18 @@ def main():
             r.metrics.integrand_evals / r.metrics.tasks, 3),
         "engine": "walker",
         "walker_fraction": round(r.walker_fraction, 4),
+        "lane_efficiency": round(r.lane_efficiency, 4),
         # the tunneled device shows bursty slowdowns; the per-run rates
         # document the spread behind the median (167-414 M measured for
         # identical binaries across one day)
         "per_run_rates": [round(v, 1) for v in rates],
+        "timed_runs": len(rates),
     }
+    if abs_err is None:
+        out["exact_ungated"] = True
+    if attempts_log:
+        out["transient_retries"] = attempts_log
+    out.update(cpu_stability)
     if cpu_rate:
         out["evals_per_task_cpu"] = round(cpu_evals_rate / cpu_rate, 3)
     else:

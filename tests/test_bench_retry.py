@@ -1,0 +1,93 @@
+"""The bench's transient-infra retry (VERDICT r3 #1).
+
+Round 3's official perf artifact recorded 0.0 because ONE transient
+tunnel drop ("response body closed") during warmup hit a no-retry path.
+These tests pin the fix: transient infrastructure errors retry (bounded)
+and are recorded; numerical failures — the NaN guard, gate misses —
+still fail immediately.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def test_transient_classification():
+    # the exact round-3 killer
+    assert bench.is_transient(
+        "INTERNAL: http://127.0.0.1:8083/remote_compile: read body: "
+        "response body closed before all bytes were read")
+    assert bench.is_transient("UNAVAILABLE: socket closed")
+    assert bench.is_transient("ConnectionResetError: peer reset")
+    # the framework's own numerical guards must NOT look transient
+    assert not bench.is_transient(
+        "walker produced 3/1024 non-finite areas (NaN/inf)")
+    assert not bench.is_transient("area mismatch vs C baseline: 1.2e-3")
+    assert not bench.is_transient(
+        "walker did not converge in 64 cycles (12 tasks left)")
+    assert not bench.is_transient("walker bag overflowed; raise capacity")
+
+
+def test_retry_recovers_from_transient(monkeypatch):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "INTERNAL: remote_compile: response body closed")
+        return 42
+
+    attempts = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.with_retry(flaky, attempts) == 42
+    assert calls["n"] == 2
+    assert len(attempts) == 1 and "remote_compile" in attempts[0]
+
+
+def test_retry_exhausts_then_raises(monkeypatch):
+    def always_down():
+        raise RuntimeError("UNAVAILABLE: tunnel down")
+
+    attempts = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    with pytest.raises(RuntimeError, match="tunnel down"):
+        bench.with_retry(always_down, attempts)
+    assert len(attempts) == bench.MAX_ATTEMPTS - 1
+
+
+def test_numerical_failures_never_retry(monkeypatch):
+    calls = {"n": 0}
+
+    def nan_guard():
+        calls["n"] += 1
+        raise FloatingPointError("walker produced 5/1024 non-finite areas")
+
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    with pytest.raises(FloatingPointError):
+        bench.with_retry(nan_guard, [])
+    assert calls["n"] == 1
+
+    calls["n"] = 0
+
+    def engine_error():
+        calls["n"] += 1
+        raise RuntimeError("walker did not converge in 64 cycles")
+
+    with pytest.raises(RuntimeError):
+        bench.with_retry(engine_error, [])
+    assert calls["n"] == 1
+
+
+def test_injected_transient_still_succeeds(monkeypatch):
+    """The VERDICT acceptance criterion: a simulated transient exception
+    on the first attempt still yields a valid result."""
+    monkeypatch.setenv("PPLS_BENCH_INJECT_TRANSIENT", "1")
+    attempts = []
+    assert bench.with_retry(lambda: "ok", attempts) == "ok"
+    assert attempts and "injected" in attempts[0]
