@@ -63,12 +63,18 @@ def neumaier_add_host(s: float, c: float, x: float) -> Tuple[float, float]:
 
 def segment_sum_auto(fam: jnp.ndarray, leaf: jnp.ndarray, m: int,
                      n: int) -> jnp.ndarray:
-    """Exact per-family sum with the cheapest exact lowering for the
-    family count (measured on v5e, chunk=2^15): a plain sum for m == 1,
-    the O(m*n) f64 broadcast-mask reduce for m <= 256 (~27 us at m=128),
-    and the digit-plane MXU reduction beyond (~75 us at m=1024 vs
-    ~216 us for the mask). All three are bit-equivalent to a fixed-order
-    sequential f64 accumulation per family."""
+    """Per-family sum with the cheapest adequate lowering for the family
+    count (measured on v5e, chunk=2^15): a plain sum for m == 1, the
+    O(m*n) f64 broadcast-mask reduce for m <= 256 (~27 us at m=128), and
+    the digit-plane MXU reduction beyond (~75 us at m=1024 vs ~216 us
+    for the mask). Each tier is deterministic for a fixed shape, but
+    only :func:`exact_segment_sum` is error-free: the m == 1 and
+    m <= 256 tiers are ordinary XLA f64 reductions whose tree order
+    (and hence rounding) is backend-dependent, so results can shift by
+    ~1 f64 ulp per reduction when m crosses a tier boundary (e.g. the
+    sharded walker's m_local <= 256 vs the single-chip m=1024) — below
+    every engine's stated noise floor, and callers that need the exact
+    contract call :func:`exact_segment_sum` directly."""
     if m == 1:
         return jnp.sum(leaf)[None]
     if m <= 256:

@@ -74,9 +74,9 @@ def simpson_rect_batch(lx: jnp.ndarray, rx: jnp.ndarray,
     g = [[f(lx + i * hx, ly + j * hy) for j in range(5)] for i in range(5)]
 
     def panel(i0, j0):
-        # one tensor-product Simpson panel on the 3x3 sub-grid starting
-        # at (i0, j0) with stride s in grid steps; weights (1,4,1)^2/36
-        # times the panel area.
+        # one tensor-product Simpson panel on the stride-1 3x3 sub-grid
+        # starting at (i0, j0); weights (1,4,1)^2/36 times the panel
+        # area. (The coarse stride-2 panel is inlined below.)
         w = (1.0, 4.0, 1.0)
         tot = 0.0
         for a in range(3):

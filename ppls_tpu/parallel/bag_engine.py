@@ -255,6 +255,14 @@ def _family_ckpt_identity(engine: str, f_theta, eps: float, m: int,
                             float(eps), m, theta, bounds)
 
 
+def _clear_snapshot(path) -> None:
+    """Remove a run's snapshot after successful completion, so a repeat
+    invocation starts fresh instead of resuming a finished run's tail."""
+    import os
+    if path is not None and os.path.exists(path):
+        os.unlink(path)
+
+
 def _snapshot_bag(path: str, identity: dict, s: BagState) -> None:
     """Pull ONLY the live prefix (pow2-bucketed slice to bound the
     number of compiled slice shapes) and write an atomic snapshot."""
@@ -375,6 +383,10 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
         raise FloatingPointError(
             f"bag engine produced {bad}/{acc_np.size} non-finite areas "
             f"(NaN/inf) — refusing to report garbage")
+    # A finished run's last mid-run snapshot must not linger: re-invoking
+    # the same command would resume it and silently replay only the tail
+    # of the previous run (ADVICE r3).
+    _clear_snapshot(checkpoint_path)
 
     tasks = int(tasks)
     iters = int(iters)

@@ -895,6 +895,10 @@ def integrate_family_walker(
         raise FloatingPointError(
             f"walker produced {bad}/{acc.size} non-finite areas "
             f"(NaN/inf) — refusing to report garbage")
+    # A finished run must not leave its last mid-run snapshot behind
+    # (ADVICE r3: re-invoking would silently resume and replay the tail).
+    from ppls_tpu.parallel.bag_engine import _clear_snapshot
+    _clear_snapshot(checkpoint_path)
 
     tasks = int(tasks)
     wtasks = int(wtasks)

@@ -49,6 +49,25 @@ def test_bag_checkpointed_uninterrupted_matches(tmp_path):
     assert res.metrics.tasks == base.metrics.tasks
 
 
+def test_completed_run_clears_snapshot(tmp_path):
+    # A finished run must delete its last mid-run snapshot (ADVICE r3):
+    # otherwise re-invoking the identical command finds the file and
+    # silently resumes, replaying only the tail of the previous run.
+    import os
+    path = str(tmp_path / "done.ckpt")
+    res = integrate_family(F, THETA, BOUNDS, EPS, **BAG_KW,
+                           checkpoint_path=path, checkpoint_every=8)
+    assert res.metrics.tasks > 0
+    assert not os.path.exists(path)
+
+    wpath = str(tmp_path / "done_w.ckpt")
+    wres = integrate_family_walker(F, F_DS, THETA, BOUNDS, EPS, **WALK_KW,
+                                   checkpoint_path=wpath,
+                                   checkpoint_every=2)
+    assert wres.metrics.tasks > 0
+    assert not os.path.exists(wpath)
+
+
 def test_bag_resume_rejects_mismatched_identity(tmp_path):
     path = str(tmp_path / "bag.ckpt")
     with pytest.raises(RuntimeError, match="simulated crash"):
