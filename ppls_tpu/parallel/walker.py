@@ -7,7 +7,7 @@ pushes, and the per-op scheduling gaps between them — a hard ceiling of
 round 2; see tools/profile_bag.py and the BENCH history).
 
 This engine removes the scheduling tax entirely for the hot phase. Each
-of 2^15 SIMD lanes walks ONE task's whole refinement subtree depth-first,
+of 2^14 SIMD lanes walks ONE task's whole refinement subtree depth-first,
 *in registers*, using the implicit binary-tree address (i, d): the
 current node of root [A, A+W] is [A + i*W*2^-d, A + (i+1)*W*2^-d].
 
@@ -86,7 +86,12 @@ from ppls_tpu.parallel.bag_engine import (
 )
 from ppls_tpu.utils.metrics import RunMetrics
 
-DEFAULT_LANES = 1 << 15     # SIMD lanes of the walker (multiple of 128)
+DEFAULT_LANES = 1 << 14     # SIMD lanes of the walker (multiple of 128).
+                            # 2^14 measured fastest on v5e (783 M subint/s
+                            # vs 569 M at 2^15, 407 M at 2^16: the larger
+                            # states pressure VMEM and slow every step);
+                            # occupancy losses are covered by early-exit
+                            # segments + refill, not by more lanes.
 MAX_REL_DEPTH = 30          # i must stay in int32
 
 # flags bits
@@ -97,6 +102,13 @@ _OVF = 8                    # lane parked on depth overflow: its partial
                             # accumulator is banked, but it must NOT be
                             # refilled — its (i, d) pending set feeds the
                             # mop-up phase
+_MODE_INIT = 16             # freshly refilled root: next eval is f(left)
+                            # (the step after, via _MODE_LOAD, is
+                            # f(right)) — root endpoints are evaluated
+                            # IN-KERNEL, overlapped with other lanes'
+                            # walk steps, instead of at the XLA refill
+                            # boundary where the fenced-ds evaluation of
+                            # 2 x lanes points cost ~1 ms per boundary
 
 
 class WalkState(NamedTuple):
@@ -149,16 +161,27 @@ def _ctz(k):
 
 
 def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
-                     interpret: bool = False):
-    """Build the segment kernel: seg_iters walker steps over all lanes.
+                     interpret: bool = False, early_exit: bool = False):
+    """Build the segment kernel: up to seg_iters walker steps over all
+    lanes.
 
     ``f_ds((hi, lo) x, (hi, lo) theta) -> (hi, lo)`` is the ds integrand.
+
+    With ``early_exit`` the kernel takes two (1, 1) int32 SMEM scalars
+    (live-lane exit threshold, iteration cap <= seg_iters) and RETURNS
+    the executed step count alongside the state: the segment stops as
+    soon as the live-lane count drops to the threshold, so parked lanes
+    never burn more than ~1/(1-thresh_frac) of the segment's lane-steps
+    waiting for the XLA-level bank/refill boundary (the round-3 design
+    ran fixed 32/256-step segments; measured lane efficiency 0.30 —
+    most of the loss was parked lanes inside segments, VERDICT r3 #2).
     """
     eps32 = np.float32(eps)
 
     def step(s: WalkState) -> WalkState:
         parked = (s.flags & _PARKED) != 0
         mode_load = (s.flags & _MODE_LOAD) != 0
+        mode_init = (s.flags & _MODE_INIT) != 0
         live = jnp.logical_not(parked)
 
         w, x0, x1 = _node_geometry(s)
@@ -166,6 +189,7 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
 
         # the single eval of this step (parked lanes eval a benign point)
         xq = dsk.ds_where(mode_load, x1, mid)
+        xq = dsk.ds_where(mode_init, x0, xq)
         xq = dsk.ds_where(parked, (jnp.ones_like(xq[0]),
                                    jnp.zeros_like(xq[1])), xq)
         fq = f_ds(xq, (s.th_h, s.th_l))
@@ -181,7 +205,8 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         err = dsk.ds_abs(dsk.ds_sub(val, lr))
         split = (err[0] + err[1]) > eps32
 
-        testing = jnp.logical_and(live, jnp.logical_not(mode_load))
+        testing = jnp.logical_and(
+            live, jnp.logical_not(jnp.logical_or(mode_load, mode_init)))
         do_split = jnp.logical_and(testing, split)
         # depth guard: an overflow lane parks un-finished; the mop-up
         # phase expands its pending nodes into bag tasks.
@@ -201,14 +226,18 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         d_next = jnp.where(do_split, s.d + 1,
                            jnp.where(adv, s.d - t, s.d))
         # caches: descend keeps f(left), f(mid) becomes f(right);
-        # advance shifts f(right) to f(left) and must reload f(right).
+        # advance shifts f(right) to f(left) and must reload f(right);
+        # an INIT step stores f(left) and hands off to a LOAD step.
         new_fl = dsk.ds_where(adv, fr, fl)
+        new_fl = dsk.ds_where(mode_init, fq, new_fl)
         new_fr = dsk.ds_where(do_split, fq, fr)
         new_fr = dsk.ds_where(mode_load, fq, new_fr)
 
         flags = s.flags
         flags = jnp.where(adv, flags | _MODE_LOAD, flags)
         flags = jnp.where(mode_load, flags & ~_MODE_LOAD, flags)
+        flags = jnp.where(mode_init,
+                          (flags & ~_MODE_INIT) | _MODE_LOAD, flags)
         flags = jnp.where(fin, flags | _PARKED, flags)
         flags = jnp.where(ovf, flags | (_PARKED | _OVF), flags)
 
@@ -228,35 +257,100 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
 
     n_fields = len(WalkState._fields)
 
-    def kernel(*refs):
-        in_refs = refs[:n_fields]
-        out_refs = refs[n_fields:]
+    if not early_exit:
+        def kernel(*refs):
+            in_refs = refs[:n_fields]
+            out_refs = refs[n_fields:]
+            s = WalkState(*(r[:] for r in in_refs))
+
+            def body(_, s):
+                return step(s)
+
+            out = lax.fori_loop(0, seg_iters, body, s)
+            for r, v in zip(out_refs, out):
+                r[:] = v
+
+        def run_segment(state: WalkState) -> WalkState:
+            shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                           for x in state)
+            out = pl.pallas_call(
+                kernel,
+                out_shape=shapes,
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_fields,
+                out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * n_fields,
+                interpret=interpret,
+            )(*state)
+            return WalkState(*out)
+
+        return run_segment
+
+    def kernel_ee(*refs):
+        thresh_ref, cap_ref = refs[:2]
+        in_refs = refs[2:2 + n_fields]
+        out_refs = refs[2 + n_fields:2 + 2 * n_fields]
+        steps_ref = refs[2 + 2 * n_fields]
         s = WalkState(*(r[:] for r in in_refs))
+        thresh = thresh_ref[0, 0]
+        cap = cap_ref[0, 0]
 
-        def body(_, s):
-            return step(s)
+        def live_count(st):
+            # f32 accumulation: exact for lanes <= 2^24, and avoids the
+            # int64-promoting integer-sum path Mosaic cannot lower under
+            # global x64
+            live = ((st.flags & _PARKED) == 0).astype(jnp.float32)
+            return jnp.sum(live).astype(jnp.int32)
 
-        out = lax.fori_loop(0, seg_iters, body, s)
+        def cond(carry):
+            k, st = carry
+            # always take at least one step (the XLA loop guarantees
+            # progress is useful before launching), never exceed the cap
+            return jnp.logical_or(
+                k == 0,
+                jnp.logical_and(k < cap, live_count(st) > thresh))
+
+        def body(carry):
+            k, st = carry
+            return k + 1, step(st)
+
+        k, out = lax.while_loop(cond, body, (jnp.int32(0), s))
         for r, v in zip(out_refs, out):
             r[:] = v
+        steps_ref[0, 0] = k
 
-    def run_segment(state: WalkState) -> WalkState:
-        shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in state)
+    def run_segment_ee(state: WalkState, thresh, cap):
+        shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                       for x in state)
+        smem = pl.BlockSpec(memory_space=pltpu.SMEM)
         out = pl.pallas_call(
-            kernel,
-            out_shape=shapes,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * n_fields,
-            out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * n_fields,
+            kernel_ee,
+            out_shape=shapes + (jax.ShapeDtypeStruct((1, 1), jnp.int32),),
+            in_specs=[smem, smem]
+            + [pl.BlockSpec(memory_space=pltpu.VMEM)] * n_fields,
+            out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * n_fields
+            + (smem,),
             interpret=interpret,
-        )(*state)
-        return WalkState(*out)
+        )(thresh.reshape(1, 1).astype(jnp.int32),
+          cap.reshape(1, 1).astype(jnp.int32), *state)
+        return WalkState(*out[:n_fields]), out[n_fields][0, 0]
 
-    return run_segment
+    return run_segment_ee
 
 
 # ---------------------------------------------------------------------------
 # XLA orchestration
 # ---------------------------------------------------------------------------
+
+
+S_CAP = 1024    # per-segment stats ring rows (VERDICT r3 #10): segments
+                # beyond the cap overwrite the last row
+C_CAP = 64      # per-cycle stats ring rows
+
+# column order of the per-segment stats ring (one row per kernel segment)
+SEG_STAT_FIELDS = ("steps", "live_at_exit", "queue_left", "refilled")
+# column order of the per-cycle stats ring (one row per engine cycle)
+CYCLE_STAT_FIELDS = ("bred_roots", "breed_iters", "roots_consumed",
+                     "walker_tasks", "walker_steps", "segments",
+                     "expand_tasks", "drain_tasks")
 
 
 class _WalkCarry(NamedTuple):
@@ -265,8 +359,10 @@ class _WalkCarry(NamedTuple):
     cursor: jnp.ndarray     # int32 — next unconsumed root in [0, bag.count)
     acc: jnp.ndarray        # (m,) f64 per-family banked areas
     segs: jnp.ndarray       # int32 segments (bank/refill boundaries)
-    steps: jnp.ndarray      # int32 kernel iterations executed (adaptive
-                            # segment lengths make this != segs*seg_iters)
+    steps: jnp.ndarray      # int32 kernel iterations executed (early exit
+                            # makes this != segs*seg_iters)
+    gsegs: jnp.ndarray      # int32 global segment counter (ring index)
+    seg_stats: jnp.ndarray  # (S_CAP, len(SEG_STAT_FIELDS)) int32 ring
 
 
 def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
@@ -296,15 +392,10 @@ def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
     return out
 
 
-def _bank_and_refill(c: _WalkCarry, f_ds: Callable, m: int,
-                     lanes: int) -> _WalkCarry:
+def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
     """Credit finished lanes' accumulators to their families and hand
-    them fresh roots (one monotone gather from the root queue).
-
-    Root-endpoint integrand values are computed in ds (the kernel's own
-    working precision), not emulated f64: the f64 transcendental on
-    2 x lanes points cost more than the whole segment kernel (measured
-    ~2.6 ms vs 2.1 ms at lanes=2^15 on v5e)."""
+    them fresh roots (one monotone gather from the root queue). Root
+    endpoint values are left to the kernel's INIT/LOAD steps."""
     s = c.lanes
     parked = ((s.flags & _PARKED) != 0).reshape(-1)
     has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
@@ -326,6 +417,18 @@ def _bank_and_refill(c: _WalkCarry, f_ds: Callable, m: int,
     rank = jnp.cumsum(refillable, dtype=jnp.int32) - 1
     avail = c.bag.count - c.cursor
     take = jnp.logical_and(refillable, rank < avail)
+    # MISCOMPILE GUARD — do not remove. Without this barrier XLA's
+    # simplifier mis-folds the routing when the lane state entering a
+    # walk phase is a compile-time constant (the fresh-lane seeding
+    # refill): observed on both CPU and TPU backends as `take` landing
+    # on every 8th lane while `cursor` still advances by sum(take)'s
+    # correct value — consumed roots silently vanish (round-4 width-
+    # conservation debug). Round 3 never hit it because the fenced-ds
+    # endpoint evaluation here acted as an accidental barrier; when the
+    # evals moved into the kernel (_MODE_INIT) the folding appeared.
+    # Forcing materialization of the routing mask restores correctness;
+    # cost is ~us per boundary on i32/bool vectors.
+    take, rank = lax.optimization_barrier((take, rank))
 
     # Consume from the TOP of the bred bag (cursor counts consumed
     # roots), so the unconsumed remainder [0, count - cursor) remains a
@@ -366,15 +469,12 @@ def _bank_and_refill(c: _WalkCarry, f_ds: Callable, m: int,
     a_h, a_l = to_ds(rl)
     w_h, w_l = to_ds(rr - rl)
     th_h, th_l = to_ds(rth)
-    # This runs at XLA level, so the FENCED ds module is mandatory: the
-    # fence-free kernel twin degrades to f32 accuracy under XLA's
-    # algebraic simplifier (measured 3.8e-8 per endpoint -> 1.3e-5 area
-    # error on the oscillatory workload when this used dsm=ds_kernel).
-    from ppls_tpu.ops import ds as ds_xla
-    flh, fll = f_ds((a_h, a_l), (th_h, th_l), dsm=ds_xla)
-    flh, fll = flh.reshape(rows, 128), fll.reshape(rows, 128)
-    frh, frl = f_ds(to_ds(rr), (th_h, th_l), dsm=ds_xla)
-    frh, frl = frh.reshape(rows, 128), frl.reshape(rows, 128)
+    # Root endpoint values f(left)/f(right) are NOT evaluated here: the
+    # kernel's _MODE_INIT/_MODE_LOAD steps compute them in ds on the
+    # refilled lanes' first two steps, overlapped with every other
+    # lane's walk. (Round 3 evaluated them here with the fenced-ds XLA
+    # module — correct, but ~1 ms of serialized fence chains per
+    # boundary, the dominant boundary cost at 150+ boundaries/run.)
     fam_new = (rmeta >> DEPTH_BITS).reshape(rows, 128)
     based_new = (rmeta & DEPTH_MASK).reshape(rows, 128)
 
@@ -390,7 +490,7 @@ def _bank_and_refill(c: _WalkCarry, f_ds: Callable, m: int,
     bank2 = bank.reshape(rows, 128)
     retire = jnp.logical_and(refillable, jnp.logical_not(take))
     flags = s.flags
-    flags = jnp.where(take2, zi, flags)                       # fresh TEST
+    flags = jnp.where(take2, jnp.int32(_MODE_INIT), flags)  # fresh INIT
     flags = jnp.where(retire.reshape(rows, 128),
                       jnp.int32(_PARKED | _NO_ROOT), flags)
 
@@ -398,8 +498,8 @@ def _bank_and_refill(c: _WalkCarry, f_ds: Callable, m: int,
         a_h=pick(a_h, s.a_h), a_l=pick(a_l, s.a_l),
         w_h=pick(w_h, s.w_h), w_l=pick(w_l, s.w_l),
         th_h=pick(th_h, s.th_h), th_l=pick(th_l, s.th_l),
-        fl_h=pick(flh, s.fl_h), fl_l=pick(fll, s.fl_l),
-        fr_h=pick(frh, s.fr_h), fr_l=pick(frl, s.fr_l),
+        fl_h=pick(z32, s.fl_h), fl_l=pick(z32, s.fl_l),
+        fr_h=pick(z32, s.fr_h), fr_l=pick(z32, s.fr_l),
         acc_h=jnp.where(bank2, z32, s.acc_h),
         acc_l=jnp.where(bank2, z32, s.acc_l),
         i=pick(zi, s.i), d=pick(zi, s.d),
@@ -410,7 +510,8 @@ def _bank_and_refill(c: _WalkCarry, f_ds: Callable, m: int,
     n_taken = jnp.sum(take, dtype=jnp.int32)
     return _WalkCarry(lanes=new_lanes, bag=c.bag,
                       cursor=c.cursor + n_taken, acc=acc,
-                      segs=c.segs + 1, steps=c.steps)
+                      segs=c.segs + 1, steps=c.steps,
+                      gsegs=c.gsegs, seg_stats=c.seg_stats)
 
 
 def _idle_lanes(s: WalkState):
@@ -419,22 +520,29 @@ def _idle_lanes(s: WalkState):
 
 def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
               m: int, seg_iters: int, max_segments: int,
-              min_active_frac: float, interpret: bool,
-              lanes: int) -> _WalkCarry:
+              min_active_frac: float, exit_frac: float,
+              suspend_frac: float, interpret: bool,
+              lanes: int, gsegs0, seg_stats0) -> _WalkCarry:
     """One walk phase (traced inline inside :func:`_run_cycles`).
 
-    Adaptive segment length: at high occupancy (>= 3/4 of lanes live —
-    early/mid walk, when most lanes are deep inside their subtrees) an
-    8x longer kernel segment runs between bank/refill boundaries,
-    cutting the per-boundary costs (the refill routing sorts + the
-    per-family segment sum, ~200 us at lanes=2^15/m=1024) by ~4x over
-    the phase; when occupancy decays the short segment keeps refill
-    latency low so parked lanes get fresh roots quickly.
+    Occupancy-aware segments: each kernel launch runs until the live
+    lane count drops to ``exit_frac * lanes`` (or ``seg_iters`` steps,
+    whichever first), then banks/refills at the XLA boundary. This
+    replaced round 3's fixed 32/256-step segments: with heavy-tailed
+    subtree sizes most lanes park early in a fixed segment and burn the
+    remainder (measured lane efficiency 0.30, VERDICT r3 #2).
+
+    Once the root queue is dry a boundary can't raise occupancy, so the
+    threshold switches to ``suspend_frac``: the kernel walks the tail
+    in one launch down to that floor, then the phase SUSPENDS — the
+    survivors' pending subtrees go back through expand -> re-breed into
+    fresh roots and the next cycle walks them at full occupancy.
+    (Round 3 walked dry tails all the way down to ``min_active_frac`` =
+    0.1: 44% of all kernel steps ran at ~0.25 occupancy, the single
+    largest efficiency loss in the segment trace.)
     """
-    run_segment = make_walk_kernel(f_ds, eps, seg_iters, interpret=interpret)
-    big_mult = 8
-    run_segment_big = make_walk_kernel(f_ds, eps, seg_iters * big_mult,
-                                       interpret=interpret)
+    run_segment = make_walk_kernel(f_ds, eps, seg_iters,
+                                   interpret=interpret, early_exit=True)
 
     rows = lanes // 128
     z32 = jnp.zeros((rows, 128), jnp.float32)
@@ -451,35 +559,51 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
     # so `segs` counts executed kernel segments only.
     carry = _WalkCarry(lanes=lane0, bag=bag, cursor=jnp.int32(0),
                        acc=jnp.zeros(m, jnp.float64), segs=jnp.int32(-1),
-                       steps=jnp.int32(0))
-    carry = _bank_and_refill(carry, f_ds, m, lanes)   # initial seeding
+                       steps=jnp.int32(0),
+                       gsegs=jnp.asarray(gsegs0, jnp.int32),
+                       seg_stats=seg_stats0)
+    carry = _bank_and_refill(carry, m, lanes)   # initial seeding
     min_active = jnp.int32(int(lanes * min_active_frac))
-    big_active = jnp.int32((3 * lanes) // 4)
-    # max_segments keeps its pre-adaptive WORK semantics: a budget of
-    # max_segments * seg_iters kernel iterations per walk phase (the big
-    # kernel is only selected when it fits the remaining budget).
+    exit_thresh = jnp.int32(int(lanes * exit_frac))
+    suspend_thresh = jnp.int32(int(lanes * suspend_frac))
+    # max_segments keeps its work-budget semantics: a budget of
+    # max_segments * seg_iters kernel iterations per walk phase (the
+    # per-segment cap shrinks to the remaining budget).
     step_budget = jnp.int32(max_segments * seg_iters)
 
     def cond(c: _WalkCarry):
         idle = _idle_lanes(c.lanes)
         active = lanes - idle
         queue_left = c.bag.count - c.cursor
-        useful = jnp.logical_or(active >= min_active,
+        # engagement floor: min_active with roots to refill from,
+        # suspend_frac once the queue is dry (suspend the tail early and
+        # let the next cycle re-breed it instead of walking it thin)
+        floor = jnp.where(queue_left > 0, min_active,
+                          jnp.maximum(min_active, suspend_thresh))
+        useful = jnp.logical_or(active >= floor,
                                 jnp.logical_and(queue_left > 0,
                                                 active + queue_left
                                                 >= min_active))
         return jnp.logical_and(useful, c.steps < step_budget)
 
     def body(c: _WalkCarry):
-        active = lanes - _idle_lanes(c.lanes)
-        use_big = jnp.logical_and(
-            active >= big_active,
-            c.steps + seg_iters * big_mult <= step_budget)
-        new_lanes = lax.cond(use_big, run_segment_big, run_segment, c.lanes)
-        si_used = jnp.where(use_big, jnp.int32(seg_iters * big_mult),
-                            jnp.int32(seg_iters))
-        out = _bank_and_refill(c._replace(lanes=new_lanes), f_ds, m, lanes)
-        return out._replace(steps=out.steps + si_used)
+        queue_left = c.bag.count - c.cursor
+        # queue dry -> no refill can raise occupancy; walk the tail in
+        # one launch down to the suspension floor instead.
+        thresh = jnp.where(queue_left > 0, exit_thresh,
+                           jnp.maximum(min_active, suspend_thresh))
+        cap = jnp.clip(step_budget - c.steps, 1, seg_iters)
+        new_lanes, si_used = run_segment(c.lanes, thresh, cap)
+        live_exit = lanes - jnp.sum((new_lanes.flags & _PARKED) != 0,
+                                    dtype=jnp.int32)
+        out = _bank_and_refill(c._replace(lanes=new_lanes), m, lanes)
+        row = jnp.stack([si_used, live_exit, queue_left,
+                         out.cursor - c.cursor]).astype(jnp.int32)
+        stats = lax.dynamic_update_slice(
+            out.seg_stats, row[None, :],
+            (jnp.minimum(out.gsegs, S_CAP - 1), jnp.int32(0)))
+        return out._replace(steps=out.steps + si_used,
+                            gsegs=out.gsegs + 1, seg_stats=stats)
 
     out = lax.while_loop(cond, body, carry)
     # Final credit: lanes still mid-walk (suspended) hold accepted-leaf
@@ -613,18 +737,23 @@ class _CycleCarry(NamedTuple):
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32
     overflow: jnp.ndarray   # bool
+    seg_stats: jnp.ndarray  # (S_CAP, len(SEG_STAT_FIELDS)) i32 ring
+    cyc_stats: jnp.ndarray  # (C_CAP, len(CYCLE_STAT_FIELDS)) i64 ring
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("f_theta", "f_ds", "eps", "m", "seg_iters",
-                     "max_segments", "min_active_frac", "interpret",
+                     "max_segments", "min_active_frac", "exit_frac", "suspend_frac",
+                     "interpret",
                      "lanes", "capacity", "breed_chunk", "target",
                      "max_cycles"))
 def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 f_ds: Callable,
                 eps: float, m: int, seg_iters: int, max_segments: int,
-                min_active_frac: float, interpret: bool, lanes: int,
+                min_active_frac: float, exit_frac: float,
+                suspend_frac: float,
+                interpret: bool, lanes: int,
                 capacity: int, breed_chunk: int, target: int,
                 max_cycles: int) -> _CycleCarry:
     """The full engine as ONE device program:
@@ -665,7 +794,10 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         walk = _run_walk(bred, f_ds=f_ds, eps=eps, m=m,
                          seg_iters=seg_iters, max_segments=max_segments,
                          min_active_frac=min_active_frac,
-                         interpret=interpret, lanes=lanes)
+                         exit_frac=exit_frac, suspend_frac=suspend_frac,
+                         interpret=interpret, lanes=lanes,
+                         gsegs0=c.segs.astype(jnp.int32),
+                         seg_stats0=c.seg_stats)
         bag2 = _expand_pending(walk, capacity, m)
 
         # Drain in f64 ONLY below the walker's own engagement threshold
@@ -688,6 +820,14 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         ws = jnp.sum(walk.lanes.splits.astype(jnp.int64))
         bag_tasks = bred.tasks + bag3.tasks
         bag_splits = bred.splits + bag3.splits
+        cyc_row = jnp.stack([
+            bred.count.astype(jnp.int64), bred.iters,
+            walk.cursor.astype(jnp.int64), wt,
+            walk.steps.astype(jnp.int64), walk.segs.astype(jnp.int64),
+            bag2.count.astype(jnp.int64), bag3.tasks])
+        cyc_stats = lax.dynamic_update_slice(
+            c.cyc_stats, cyc_row[None, :],
+            (jnp.minimum(c.cycles, C_CAP - 1), jnp.int32(0)))
         next_bag = bag3._replace(
             acc=jnp.zeros_like(bag3.acc),
             tasks=jnp.zeros((), jnp.int64),
@@ -712,6 +852,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 jnp.maximum(bred.max_depth, bag3.max_depth)),
             cycles=c.cycles + 1,
             overflow=jnp.logical_or(bred.overflow, bag3.overflow),
+            seg_stats=walk.seg_stats,
+            cyc_stats=cyc_stats,
         )
 
     z64 = jnp.zeros((), jnp.int64)
@@ -725,6 +867,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         roots=z64, rounds=z64, segs=z64, wsteps=z64,
         maxd=jnp.zeros((), jnp.int32), cycles=jnp.zeros((), jnp.int32),
         overflow=jnp.zeros((), bool),
+        seg_stats=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)), jnp.int32),
+        cyc_stats=jnp.zeros((C_CAP, len(CYCLE_STAT_FIELDS)), jnp.int64),
     )
     return lax.while_loop(cond, body, init)
 
@@ -733,9 +877,42 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
 class WalkerResult:
     areas: np.ndarray
     metrics: RunMetrics
-    lane_efficiency: float       # walker tasks / (segments * K * lanes)
+    lane_efficiency: float       # walker tasks / (kernel steps * lanes)
     walker_fraction: float       # share of tasks done by the Pallas kernel
     cycles: int = 0
+    # per-segment rows [steps, live_at_exit, queue_left, refilled]
+    # (SEG_STAT_FIELDS; first S_CAP segments) and per-cycle rows
+    # (CYCLE_STAT_FIELDS; first C_CAP cycles) — VERDICT r3 #10: item-2
+    # occupancy progress must be measurable without a profiler
+    seg_stats: Optional[np.ndarray] = None
+    cycle_stats: Optional[np.ndarray] = None
+
+
+class WalkerDispatch(NamedTuple):
+    """In-flight walker run: device arrays only, no host sync.
+
+    Produced by :func:`dispatch_family_walker`; redeem with
+    :func:`collect_family_walker`. Because XLA dispatch is asynchronous,
+    several dispatches can be queued back-to-back and collected
+    together — the device pipelines them with ONE host round-trip at
+    the end instead of one per run. On this rig the round-trip through
+    the tunneled device costs ~100-300 ms, comparable to the whole
+    run's device time (~200 ms), so pipelining is the difference
+    between measuring the chip and measuring the tunnel.
+    """
+
+    out: _CycleCarry
+    t0: float
+    lanes: int
+
+
+# NOTE on pipelined wall times: a WalkerDispatch's t0 is its DISPATCH
+# time, so when several dispatches are queued, collect_family_walker's
+# metrics.wall_time_s for run k spans the device time of runs 1..k (the
+# queue wait is real wall time from that run's perspective). For
+# per-run throughput under pipelining, time the deltas between
+# consecutive collect completions instead (as bench.py does); only a
+# solo dispatch's wall_time_s measures its own run.
 
 
 def integrate_family_walker(
@@ -745,16 +922,19 @@ def integrate_family_walker(
         capacity: int = 1 << 23,
         lanes: int = DEFAULT_LANES,
         roots_per_lane: int = 12,
-        seg_iters: int = 32,
+        seg_iters: int = 512,
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
+        exit_frac: float = 0.65,
+        suspend_frac: float = 0.5,
         max_cycles: int = 64,
         interpret: Optional[bool] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 1,
         _state_override=None,
         _totals_override: Optional[dict] = None,
-        _crash_after_legs: Optional[int] = None) -> WalkerResult:
+        _crash_after_legs: Optional[int] = None,
+        _dispatch_only: bool = False) -> WalkerResult:
     """Flagship integration: cycles of breed (f64 bag, BFS) -> walk
     (Pallas ds kernel) -> expand -> drain, all in one device program.
 
@@ -807,17 +987,15 @@ def integrate_family_walker(
               m=m, seg_iters=int(seg_iters),
               max_segments=int(max_segments),
               min_active_frac=float(min_active_frac),
+              exit_frac=float(exit_frac),
+              suspend_frac=float(suspend_frac),
               interpret=bool(interpret), lanes=int(lanes),
               capacity=int(capacity), breed_chunk=int(breed_chunk),
               target=int(target))
     if checkpoint_path is None:
         out = _run_cycles(state, max_cycles=int(max_cycles), **kw)
-        (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
-         wsteps, maxd, cycles, overflow, left) = jax.device_get(
-             (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
-              out.wsplits, out.roots, out.rounds, out.segs, out.wsteps,
-              out.maxd, out.cycles, out.overflow, out.bag.count))
-        acc = np.asarray(acc)
+        d = WalkerDispatch(out=out, t0=t0, lanes=int(lanes))
+        return d if _dispatch_only else collect_family_walker(d)
     else:
         from ppls_tpu.parallel.bag_engine import _family_ckpt_identity
         from ppls_tpu.runtime.checkpoint import save_family_checkpoint
@@ -837,16 +1015,23 @@ def integrate_family_walker(
             acc_dev = jnp.zeros(m, jnp.float64)
         legs = 0
         bag = state
+        leg_seg_stats = []
+        leg_cyc_stats = []
         while True:
             out = _run_cycles(bag, acc_dev,
                               max_cycles=int(checkpoint_every), **kw)
             (l_tasks, l_splits, l_bt, l_wt, l_ws, l_roots,
              l_rounds, l_segs, l_wst, l_maxd, l_cycles, l_ovf,
-             left) = jax.device_get(
+             left, l_seg_stats, l_cyc_stats) = jax.device_get(
                  (out.tasks, out.splits, out.btasks, out.wtasks,
                   out.wsplits, out.roots, out.rounds, out.segs,
                   out.wsteps, out.maxd,
-                  out.cycles, out.overflow, out.bag.count))
+                  out.cycles, out.overflow, out.bag.count,
+                  out.seg_stats, out.cyc_stats))
+            leg_seg_stats.append(
+                np.asarray(l_seg_stats)[:min(int(l_segs), S_CAP)])
+            leg_cyc_stats.append(
+                np.asarray(l_cyc_stats)[:min(int(l_cycles), C_CAP)])
             acc_dev = out.acc
             for k, v in (("tasks", l_tasks), ("splits", l_splits),
                          ("btasks", l_bt), ("wtasks", l_wt),
@@ -881,13 +1066,30 @@ def integrate_family_walker(
              tot["wtasks"], tot["wsplits"], tot["roots"],
              tot["rounds"], tot["segs"], tot["wsteps"],
              tot["max_depth"], tot["cycles"])
+        seg_stats_np = (np.concatenate(leg_seg_stats)[:S_CAP]
+                        if leg_seg_stats else None)
+        cyc_stats_np = (np.concatenate(leg_cyc_stats)[:C_CAP]
+                        if leg_cyc_stats else None)
     wall = time.perf_counter() - t0
+    return _assemble_result(
+        acc, dict(tasks=tasks, splits=splits, btasks=btasks,
+                  wtasks=wtasks, wsplits=wsplits, roots=roots,
+                  rounds=rounds, segs=segs, wsteps=wsteps,
+                  max_depth=maxd, cycles=cycles),
+        left=left, overflow=overflow, wall=wall, lanes=lanes,
+        seg_stats=seg_stats_np, cyc_stats=cyc_stats_np,
+        checkpoint_path=checkpoint_path)
 
+
+def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
+                     seg_stats, cyc_stats,
+                     checkpoint_path=None) -> WalkerResult:
+    """Validate a finished run and build its :class:`WalkerResult`."""
     if bool(overflow):
         raise RuntimeError("walker bag overflowed; raise capacity")
     if int(left) > 0:
         raise RuntimeError(
-            f"walker did not converge in {int(cycles)} cycles "
+            f"walker did not converge in {int(tot['cycles'])} cycles "
             f"({int(left)} tasks left); raise max_cycles")
     acc = np.asarray(acc)
     if not np.all(np.isfinite(acc)):
@@ -900,37 +1102,82 @@ def integrate_family_walker(
     from ppls_tpu.parallel.bag_engine import _clear_snapshot
     _clear_snapshot(checkpoint_path)
 
-    tasks = int(tasks)
-    wtasks = int(wtasks)
-    segs = int(segs)
+    tasks = int(tot["tasks"])
+    wtasks = int(tot["wtasks"])
+    segs = int(tot["segs"])
+    roots = int(tot["roots"])
     metrics = RunMetrics(
         tasks=tasks,
-        splits=int(splits),
-        leaves=tasks - int(splits),
-        rounds=int(rounds) + segs,
-        max_depth=int(maxd),
+        splits=int(tot["splits"]),
+        leaves=tasks - int(tot["splits"]),
+        rounds=int(tot["rounds"]) + segs,
+        max_depth=int(tot["max_depth"]),
         # The walker evaluates 1 new point per TEST step (= wtasks), 1
         # per ADVANCE reload — one per accepted leaf EXCEPT each root's
         # final leaf, which parks instead of reloading (= leaves - roots)
-        # — and 2 refill endpoints per consumed root: total
-        # wtasks + (wtasks - wsplits - roots) + 2*roots. Suspended roots
-        # never reach their final leaf, so this overstates by at most
-        # one eval per lane suspended at phase end (~1e-4 relative).
-        # The f64 bag phases evaluate 3 per task.
-        integrand_evals=3 * int(btasks)
-        + 2 * wtasks - int(wsplits) + int(roots),
+        # — and 2 root endpoints (INIT + LOAD kernel steps) per consumed
+        # root: total wtasks + (wtasks - wsplits - roots) + 2*roots.
+        # Suspended roots never reach their final leaf, so this
+        # overstates by at most one eval per lane suspended at phase end
+        # (~1e-4 relative). The f64 bag phases evaluate 3 per task.
+        integrand_evals=3 * int(tot["btasks"])
+        + 2 * wtasks - int(tot["wsplits"]) + roots,
         wall_time_s=wall,
         n_chips=1,
         tasks_per_chip=[tasks],
     )
-    denom = int(wsteps) * lanes
+    denom = int(tot["wsteps"]) * lanes
     return WalkerResult(
-        areas=np.asarray(acc),
+        areas=acc,
         metrics=metrics,
         lane_efficiency=wtasks / denom if denom else 0.0,
         walker_fraction=wtasks / tasks if tasks else 0.0,
-        cycles=int(cycles),
+        cycles=int(tot["cycles"]),
+        seg_stats=seg_stats,
+        cycle_stats=cyc_stats,
     )
+
+
+def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
+    """Block on an in-flight :class:`WalkerDispatch`, validate it, and
+    assemble the :class:`WalkerResult` (one small host pull)."""
+    out = d.out
+    (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
+     wsteps, maxd, cycles, overflow, left, seg_stats_np,
+     cyc_stats_np) = jax.device_get(
+         (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
+          out.wsplits, out.roots, out.rounds, out.segs, out.wsteps,
+          out.maxd, out.cycles, out.overflow, out.bag.count,
+          out.seg_stats, out.cyc_stats))
+    seg_stats_np = np.asarray(seg_stats_np)[:min(int(segs), S_CAP)]
+    cyc_stats_np = np.asarray(cyc_stats_np)[:min(int(cycles), C_CAP)]
+    return _assemble_result(
+        np.asarray(acc),
+        dict(tasks=tasks, splits=splits, btasks=btasks, wtasks=wtasks,
+             wsplits=wsplits, roots=roots, rounds=rounds, segs=segs,
+             wsteps=wsteps, max_depth=maxd, cycles=cycles),
+        left=left, overflow=overflow,
+        wall=time.perf_counter() - d.t0, lanes=d.lanes,
+        seg_stats=seg_stats_np, cyc_stats=cyc_stats_np)
+
+
+def dispatch_family_walker(
+        f_theta: Callable, f_ds: Callable, theta: Sequence[float],
+        bounds, eps: float, **kwargs) -> WalkerDispatch:
+    """Launch a walker run WITHOUT waiting for it.
+
+    Same parameters as :func:`integrate_family_walker` (checkpointing
+    excluded — a checkpointed run must sync at leg boundaries). Returns
+    a :class:`WalkerDispatch`; redeem with
+    :func:`collect_family_walker`. Queue several dispatches to pipeline
+    runs on-device with a single host round-trip at the end.
+    """
+    for bad in ("checkpoint_path", "checkpoint_every"):
+        if kwargs.get(bad) is not None:
+            raise ValueError(f"dispatch_family_walker does not support "
+                             f"{bad}; use integrate_family_walker")
+    return integrate_family_walker(f_theta, f_ds, theta, bounds, eps,
+                                   _dispatch_only=True, **kwargs)
 
 
 def resume_family_walker(
@@ -940,9 +1187,11 @@ def resume_family_walker(
         capacity: int = 1 << 23,
         lanes: int = DEFAULT_LANES,
         roots_per_lane: int = 12,
-        seg_iters: int = 32,
+        seg_iters: int = 512,
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
+        exit_frac: float = 0.65,
+        suspend_frac: float = 0.5,
         max_cycles: int = 64,
         interpret: Optional[bool] = None,
         checkpoint_every: int = 1) -> WalkerResult:
@@ -980,6 +1229,7 @@ def resume_family_walker(
         f_theta, f_ds, theta, bounds, eps, chunk=chunk, capacity=capacity,
         lanes=lanes, roots_per_lane=roots_per_lane, seg_iters=seg_iters,
         max_segments=max_segments, min_active_frac=min_active_frac,
+        exit_frac=exit_frac, suspend_frac=suspend_frac,
         max_cycles=max_cycles, interpret=interpret,
         checkpoint_path=path, checkpoint_every=checkpoint_every,
         _state_override=state, _totals_override=totals)
@@ -992,9 +1242,11 @@ def integrate_family_walker_sharded(
         capacity: int = 1 << 22,
         lanes: int = DEFAULT_LANES,
         roots_per_lane: int = 12,
-        seg_iters: int = 32,
+        seg_iters: int = 512,
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
+        exit_frac: float = 0.65,
+        suspend_frac: float = 0.5,
         max_cycles: int = 64,
         interpret: Optional[bool] = None,
         mesh=None, n_devices: Optional[int] = None) -> WalkerResult:
@@ -1071,6 +1323,8 @@ def integrate_family_walker_sharded(
     kw = dict(f_theta=f_theta, f_ds=f_ds, eps=float(eps), m=int(m_local),
               seg_iters=int(seg_iters), max_segments=int(max_segments),
               min_active_frac=float(min_active_frac),
+              exit_frac=float(exit_frac),
+              suspend_frac=float(suspend_frac),
               interpret=bool(interpret), lanes=int(lanes),
               capacity=int(capacity), breed_chunk=int(breed_chunk),
               target=int(target), max_cycles=int(max_cycles))
