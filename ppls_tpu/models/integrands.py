@@ -228,8 +228,16 @@ register_family_exact("gauss_center", _gauss_center_exact)
 
 DS_FAMILIES: Dict[str, Callable] = {}
 
+# Cody-Waite validity limits of the ds transcendentals (ops/ds.py:255-343
+# and the fence-free twins): beyond these the range reduction loses the
+# quadrant / the result is silently wrong, NOT an overflow the hardware
+# would flag.
+DS_SIN_MAX_ARG = float(1 << 22)
+DS_EXP_MAX_ARG = 88.0
 
-def register_family_ds(name: str, f_ds: Callable) -> Callable:
+
+def register_family_ds(name: str, f_ds: Callable,
+                       domain_check: Optional[Callable] = None) -> Callable:
     """Register the ds-arithmetic twin of a family:
     ``f_ds(x_ds, theta_ds, dsm=<ds module>)`` with (hi, lo) f32 pairs.
 
@@ -240,9 +248,27 @@ def register_family_ds(name: str, f_ds: Callable) -> Callable:
     silently degrade results to f32 accuracy; both modules share one
     API). The walker kernel uses the default; its refill path passes
     the fenced module.
+
+    ``domain_check(bounds, theta)`` (host-side; ``bounds`` is (m, 2),
+    ``theta`` (m,)) must raise ``ValueError`` when any family member's
+    (bounds, theta) would drive a ds transcendental outside its
+    Cody-Waite validity — out-of-range arguments return silently wrong
+    values, not NaNs, so the engines check BEFORE launching
+    (VERDICT r3 #6). It is attached to the function as
+    ``f_ds.ds_domain_check`` for the engines to find.
     """
+    if domain_check is not None:
+        f_ds.ds_domain_check = domain_check
     DS_FAMILIES[name] = f_ds
     return f_ds
+
+
+def check_ds_domain(f_ds: Callable, bounds, theta) -> None:
+    """Run a registered ds twin's domain validator, if any."""
+    check = getattr(f_ds, "ds_domain_check", None)
+    if check is not None:
+        check(np.asarray(bounds, dtype=np.float64).reshape(-1, 2),
+              np.asarray(theta, dtype=np.float64).reshape(-1))
 
 
 def get_family_ds(name: str) -> Callable:
@@ -277,8 +303,38 @@ def _gauss_center_ds(x, c, dsm=None):
     return dsm.ds_exp(z)
 
 
-register_family_ds("sin_recip_scaled", _sin_recip_scaled_ds)
-register_family_ds("sin_scaled", _sin_scaled_ds)
+def _sin_recip_domain(bounds, theta):
+    # arg = theta / x over [a, b]: |arg| peaks at max|theta| / min x.
+    if np.any(bounds <= 0.0):
+        raise ValueError(
+            "sin_recip_scaled ds twin requires bounds > 0 (theta/x pole)")
+    worst = np.max(np.abs(theta) / np.min(bounds, axis=1))
+    if worst > DS_SIN_MAX_ARG:
+        raise ValueError(
+            f"sin_recip_scaled ds twin out of ds_sin's Cody-Waite range: "
+            f"max |theta/x| = {worst:.3e} > {DS_SIN_MAX_ARG:.3e} "
+            f"(results would be silently wrong, not NaN). Use the f64 "
+            f"bag engine for this (bounds, theta), or shrink theta / "
+            f"raise the lower bound.")
+
+
+def _sin_scaled_domain(bounds, theta):
+    worst = np.max(np.abs(theta) * np.max(np.abs(bounds), axis=1))
+    if worst > DS_SIN_MAX_ARG:
+        raise ValueError(
+            f"sin_scaled ds twin out of ds_sin's Cody-Waite range: "
+            f"max |theta*x| = {worst:.3e} > {DS_SIN_MAX_ARG:.3e} "
+            f"(results would be silently wrong, not NaN). Use the f64 "
+            f"bag engine for this (bounds, theta).")
+
+
+# gauss_center: arg = -500000 (x - c)^2 <= 0 always; large-magnitude
+# negative args underflow ds_exp to exactly 0 (the correct limit), so
+# every (bounds, theta) is in-domain and no check is registered.
+register_family_ds("sin_recip_scaled", _sin_recip_scaled_ds,
+                   domain_check=_sin_recip_domain)
+register_family_ds("sin_scaled", _sin_scaled_ds,
+                   domain_check=_sin_scaled_domain)
 register_family_ds("gauss_center", _gauss_center_ds)
 
 

@@ -135,6 +135,41 @@ def test_walker_parity_on_device():
     assert w.walker_fraction > 0.5, w.walker_fraction
 
 
+def test_walker_flagship_operating_point():
+    # The bench's EXACT operating point (VERDICT r3 #5): a=1e-4,
+    # eps=1e-10, default engine parameters (lanes=2^14, early-exit
+    # segments, suspend/re-breed tails, in-kernel INIT endpoint evals)
+    # — where ds_div/ds_sin arguments reach theta/1e-4 ~ 2e4 and the
+    # reduction depth is 10x the shallower parity test above. A scaled
+    # family slice (m=32 of the bench's 1024) keeps the runtime in
+    # test range; everything else matches bench.py. The round-2 bug
+    # classes (ds range/exponent underflow) and the round-4 seeding
+    # miscompile (roots silently dropped -> area loss ~1e-5 and task
+    # drift) all fail these assertions.
+    from ppls_tpu.models.integrands import get_family, get_family_ds
+    from ppls_tpu.parallel.bag_engine import integrate_family
+    from ppls_tpu.parallel.walker import integrate_family_walker
+
+    f = get_family("sin_recip_scaled")
+    fds = get_family_ds("sin_recip_scaled")
+    m = 32
+    theta = 1.0 + np.arange(m) / m
+    eps = 1e-10
+    w = integrate_family_walker(f, fds, theta, (1e-4, 1.0), eps,
+                                capacity=1 << 22)
+    b = integrate_family(f, theta, (1e-4, 1.0), eps,
+                         chunk=1 << 15, capacity=1 << 22)
+    assert np.all(np.isfinite(w.areas))
+    assert np.max(np.abs(w.areas - b.areas)) < 1e-9          # parity
+    drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 1e-4, (w.metrics.tasks, b.metrics.tasks)
+    # engine-health floor: at m=32 the breed share is larger than the
+    # bench's m=1024 (walker fraction 0.74 vs 0.99 measured) — the
+    # assertion guards collapse, not the bench's exact share
+    assert w.walker_fraction > 0.6, w.walker_fraction
+    assert 0.2 < w.lane_efficiency <= 2.0 / 3.0 + 1e-6, w.lane_efficiency
+
+
 def test_walker_gauss_family_on_device():
     # ds_exp inside real Mosaic codegen (exact pow2 scaling + fence-free
     # transforms), on the clustered-refinement Gaussian family.

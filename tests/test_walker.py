@@ -177,3 +177,33 @@ def test_walker_sharded_more_chips_than_families():
     b = integrate_family_walker(F, F_DS, theta, BOUNDS, 1e-6, **KW)
     assert np.all(np.isfinite(s.areas))
     assert np.max(np.abs(s.areas - b.areas)) < 3e-9
+
+
+def test_ds_domain_guard_rejects_out_of_range():
+    # VERDICT r3 #6: an out-of-range (bounds, theta) must raise up front
+    # with a clear message — the ds transcendentals return silently
+    # WRONG values (not NaN) outside their Cody-Waite validity, so no
+    # runtime gate can catch it after the fact.
+    with pytest.raises(ValueError, match="Cody-Waite"):
+        integrate_family_walker(F, F_DS, [2.0], (1e-7, 1.0), 1e-6, **KW)
+    # per-family bounds: only the offending member matters
+    with pytest.raises(ValueError, match="Cody-Waite"):
+        integrate_family_walker(
+            F, F_DS, [1.0, 2.0], np.array([[1e-2, 1.0], [1e-7, 1.0]]),
+            1e-6, **KW)
+    # pole/nonpositive domain is its own error
+    with pytest.raises(ValueError, match="bounds > 0"):
+        integrate_family_walker(F, F_DS, [1.0], (-1.0, 1.0), 1e-6, **KW)
+    # sin_scaled twin: arg = theta * x
+    fs = get_family("sin_scaled")
+    fs_ds = get_family_ds("sin_scaled")
+    with pytest.raises(ValueError, match="Cody-Waite"):
+        integrate_family_walker(fs, fs_ds, [1e9], (0.0, 1.0), 1e-6, **KW)
+
+
+def test_ds_domain_guard_sharded_entry():
+    from ppls_tpu.parallel.walker import integrate_family_walker_sharded
+    with pytest.raises(ValueError, match="Cody-Waite"):
+        integrate_family_walker_sharded(F, F_DS, [2.0], (1e-7, 1.0), 1e-6,
+                                        capacity=1 << 14, lanes=256,
+                                        n_devices=2)
