@@ -45,6 +45,10 @@ from ppls_tpu.parallel.walker import (  # noqa: E402
     resume_family_walker,
 )
 from ppls_tpu.parallel.sharded_bag import integrate_family_sharded  # noqa: E402
+from ppls_tpu.parallel.sharded_walker import (  # noqa: E402
+    integrate_family_walker_dd,
+    resume_family_walker_dd,
+)
 from ppls_tpu.parallel.cubature import integrate_2d, integrate_2d_sharded  # noqa: E402
 from ppls_tpu.parallel.qmc import integrate_qmc  # noqa: E402
 
@@ -63,6 +67,8 @@ __all__ = [
     "IntegrationResult",
     "device_integrate",
     "sharded_integrate",
+    "integrate_family_walker_dd",
+    "resume_family_walker_dd",
     "integrate_family",
     "resume_family",
     "integrate_family_walker",

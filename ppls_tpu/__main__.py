@@ -67,14 +67,19 @@ def build_parser() -> argparse.ArgumentParser:
     fam.add_argument("--eps", type=float, default=1e-8)
     fam.add_argument("--engine",
                      choices=["bag", "walker", "sharded-bag",
-                              "sharded-walker"],
+                              "sharded-walker", "sharded-walker-dd"],
                      default="bag",
                      help="bag: chunked-LIFO f64; walker: Pallas ds "
-                          "flagship; sharded-*: multi-chip variants")
+                          "flagship; sharded-bag/-walker: multi-chip "
+                          "variants; sharded-walker-dd: demand-driven "
+                          "cross-chip root rebalancing (one deep family "
+                          "spreads over the whole mesh)")
     fam.add_argument("--rule", choices=["trapezoid", "simpson"],
                      default="trapezoid",
-                     help="bag engines only (the walker is the "
-                          "reference-parity trapezoid)")
+                     help="both rules on the bag, walker, and "
+                          "sharded-bag engines (one interface, SURVEY.md "
+                          "§2 defect note); the sharded walkers are "
+                          "trapezoid-only and refuse simpson")
     fam.add_argument("--chunk", type=int, default=1 << 13)
     fam.add_argument("--capacity", type=int, default=1 << 20)
     fam.add_argument("--n-devices", type=int, default=None)
@@ -142,10 +147,12 @@ def _main_family(args) -> int:
             res = integrate_family(f, theta, bounds, args.eps,
                                    checkpoint_path=args.checkpoint, **kw)
     elif args.engine == "walker":
+        from ppls_tpu.config import Rule
         from ppls_tpu.parallel.walker import (integrate_family_walker,
                                               resume_family_walker)
         fds = get_family_ds(args.family)
-        wkw = dict(chunk=args.chunk, capacity=args.capacity)
+        wkw = dict(chunk=args.chunk, capacity=args.capacity,
+                   rule=Rule(args.rule))
         if args.checkpoint and os.path.exists(args.checkpoint):
             res = resume_family_walker(args.checkpoint, f, fds, theta,
                                        bounds, args.eps, **wkw)
@@ -153,14 +160,38 @@ def _main_family(args) -> int:
             res = integrate_family_walker(f, fds, theta, bounds, args.eps,
                                           checkpoint_path=args.checkpoint,
                                           **wkw)
+    elif args.engine == "sharded-walker-dd":
+        from ppls_tpu.parallel.sharded_walker import (
+            integrate_family_walker_dd, resume_family_walker_dd)
+        if args.rule != "trapezoid":
+            raise SystemExit(
+                "--rule simpson is not available on the sharded walker "
+                "engines (trapezoid only); use --engine bag/walker or "
+                "sharded-bag for Simpson")
+        dkw = dict(chunk=args.chunk, capacity=args.capacity,
+                   n_devices=args.n_devices)
+        if args.checkpoint and os.path.exists(args.checkpoint):
+            res = resume_family_walker_dd(args.checkpoint, args.family,
+                                          theta, bounds, args.eps, **dkw)
+        else:
+            res = integrate_family_walker_dd(
+                args.family, theta, bounds, args.eps,
+                checkpoint_path=args.checkpoint, **dkw)
     elif args.engine == "sharded-bag":
+        from ppls_tpu.config import Rule
         from ppls_tpu.parallel.sharded_bag import integrate_family_sharded
         res = integrate_family_sharded(args.family, theta, bounds,
-                                       args.eps, chunk=args.chunk,
+                                       args.eps, rule=Rule(args.rule),
+                                       chunk=args.chunk,
                                        capacity=args.capacity,
                                        n_devices=args.n_devices)
     else:
         from ppls_tpu.parallel.walker import integrate_family_walker_sharded
+        if args.rule != "trapezoid":
+            raise SystemExit(
+                "--rule simpson is not available on the sharded walker "
+                "engines (trapezoid only); use --engine bag/walker or "
+                "sharded-bag for Simpson")
         res = integrate_family_walker_sharded(
             f, get_family_ds(args.family), theta, bounds, args.eps,
             chunk=args.chunk, capacity=args.capacity,

@@ -103,16 +103,24 @@ _OVF = 8                    # lane parked on depth overflow: its partial
                             # refilled — its (i, d) pending set feeds the
                             # mop-up phase
 _MODE_INIT = 16             # freshly refilled root: next eval is f(left)
-                            # (the step after, via _MODE_LOAD, is
-                            # f(right)) — root endpoints are evaluated
+                            # (the steps after load the remaining
+                            # caches) — root endpoints are evaluated
                             # IN-KERNEL, overlapped with other lanes'
                             # walk steps, instead of at the XLA refill
                             # boundary where the fenced-ds evaluation of
                             # 2 x lanes points cost ~1 ms per boundary
+_MODE_LOADM = 32            # Simpson only: next eval loads f(mid)
+_MODE_TESTB = 64            # Simpson only: q1 is stashed, next eval is
+                            # q3 and the split decision fires
 
 
 class WalkState(NamedTuple):
-    """Per-lane walker state, all (ROWS, 128)."""
+    """Per-lane walker state, all (ROWS, 128).
+
+    ``fm``/``fq`` are Simpson-only caches (midpoint value; stashed
+    quarter-point q1 between the two test steps); the trapezoid kernel
+    carries them untouched.
+    """
 
     a_h: jnp.ndarray        # root left endpoint (ds)
     a_l: jnp.ndarray
@@ -124,6 +132,10 @@ class WalkState(NamedTuple):
     fl_l: jnp.ndarray
     fr_h: jnp.ndarray       # cached f(right endpoint of current node)
     fr_l: jnp.ndarray
+    fm_h: jnp.ndarray       # cached f(midpoint) — Simpson
+    fm_l: jnp.ndarray
+    fq_h: jnp.ndarray       # stashed f(q1) — Simpson TESTA -> TESTB
+    fq_l: jnp.ndarray
     acc_h: jnp.ndarray      # ds accumulator for the current root
     acc_l: jnp.ndarray
     i: jnp.ndarray          # int32 node index at depth d
@@ -161,7 +173,8 @@ def _ctz(k):
 
 
 def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
-                     interpret: bool = False, early_exit: bool = False):
+                     interpret: bool = False, early_exit: bool = False,
+                     rule: Rule = Rule.TRAPEZOID):
     """Build the segment kernel: up to seg_iters walker steps over all
     lanes.
 
@@ -246,6 +259,7 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             th_h=s.th_h, th_l=s.th_l,
             fl_h=new_fl[0], fl_l=new_fl[1],
             fr_h=new_fr[0], fr_l=new_fr[1],
+            fm_h=s.fm_h, fm_l=s.fm_l, fq_h=s.fq_h, fq_l=s.fq_l,
             acc_h=acc[0], acc_l=acc[1],
             i=i_next, d=d_next, base_d=s.base_d, fam=s.fam,
             flags=flags,
@@ -254,6 +268,136 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             maxd=jnp.maximum(s.maxd, jnp.where(
                 testing, s.base_d + s.d, jnp.int32(0))),
         )
+
+    def step_simpson(s: WalkState) -> WalkState:
+        """Simpson+Richardson DFS step (ops/rules.simpson_batch twin).
+
+        One eval per step, like the trapezoid twin, via a 5-phase mode
+        chain per node visit: INIT (f(left), fresh roots only) ->
+        LOADM (f(mid)) -> LOAD (f(right)) -> TESTA (f(q1), stash) ->
+        TESTB (f(q3), decide). Cache reuse: descend-left hands the
+        child (fl, fm=q1_stash, fr=fm) for free, so a split costs only
+        the child's 2 test evals; an advance reloads (fm, fr) — 2
+        loads + 2 tests per advanced node, ~3 evals/task amortized
+        (vs 5/task in the f64 Simpson bag, 1.5 for the trapezoid
+        walker whose accepts are O(h^3) coarser).
+        """
+        parked = (s.flags & _PARKED) != 0
+        mode_load = (s.flags & _MODE_LOAD) != 0
+        mode_init = (s.flags & _MODE_INIT) != 0
+        mode_loadm = (s.flags & _MODE_LOADM) != 0
+        mode_testb = (s.flags & _MODE_TESTB) != 0
+        live = jnp.logical_not(parked)
+        testa = jnp.logical_and(live, jnp.logical_not(
+            mode_load | mode_init | mode_loadm | mode_testb))
+
+        w, x0, x1 = _node_geometry(s)
+        mid = dsk.ds_add(x0, dsk.ds_mul_pow2(w, 0.5))
+        q1 = dsk.ds_add(x0, dsk.ds_mul_pow2(w, 0.25))
+        q3 = dsk.ds_add(mid, dsk.ds_mul_pow2(w, 0.25))
+
+        # the single eval of this step, by phase
+        xq = dsk.ds_where(mode_testb, q3, q1)        # TESTA default: q1
+        xq = dsk.ds_where(mode_loadm, mid, xq)
+        xq = dsk.ds_where(mode_load, x1, xq)
+        xq = dsk.ds_where(mode_init, x0, xq)
+        xq = dsk.ds_where(parked, (jnp.ones_like(xq[0]),
+                                   jnp.zeros_like(xq[1])), xq)
+        fq = f_ds(xq, (s.th_h, s.th_l))
+
+        # Simpson + Richardson on (fl, fq1_stash, fm, fq=q3, fr). The
+        # 1/6, 1/12, 1/15 scalings use DS constants: an f32 literal
+        # carries 3e-8 relative error, which lands SYSTEMATICALLY on
+        # every accepted value (measured 1.5e-8 absolute on the family
+        # areas — 1000x the ds noise floor).
+        fl = (s.fl_h, s.fl_l)
+        fr = (s.fr_h, s.fr_l)
+        fm = (s.fm_h, s.fm_l)
+        fq1 = (s.fq_h, s.fq_l)
+
+        def dsc(x):
+            hi = np.float32(x)
+            return hi, np.float32(x - np.float64(hi))
+
+        four_fm = dsk.ds_mul_pow2(fm, 4.0)
+        s1 = dsk.ds_mul(dsk.ds_mul(w, dsc(1.0 / 6.0)),
+                        dsk.ds_add(dsk.ds_add(fl, four_fm), fr))
+        inner = dsk.ds_add(
+            dsk.ds_add(fl, fr),
+            dsk.ds_add(dsk.ds_mul_pow2(dsk.ds_add(fq1, fq), 4.0),
+                       dsk.ds_mul_pow2(fm, 2.0)))
+        s2 = dsk.ds_mul(dsk.ds_mul(w, dsc(1.0 / 12.0)), inner)
+        diff = dsk.ds_sub(s2, s1)
+        corr = dsk.ds_mul(diff, dsc(1.0 / 15.0))
+        err = dsk.ds_abs(corr)
+        val = dsk.ds_add(s2, corr)
+        split = (err[0] + err[1]) > eps32
+
+        testing = jnp.logical_and(live, mode_testb)
+        do_split = jnp.logical_and(testing, split)
+        ovf = jnp.logical_and(do_split, s.d >= MAX_REL_DEPTH)
+        do_split = jnp.logical_and(do_split, jnp.logical_not(ovf))
+        do_accept = jnp.logical_and(testing, jnp.logical_not(split))
+
+        acc = dsk.ds_add((s.acc_h, s.acc_l), dsk.ds_where(
+            do_accept, val,
+            (jnp.zeros_like(val[0]), jnp.zeros_like(val[1]))))
+        t = _ctz(s.i + 1)
+        fin = jnp.logical_and(do_accept, t >= s.d)
+        adv = jnp.logical_and(do_accept, jnp.logical_not(fin))
+        i_next = jnp.where(do_split, s.i * 2,
+                           jnp.where(adv, (s.i >> t) + 1, s.i))
+        d_next = jnp.where(do_split, s.d + 1,
+                           jnp.where(adv, s.d - t, s.d))
+
+        # caches by phase:
+        #   INIT:  fl := fq                         -> LOADM
+        #   LOADM: fm := fq                         -> LOAD
+        #   LOAD:  fr := fq                         -> TESTA
+        #   TESTA: fq1_stash := fq                  -> TESTB
+        #   TESTB split: (fl, fm, fr) := (fl, fq1_stash, fm) -> TESTA
+        #   TESTB accept+advance: fl := fr          -> LOADM
+        new_fl = dsk.ds_where(adv, fr, fl)
+        new_fl = dsk.ds_where(mode_init, fq, new_fl)
+        new_fm = dsk.ds_where(do_split, fq1, fm)
+        new_fm = dsk.ds_where(mode_loadm, fq, new_fm)
+        new_fr = dsk.ds_where(do_split, fm, fr)
+        new_fr = dsk.ds_where(mode_load, fq, new_fr)
+        new_fq = dsk.ds_where(testa, fq, fq1)
+
+        flags = s.flags
+        flags = jnp.where(mode_init,
+                          (flags & ~_MODE_INIT) | _MODE_LOADM, flags)
+        flags = jnp.where(mode_loadm,
+                          (flags & ~_MODE_LOADM) | _MODE_LOAD, flags)
+        flags = jnp.where(mode_load, flags & ~_MODE_LOAD, flags)
+        flags = jnp.where(testa, flags | _MODE_TESTB, flags)
+        flags = jnp.where(do_split, flags & ~_MODE_TESTB, flags)
+        flags = jnp.where(adv,
+                          (flags & ~_MODE_TESTB) | _MODE_LOADM, flags)
+        flags = jnp.where(fin, (flags & ~_MODE_TESTB) | _PARKED, flags)
+        flags = jnp.where(ovf,
+                          (flags & ~_MODE_TESTB) | (_PARKED | _OVF),
+                          flags)
+
+        return WalkState(
+            a_h=s.a_h, a_l=s.a_l, w_h=s.w_h, w_l=s.w_l,
+            th_h=s.th_h, th_l=s.th_l,
+            fl_h=new_fl[0], fl_l=new_fl[1],
+            fr_h=new_fr[0], fr_l=new_fr[1],
+            fm_h=new_fm[0], fm_l=new_fm[1],
+            fq_h=new_fq[0], fq_l=new_fq[1],
+            acc_h=acc[0], acc_l=acc[1],
+            i=i_next, d=d_next, base_d=s.base_d, fam=s.fam,
+            flags=flags,
+            tasks=s.tasks + testing.astype(jnp.int32),
+            splits=s.splits + do_split.astype(jnp.int32),
+            maxd=jnp.maximum(s.maxd, jnp.where(
+                testing, s.base_d + s.d, jnp.int32(0))),
+        )
+
+    if rule == Rule.SIMPSON:
+        step = step_simpson
 
     n_fields = len(WalkState._fields)
 
@@ -366,7 +510,8 @@ class _WalkCarry(NamedTuple):
 
 
 def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
-           capacity: int, target: int) -> BagState:
+           capacity: int, target: int,
+           rule: Rule = Rule.TRAPEZOID) -> BagState:
     """BFS-refine the bag until it holds >= target roots, it empties, OR
     the frontier passes its peak (count shrinks round-over-round).
 
@@ -385,7 +530,7 @@ def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
 
     def body(carry):
         s, _ = carry
-        return (bag_step(s, f_theta, eps, Rule.TRAPEZOID, chunk, capacity),
+        return (bag_step(s, f_theta, eps, rule, chunk, capacity),
                 s.count)
 
     out, _ = lax.while_loop(cond, body, (bag, jnp.int32(0)))
@@ -500,6 +645,8 @@ def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
         th_h=pick(th_h, s.th_h), th_l=pick(th_l, s.th_l),
         fl_h=pick(z32, s.fl_h), fl_l=pick(z32, s.fl_l),
         fr_h=pick(z32, s.fr_h), fr_l=pick(z32, s.fr_l),
+        fm_h=pick(z32, s.fm_h), fm_l=pick(z32, s.fm_l),
+        fq_h=pick(z32, s.fq_h), fq_l=pick(z32, s.fq_l),
         acc_h=jnp.where(bank2, z32, s.acc_h),
         acc_l=jnp.where(bank2, z32, s.acc_l),
         i=pick(zi, s.i), d=pick(zi, s.d),
@@ -522,7 +669,8 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
               m: int, seg_iters: int, max_segments: int,
               min_active_frac: float, exit_frac: float,
               suspend_frac: float, interpret: bool,
-              lanes: int, gsegs0, seg_stats0) -> _WalkCarry:
+              lanes: int, gsegs0, seg_stats0,
+              rule: Rule = Rule.TRAPEZOID) -> _WalkCarry:
     """One walk phase (traced inline inside :func:`_run_cycles`).
 
     Occupancy-aware segments: each kernel launch runs until the live
@@ -542,7 +690,8 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
     largest efficiency loss in the segment trace.)
     """
     run_segment = make_walk_kernel(f_ds, eps, seg_iters,
-                                   interpret=interpret, early_exit=True)
+                                   interpret=interpret, early_exit=True,
+                                   rule=rule)
 
     rows = lanes // 128
     z32 = jnp.zeros((rows, 128), jnp.float32)
@@ -550,7 +699,9 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
     ones = jnp.ones((rows, 128), jnp.float32)
     lane0 = WalkState(
         a_h=ones, a_l=z32, w_h=ones, w_l=z32, th_h=ones, th_l=z32,
-        fl_h=z32, fl_l=z32, fr_h=z32, fr_l=z32, acc_h=z32, acc_l=z32,
+        fl_h=z32, fl_l=z32, fr_h=z32, fr_l=z32,
+        fm_h=z32, fm_l=z32, fq_h=z32, fq_l=z32,
+        acc_h=z32, acc_l=z32,
         i=zi, d=zi, base_d=zi, fam=zi,
         flags=jnp.full((rows, 128), _PARKED | _NO_ROOT, jnp.int32),
         tasks=zi, splits=zi, maxd=zi,
@@ -747,7 +898,7 @@ class _CycleCarry(NamedTuple):
                      "max_segments", "min_active_frac", "exit_frac", "suspend_frac",
                      "interpret",
                      "lanes", "capacity", "breed_chunk", "target",
-                     "max_cycles"))
+                     "max_cycles", "rule"))
 def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 f_ds: Callable,
                 eps: float, m: int, seg_iters: int, max_segments: int,
@@ -755,7 +906,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 suspend_frac: float,
                 interpret: bool, lanes: int,
                 capacity: int, breed_chunk: int, target: int,
-                max_cycles: int) -> _CycleCarry:
+                max_cycles: int,
+                rule: Rule = Rule.TRAPEZOID) -> _CycleCarry:
     """The full engine as ONE device program:
 
         while bag not empty:
@@ -788,16 +940,16 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             if pc < breed_chunk:
                 bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=pc,
                               capacity=capacity,
-                              target=min(pc // 2, target))
+                              target=min(pc // 2, target), rule=rule)
         bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=breed_chunk,
-                      capacity=capacity, target=target)
+                      capacity=capacity, target=target, rule=rule)
         walk = _run_walk(bred, f_ds=f_ds, eps=eps, m=m,
                          seg_iters=seg_iters, max_segments=max_segments,
                          min_active_frac=min_active_frac,
                          exit_frac=exit_frac, suspend_frac=suspend_frac,
                          interpret=interpret, lanes=lanes,
                          gsegs0=c.segs.astype(jnp.int32),
-                         seg_stats0=c.seg_stats)
+                         seg_stats0=c.seg_stats, rule=rule)
         bag2 = _expand_pending(walk, capacity, m)
 
         # Drain in f64 ONLY below the walker's own engagement threshold
@@ -809,7 +961,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         # remaining *work* (115 M of 166 M tasks drained in f64).
         def drain(b: BagState):
             return _run_bag(b, f_theta=f_theta, eps=eps,
-                            rule=Rule.TRAPEZOID, chunk=breed_chunk,
+                            rule=rule, chunk=breed_chunk,
                             capacity=capacity, max_iters=1 << 20,
                             stop_count=None)
 
@@ -904,6 +1056,7 @@ class WalkerDispatch(NamedTuple):
     out: _CycleCarry
     t0: float
     lanes: int
+    rule: Rule = Rule.TRAPEZOID
 
 
 # NOTE on pipelined wall times: a WalkerDispatch's t0 is its DISPATCH
@@ -928,6 +1081,7 @@ def integrate_family_walker(
         exit_frac: float = 0.65,
         suspend_frac: float = 0.5,
         max_cycles: int = 64,
+        rule: Rule = Rule.TRAPEZOID,
         interpret: Optional[bool] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 1,
@@ -996,17 +1150,20 @@ def integrate_family_walker(
               suspend_frac=float(suspend_frac),
               interpret=bool(interpret), lanes=int(lanes),
               capacity=int(capacity), breed_chunk=int(breed_chunk),
-              target=int(target))
+              target=int(target), rule=Rule(rule))
     if checkpoint_path is None:
         out = _run_cycles(state, max_cycles=int(max_cycles), **kw)
-        d = WalkerDispatch(out=out, t0=t0, lanes=int(lanes))
+        d = WalkerDispatch(out=out, t0=t0, lanes=int(lanes),
+                           rule=Rule(rule))
         return d if _dispatch_only else collect_family_walker(d)
     else:
         from ppls_tpu.parallel.bag_engine import _family_ckpt_identity
         from ppls_tpu.runtime.checkpoint import save_family_checkpoint
 
-        identity = _family_ckpt_identity("walker", f_theta, float(eps), m,
-                                         theta, bounds)
+        engine_name = ("walker" if Rule(rule) == Rule.TRAPEZOID
+                       else f"walker-{Rule(rule).value}")
+        identity = _family_ckpt_identity(engine_name, f_theta, float(eps),
+                                         m, theta, bounds)
         tot = dict(tasks=0, splits=0, btasks=0, wtasks=0, wsplits=0,
                    roots=0, rounds=0, segs=0, wsteps=0, max_depth=0,
                    cycles=0)
@@ -1082,12 +1239,12 @@ def integrate_family_walker(
                   rounds=rounds, segs=segs, wsteps=wsteps,
                   max_depth=maxd, cycles=cycles),
         left=left, overflow=overflow, wall=wall, lanes=lanes,
-        seg_stats=seg_stats_np, cyc_stats=cyc_stats_np,
+        seg_stats=seg_stats_np, cyc_stats=cyc_stats_np, rule=Rule(rule),
         checkpoint_path=checkpoint_path)
 
 
 def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
-                     seg_stats, cyc_stats,
+                     seg_stats, cyc_stats, rule: Rule = Rule.TRAPEZOID,
                      checkpoint_path=None) -> WalkerResult:
     """Validate a finished run and build its :class:`WalkerResult`."""
     if bool(overflow):
@@ -1117,16 +1274,22 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         leaves=tasks - int(tot["splits"]),
         rounds=int(tot["rounds"]) + segs,
         max_depth=int(tot["max_depth"]),
-        # The walker evaluates 1 new point per TEST step (= wtasks), 1
-        # per ADVANCE reload — one per accepted leaf EXCEPT each root's
-        # final leaf, which parks instead of reloading (= leaves - roots)
-        # — and 2 root endpoints (INIT + LOAD kernel steps) per consumed
-        # root: total wtasks + (wtasks - wsplits - roots) + 2*roots.
-        # Suspended roots never reach their final leaf, so this
-        # overstates by at most one eval per lane suspended at phase end
-        # (~1e-4 relative). The f64 bag phases evaluate 3 per task.
-        integrand_evals=3 * int(tot["btasks"])
-        + 2 * wtasks - int(tot["wsplits"]) + roots,
+        # Trapezoid: 1 eval per TEST step (= wtasks), 1 per ADVANCE
+        # reload — one per accepted leaf EXCEPT each root's final leaf
+        # (= leaves - roots) — and 2 root endpoints (INIT + LOAD kernel
+        # steps) per consumed root: 2*wtasks - wsplits + roots total;
+        # the f64 bag phases evaluate 3 per task. Simpson: 2 test evals
+        # per node (q1, q3), 2 reloads (fm, fr) per advance, 3 per root
+        # (INIT, LOADM, LOAD): 4*wtasks - 2*wsplits + roots; bag phases
+        # evaluate 5 per task. Suspended roots never reach their final
+        # leaf, so both overstate by at most one eval per lane suspended
+        # at phase end (~1e-4 relative).
+        integrand_evals=(
+            3 * int(tot["btasks"])
+            + 2 * wtasks - int(tot["wsplits"]) + roots
+            if Rule(rule) == Rule.TRAPEZOID else
+            5 * int(tot["btasks"])
+            + 4 * wtasks - 2 * int(tot["wsplits"]) + roots),
         wall_time_s=wall,
         n_chips=1,
         tasks_per_chip=[tasks],
@@ -1162,7 +1325,7 @@ def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
              wsplits=wsplits, roots=roots, rounds=rounds, segs=segs,
              wsteps=wsteps, max_depth=maxd, cycles=cycles),
         left=left, overflow=overflow,
-        wall=time.perf_counter() - d.t0, lanes=d.lanes,
+        wall=time.perf_counter() - d.t0, lanes=d.lanes, rule=d.rule,
         seg_stats=seg_stats_np, cyc_stats=cyc_stats_np)
 
 
@@ -1198,6 +1361,7 @@ def resume_family_walker(
         exit_frac: float = 0.65,
         suspend_frac: float = 0.5,
         max_cycles: int = 64,
+        rule: Rule = Rule.TRAPEZOID,
         interpret: Optional[bool] = None,
         checkpoint_every: int = 1) -> WalkerResult:
     """Continue an interrupted checkpointed walker run from its last
@@ -1212,7 +1376,9 @@ def resume_family_walker(
     bounds_np = np.asarray(bounds, dtype=np.float64)
     if bounds_np.ndim == 1:
         bounds_np = np.tile(bounds_np.reshape(1, 2), (m, 1))
-    identity = _family_ckpt_identity("walker", f_theta, float(eps), m,
+    engine_name = ("walker" if Rule(rule) == Rule.TRAPEZOID
+                   else f"walker-{Rule(rule).value}")
+    identity = _family_ckpt_identity(engine_name, f_theta, float(eps), m,
                                      theta_np, bounds_np)
     bag_cols, count, acc, totals = load_family_checkpoint(path, identity)
 
@@ -1235,7 +1401,7 @@ def resume_family_walker(
         lanes=lanes, roots_per_lane=roots_per_lane, seg_iters=seg_iters,
         max_segments=max_segments, min_active_frac=min_active_frac,
         exit_frac=exit_frac, suspend_frac=suspend_frac,
-        max_cycles=max_cycles, interpret=interpret,
+        max_cycles=max_cycles, rule=rule, interpret=interpret,
         checkpoint_path=path, checkpoint_every=checkpoint_every,
         _state_override=state, _totals_override=totals)
 

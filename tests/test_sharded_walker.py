@@ -1,0 +1,90 @@
+"""Demand-driven multi-chip walker (VERDICT r3 #3 + #7).
+
+Acceptance (the judge's criterion): ONE deep family — the case the
+round-robin family deal structurally cannot balance — finishes with
+near-uniform tasks_per_chip (max/min < 2) on the virtual 8-mesh, with
+areas matching the single-chip engines within the ds contract. Plus
+kill-and-resume checkpointing for the multi-chip run.
+"""
+
+import numpy as np
+import pytest
+
+from ppls_tpu.models.integrands import get_family
+from ppls_tpu.parallel.bag_engine import integrate_family
+from ppls_tpu.parallel.sharded_walker import (integrate_family_walker_dd,
+                                              resume_family_walker_dd)
+
+BOUNDS = (1e-3, 1.0)
+EPS = 1e-9
+KW = dict(chunk=1 << 8, capacity=1 << 16, lanes=256, roots_per_lane=2,
+          seg_iters=32, min_active_frac=0.05, n_devices=8)
+
+
+def _bag(theta, eps=EPS):
+    return integrate_family(get_family("sin_recip_scaled"), theta, BOUNDS,
+                            eps, chunk=1 << 10, capacity=1 << 17)
+
+
+def test_one_deep_family_balances_across_mesh():
+    # The reference's defining capability (aquadPartA.c:156-165): all
+    # work starts as ONE seed on one chip; demand-driven re-shard must
+    # spread it over the whole mesh.
+    theta = [1.0]
+    r = integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS,
+                                   EPS, **KW)
+    b = _bag(theta)
+    assert np.max(np.abs(r.areas - b.areas)) < 1e-9
+    tpc = r.metrics.tasks_per_chip
+    assert len(tpc) == 8 and min(tpc) > 0
+    assert max(tpc) / min(tpc) < 2.0, tpc
+    # conservation of the tree across the mesh (split decisions are
+    # placement-independent at this eps)
+    drift = abs(r.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 1e-3, (r.metrics.tasks, b.metrics.tasks)
+
+
+def test_multi_family_parity():
+    theta = 1.0 + np.arange(8) / 8.0
+    r = integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS,
+                                   EPS, **KW)
+    b = _bag(theta)
+    assert np.max(np.abs(r.areas - b.areas)) < 1e-9
+    tpc = r.metrics.tasks_per_chip
+    assert max(tpc) / min(tpc) < 2.0, tpc
+
+
+def test_dd_kill_and_resume_matches_uninterrupted(tmp_path):
+    # VERDICT r3 #7: kill-and-resume on the virtual 8-mesh reproduces
+    # the uninterrupted areas exactly (leg boundaries replay identical
+    # per-cycle computation; cross-leg additions happen on device via
+    # the re-fed accumulator columns).
+    theta = [1.0, 1.5]
+    base = integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS,
+                                      EPS, **KW)
+    path = str(tmp_path / "dd.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS, EPS,
+                                   checkpoint_path=path,
+                                   checkpoint_every=1,
+                                   _crash_after_legs=2, **KW)
+    res = resume_family_walker_dd(path, "sin_recip_scaled", theta, BOUNDS,
+                                  EPS, checkpoint_every=1, **KW)
+    assert np.array_equal(res.areas, base.areas)          # bit-for-bit
+    assert res.metrics.tasks == base.metrics.tasks
+    assert res.metrics.splits == base.metrics.splits
+    import os
+    assert not os.path.exists(path)   # completed run clears its snapshot
+
+
+def test_dd_resume_rejects_mismatched_identity(tmp_path):
+    theta = [1.0, 1.5]
+    path = str(tmp_path / "dd.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS, EPS,
+                                   checkpoint_path=path,
+                                   checkpoint_every=1,
+                                   _crash_after_legs=1, **KW)
+    with pytest.raises(ValueError, match="different run"):
+        resume_family_walker_dd(path, "sin_recip_scaled", theta, BOUNDS,
+                                1e-8, **KW)

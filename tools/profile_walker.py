@@ -46,11 +46,13 @@ def kernel_ceiling(lanes: int = 1 << 15, seg_iters: int = 256,
     fl = np.sin(th64 / a64).astype(np.float32)
     fr = np.sin(th64 / (a64 + w64)).astype(np.float32)
     zi = jnp.zeros((rows, 128), jnp.int32)
+    zj = jnp.array(z)
     s0 = WalkState(
         a_h=a_h, a_l=a_l, w_h=w_h, w_l=w_l, th_h=th_h, th_l=th_l,
-        fl_h=jnp.array(fl), fl_l=jnp.array(z),
-        fr_h=jnp.array(fr), fr_l=jnp.array(z),
-        acc_h=jnp.array(z), acc_l=jnp.array(z),
+        fl_h=jnp.array(fl), fl_l=zj,
+        fr_h=jnp.array(fr), fr_l=zj,
+        fm_h=zj, fm_l=zj, fq_h=zj, fq_l=zj,
+        acc_h=zj, acc_l=zj,
         i=zi, d=zi, base_d=zi, fam=zi, flags=zi,
         tasks=zi, splits=zi, maxd=zi)
 
