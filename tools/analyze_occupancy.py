@@ -1,0 +1,194 @@
+"""Decompose the flagship walker's wall time on real hardware.
+
+VERDICT r4 item 1: before touching the kernel, find out where the
+768 M -> 1 G subint/s gap actually lives. Three candidate sinks:
+
+1. Parked lane-steps inside kernel segments (lane_efficiency 0.50 vs
+   the ~0.67 trapezoid structural max: each task costs ~1.5 steps —
+   one TEST plus amortized ~0.5 LOAD/INIT — so tasks/(steps*lanes)
+   saturates at ~2/3 even at 100% occupancy).
+2. Non-kernel device time: breed (f64 bag BFS), drain, XLA boundary
+   work (bank/refill sorts, segment sums).
+3. Host/tunnel overhead: per-dispatch eager initial_bag ops, per-
+   collect device_get round-trips (~100-300 ms each on this rig).
+
+Prints a section per measurement; run on the real chip:
+    python tools/analyze_occupancy.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ppls_tpu.models.integrands import get_family, get_family_ds
+from ppls_tpu.parallel.bag_engine import initial_bag
+from ppls_tpu.parallel.walker import (MAX_REL_DEPTH, SEG_STAT_FIELDS,
+                                      CYCLE_STAT_FIELDS, DEFAULT_LANES,
+                                      collect_family_walker,
+                                      dispatch_family_walker,
+                                      integrate_family_walker)
+
+M = 1024
+EPS = 1e-10
+BOUNDS = (1e-4, 1.0)
+
+
+def sec(title):
+    print(f"\n=== {title} ===", flush=True)
+
+
+def main():
+    theta = 1.0 + np.arange(M) / M
+    f_theta = get_family("sin_recip_scaled")
+    f_ds = get_family_ds("sin_recip_scaled")
+    kw = dict(capacity=1 << 23)
+
+    sec("tunnel RTT (trivial device_get x5)")
+    x = jnp.zeros(8)
+    jax.device_get(x)
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get(x + 1.0)
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+    print(f"RTT median {rtt*1e3:.1f} ms  (all: "
+          f"{[round(r*1e3,1) for r in rtts]})")
+
+    sec("initial_bag eager construction cost")
+    for rep in range(3):
+        t0 = time.perf_counter()
+        st = initial_bag(np.tile(np.array(BOUNDS), (M, 1)), 1 << 23, M,
+                         1 << 17, theta=theta)
+        jax.block_until_ready(st.bag_l)
+        print(f"  pass {rep}: {time.perf_counter()-t0:.3f} s")
+
+    sec("warmup/compile (first full run)")
+    t0 = time.perf_counter()
+    res = integrate_family_walker(f_theta, f_ds, theta, BOUNDS, EPS, **kw)
+    print(f"compile+run: {time.perf_counter()-t0:.1f} s; "
+          f"tasks={res.metrics.tasks}, lane_eff={res.lane_efficiency:.3f}, "
+          f"walker_frac={res.walker_fraction:.3f}, cycles={res.cycles}")
+
+    sec("solo run (dispatch + collect, cache-warm)")
+    for rep in range(2):
+        t0 = time.perf_counter()
+        d = dispatch_family_walker(f_theta, f_ds, theta, BOUNDS, EPS, **kw)
+        t1 = time.perf_counter()
+        r = collect_family_walker(d)
+        t2 = time.perf_counter()
+        print(f"  pass {rep}: dispatch {t1-t0:.3f} s, collect {t2-t1:.3f} s"
+              f" -> rate {r.metrics.tasks/(t2-t0)/1e6:.0f} M/s"
+              f" (minus 1 RTT: {r.metrics.tasks/max(t2-t0-rtt,1e-9)/1e6:.0f})")
+
+    sec("pipeline of 5 (as bench.py does)")
+    t0 = time.perf_counter()
+    ds = [dispatch_family_walker(f_theta, f_ds, theta, BOUNDS, EPS, **kw)
+          for _ in range(5)]
+    t_disp = time.perf_counter() - t0
+    deltas = []
+    prev = time.perf_counter()
+    rs = []
+    for d in ds:
+        rs.append(collect_family_walker(d))
+        now = time.perf_counter()
+        deltas.append(now - prev)
+        prev = now
+    total = time.perf_counter() - t0
+    tasks = sum(r.metrics.tasks for r in rs)
+    print(f"dispatch-all {t_disp:.3f} s; collect deltas "
+          f"{[round(x,3) for x in deltas]} s; total {total:.3f} s "
+          f"-> sustained {tasks/total/1e6:.0f} M/s")
+
+    sec("single-dispatch x5 via fori-style re-dispatch of SAME state")
+    # All 5 dispatches share one prebuilt initial state: dispatch cost is
+    # then just jit-cache lookup + enqueue.
+    from ppls_tpu.parallel.walker import _run_cycles, WalkerDispatch
+    from ppls_tpu.config import Rule
+    target = min(12 * DEFAULT_LANES, (1 << 23) // 2)
+    breed_chunk = max(1 << int(target - 1).bit_length(), 1 << 15)
+    slack = max(breed_chunk, -(-(MAX_REL_DEPTH + 1) * DEFAULT_LANES // 2))
+    bounds_arr = np.tile(np.array(BOUNDS), (M, 1))
+    state = initial_bag(bounds_arr, 1 << 23, M, slack, theta=theta)
+    jax.block_until_ready(state.bag_l)
+    ck = dict(f_theta=f_theta, f_ds=f_ds, eps=float(EPS), m=M,
+              seg_iters=512, max_segments=1 << 18, min_active_frac=0.1,
+              exit_frac=0.65, suspend_frac=0.5, interpret=False,
+              lanes=DEFAULT_LANES, capacity=1 << 23,
+              breed_chunk=breed_chunk, target=target, max_cycles=64,
+              rule=Rule.TRAPEZOID)
+    t0 = time.perf_counter()
+    outs = [_run_cycles(state, **ck) for _ in range(5)]
+    t_disp = time.perf_counter() - t0
+    deltas = []
+    prev = time.perf_counter()
+    tot_tasks = 0
+    for o in outs:
+        tot_tasks += int(jax.device_get(o.tasks))
+        now = time.perf_counter()
+        deltas.append(now - prev)
+        prev = now
+    total = time.perf_counter() - t0
+    print(f"dispatch-all {t_disp:.3f} s; collect deltas "
+          f"{[round(x,3) for x in deltas]} s; total {total:.3f} s "
+          f"-> sustained {tot_tasks/total/1e6:.0f} M/s")
+
+    sec("seg_stats occupancy breakdown (from warm run)")
+    ss = res.seg_stats
+    if ss is None or not len(ss):
+        print("no seg_stats")
+    else:
+        steps = ss[:, 0].astype(np.float64)
+        live_exit = ss[:, 1].astype(np.float64)
+        queue_left = ss[:, 2].astype(np.float64)
+        refilled = ss[:, 3].astype(np.float64)
+        lanes = DEFAULT_LANES
+        # live at segment start ~= live at previous exit + that boundary's
+        # refills (segment 0 starts fully seeded)
+        live_start = np.empty_like(live_exit)
+        live_start[0] = min(lanes, refilled[0] if refilled[0] else lanes)
+        live_start[0] = lanes  # initial seeding fills all lanes
+        for k in range(1, len(ss)):
+            live_start[k] = min(lanes, live_exit[k - 1] + refilled[k])
+        # trapezoidal estimate of within-segment mean occupancy
+        occ = (live_start + live_exit) / (2 * lanes)
+        w = steps / steps.sum()
+        dry = queue_left <= 0
+        print(f"segments={len(ss)}  total steps={int(steps.sum())}  "
+              f"mean steps/seg={steps.mean():.0f}")
+        print(f"steps-weighted est. occupancy: {float((occ*w).sum()):.3f}")
+        print(f"dry-queue segments: {int(dry.sum())} "
+              f"({float(steps[dry].sum()/steps.sum()):.2%} of steps, "
+              f"est occ {float((occ[dry]*steps[dry]).sum()/max(steps[dry].sum(),1)):.3f})")
+        fed = ~dry
+        print(f"fed segments:       {int(fed.sum())} "
+              f"({float(steps[fed].sum()/steps.sum()):.2%} of steps, "
+              f"est occ {float((occ[fed]*steps[fed]).sum()/max(steps[fed].sum(),1)):.3f})")
+        # histogram of steps by est occupancy bucket
+        for lo in (0.9, 0.8, 0.7, 0.6, 0.5, 0.0):
+            m_ = occ >= lo
+            print(f"  occ>={lo:.1f}: {float(steps[m_].sum()/steps.sum()):.2%}"
+                  f" of steps ({int(m_.sum())} segs)")
+            steps = steps * ~m_  # remove counted
+            occ = np.where(m_, -1, occ)
+        print("first 12 rows [steps, live_exit, queue_left, refilled]:")
+        print(ss[:12].tolist())
+
+    sec("cyc_stats (from warm run)")
+    cs = res.cycle_stats
+    if cs is None or not len(cs):
+        print("no cyc_stats")
+    else:
+        print(f"fields: {CYCLE_STAT_FIELDS}")
+        for row in cs.tolist():
+            print("  ", row)
+
+
+if __name__ == "__main__":
+    main()
