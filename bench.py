@@ -282,8 +282,9 @@ def main():
 
     f_theta = get_family("sin_recip_scaled")
     f_ds = get_family_ds("sin_recip_scaled")
-    # The engine defaults (lanes=2^14, seg_iters=512, exit_frac=0.65,
-    # suspend_frac=0.5) are the round-4/5 sweep winners on v5e.
+    # The engine defaults (lanes=2^14, seg_iters=512, exit_frac=0.80,
+    # suspend_frac=0.5, sort_roots=True) are the round-5 sweep winners
+    # on v5e (work-sorted root windows; tools/analyze_occupancy.py).
     kw = dict(capacity=1 << 23)
 
     log("[bench] TPU warmup/compile ...")
@@ -448,10 +449,37 @@ def main():
 
     # Secondary per-round artifacts (VERDICT r4 #8): quick 2D + QMC
     # benches so BASELINE configs #4/#5 regressions are visible
-    # round-over-round. A failure here must not zero the primary.
+    # round-over-round, plus the Simpson walker's error-per-eval
+    # record at the same eps (VERDICT r4 #2: both rules benched behind
+    # one interface). A failure here must not zero the primary.
+    def bench_simpson():
+        from ppls_tpu.config import Rule
+        t1 = time.perf_counter()
+        rs = integrate_family_walker(f_theta, f_ds, theta, BOUNDS, EPS,
+                                     rule=Rule.SIMPSON, **kw)
+        wall_s = time.perf_counter() - t1
+        err_s = (float(np.max(np.abs(rs.areas - np.asarray(exact))))
+                 if abs_err is not None else None)
+        rec = {"metric": "simpson walker @ same eps",
+               "tasks": rs.metrics.tasks,
+               "integrand_evals": rs.metrics.integrand_evals,
+               "abs_error": err_s,
+               "walker_fraction": round(rs.walker_fraction, 4),
+               "wall_s_incl_compile_once": round(wall_s, 2),
+               # the comparison the record exists for: evals and error
+               # vs the trapezoid primary AT THE SAME per-interval eps
+               "trapezoid_integrand_evals": r.metrics.integrand_evals,
+               "trapezoid_abs_error": abs_err}
+        log(f"[bench-simpson] {rs.metrics.tasks} tasks, "
+            f"{rs.metrics.integrand_evals} evals (trapezoid: "
+            f"{r.metrics.integrand_evals}), abs err {err_s} "
+            f"(trapezoid: {abs_err})")
+        return rec
+
     secondary = {}
     for name, fn in (("2d", lambda: bench_2d(repeats=2)),
-                     ("qmc", lambda: bench_qmc(n=1 << 18, shifts=8))):
+                     ("qmc", lambda: bench_qmc(n=1 << 18, shifts=8)),
+                     ("simpson", bench_simpson)):
         try:
             secondary[name] = with_retry(fn, attempts_log,
                                          what=f"secondary {name}")

@@ -76,16 +76,16 @@ def build_parser() -> argparse.ArgumentParser:
                           "spreads over the whole mesh)")
     fam.add_argument("--rule", choices=["trapezoid", "simpson"],
                      default="trapezoid",
-                     help="both rules on the bag, walker, and "
-                          "sharded-bag engines (one interface, SURVEY.md "
-                          "§2 defect note); the sharded walkers are "
-                          "trapezoid-only and refuse simpson")
+                     help="both rules on every family engine behind one "
+                          "interface (SURVEY.md §2 defect note), "
+                          "including the sharded walkers")
     fam.add_argument("--chunk", type=int, default=1 << 13)
     fam.add_argument("--capacity", type=int, default=1 << 20)
     fam.add_argument("--n-devices", type=int, default=None)
     fam.add_argument("--checkpoint", default=None,
-                     help="snapshot path (bag/walker engines); resumes "
-                          "from it if it exists")
+                     help="snapshot path (bag, walker, sharded-bag, and "
+                          "sharded-walker-dd engines); resumes from it "
+                          "if it exists")
     fam.add_argument("--json", action="store_true", dest="as_json")
 
     t2d = sub.add_parser(
@@ -104,6 +104,9 @@ def build_parser() -> argparse.ArgumentParser:
     t2d.add_argument("--n-devices", type=int, default=None,
                      help="run the sharded engine over this many chips "
                           "(default: single-chip engine)")
+    t2d.add_argument("--checkpoint", default=None,
+                     help="snapshot path (sharded engine only); resumes "
+                          "from it if it exists")
     t2d.add_argument("--json", action="store_true", dest="as_json")
 
     qmc = sub.add_parser(
@@ -161,15 +164,11 @@ def _main_family(args) -> int:
                                           checkpoint_path=args.checkpoint,
                                           **wkw)
     elif args.engine == "sharded-walker-dd":
+        from ppls_tpu.config import Rule
         from ppls_tpu.parallel.sharded_walker import (
             integrate_family_walker_dd, resume_family_walker_dd)
-        if args.rule != "trapezoid":
-            raise SystemExit(
-                "--rule simpson is not available on the sharded walker "
-                "engines (trapezoid only); use --engine bag/walker or "
-                "sharded-bag for Simpson")
         dkw = dict(chunk=args.chunk, capacity=args.capacity,
-                   n_devices=args.n_devices)
+                   n_devices=args.n_devices, rule=Rule(args.rule))
         if args.checkpoint and os.path.exists(args.checkpoint):
             res = resume_family_walker_dd(args.checkpoint, args.family,
                                           theta, bounds, args.eps, **dkw)
@@ -179,23 +178,24 @@ def _main_family(args) -> int:
                 checkpoint_path=args.checkpoint, **dkw)
     elif args.engine == "sharded-bag":
         from ppls_tpu.config import Rule
-        from ppls_tpu.parallel.sharded_bag import integrate_family_sharded
-        res = integrate_family_sharded(args.family, theta, bounds,
-                                       args.eps, rule=Rule(args.rule),
-                                       chunk=args.chunk,
-                                       capacity=args.capacity,
-                                       n_devices=args.n_devices)
+        from ppls_tpu.parallel.sharded_bag import (integrate_family_sharded,
+                                                   resume_family_sharded)
+        skw = dict(rule=Rule(args.rule), chunk=args.chunk,
+                   capacity=args.capacity, n_devices=args.n_devices)
+        if args.checkpoint and os.path.exists(args.checkpoint):
+            res = resume_family_sharded(args.checkpoint, args.family,
+                                        theta, bounds, args.eps, **skw)
+        else:
+            res = integrate_family_sharded(
+                args.family, theta, bounds, args.eps,
+                checkpoint_path=args.checkpoint, **skw)
     else:
+        from ppls_tpu.config import Rule
         from ppls_tpu.parallel.walker import integrate_family_walker_sharded
-        if args.rule != "trapezoid":
-            raise SystemExit(
-                "--rule simpson is not available on the sharded walker "
-                "engines (trapezoid only); use --engine bag/walker or "
-                "sharded-bag for Simpson")
         res = integrate_family_walker_sharded(
             f, get_family_ds(args.family), theta, bounds, args.eps,
             chunk=args.chunk, capacity=args.capacity,
-            n_devices=args.n_devices)
+            rule=Rule(args.rule), n_devices=args.n_devices)
 
     m = res.metrics
     exact = family_exact(args.family, args.a, args.b, theta)
@@ -234,12 +234,26 @@ def _main_2d(args) -> int:
 
     entry = get_integrand_2d(args.integrand)
     exact = entry.exact(*args.bounds) if entry.exact else None
+    ckpt = getattr(args, "checkpoint", None)
     if args.n_devices:
-        res = integrate_2d_sharded(entry.fn, args.bounds, args.eps,
-                                   rule=Rule(args.rule), chunk=args.chunk,
-                                   capacity=args.capacity, exact=exact,
-                                   n_devices=args.n_devices)
+        import os
+
+        from ppls_tpu.parallel.cubature import resume_2d_sharded
+        kw2 = dict(rule=Rule(args.rule), chunk=args.chunk,
+                   capacity=args.capacity, exact=exact,
+                   n_devices=args.n_devices)
+        if ckpt and os.path.exists(ckpt):
+            res = resume_2d_sharded(ckpt, entry.fn, args.bounds,
+                                    args.eps, **kw2)
+        else:
+            res = integrate_2d_sharded(entry.fn, args.bounds, args.eps,
+                                       checkpoint_path=ckpt, **kw2)
     else:
+        if ckpt:
+            raise SystemExit(
+                "--checkpoint on the 2d mode requires --n-devices (only "
+                "the sharded 2D engine snapshots; the single-chip run "
+                "is one uninterruptible device program)")
         res = integrate_2d(entry.fn, args.bounds, args.eps,
                            rule=Rule(args.rule), chunk=args.chunk,
                            capacity=args.capacity, exact=exact)

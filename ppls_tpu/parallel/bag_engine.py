@@ -345,7 +345,9 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
     if checkpoint_path is None:
         out = _run_bag(state, **kw)
     else:
-        identity = _family_ckpt_identity("bag", f_theta, float(eps), m,
+        from ppls_tpu.runtime.checkpoint import engine_name
+        identity = _family_ckpt_identity(engine_name("bag", rule),
+                                         f_theta, float(eps), m,
                                          theta, bounds)
         legs = 0
         while True:
@@ -443,8 +445,9 @@ def resume_family(path: str, f_theta: Callable, theta: Sequence[float],
     bounds_np = np.asarray(bounds, dtype=np.float64)
     if bounds_np.ndim == 1:
         bounds_np = np.tile(bounds_np.reshape(1, 2), (m, 1))
-    identity = _family_ckpt_identity("bag", f_theta, float(eps), m,
-                                     theta_np, bounds_np)
+    from ppls_tpu.runtime.checkpoint import engine_name
+    identity = _family_ckpt_identity(engine_name("bag", rule), f_theta,
+                                     float(eps), m, theta_np, bounds_np)
     bag_cols, count, acc, totals = load_family_checkpoint(path, identity)
     fresh = initial_bag(bounds_np, capacity, m, chunk, theta=theta_np)
     state = _restore_bag(fresh, bag_cols, count, acc, totals)

@@ -34,9 +34,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh
 from ppls_tpu.utils.metrics import RunMetrics
 
-# Korobov generators selected by P_2 criterion, d=8, product weights
-# (host search over odd candidates, seed 42; see module docstring).
-KOROBOV_A = {1 << 16: 48557, 1 << 18: 172995, 1 << 20: 604413}
+# Korobov generators selected by the P_2 worst-case criterion, d=8,
+# product weights 2^-j — REPRODUCIBLE: ``python tools/korobov_search.py
+# --full`` re-derives exactly this table (256 seeded odd candidates per
+# size, incumbents included so a re-run can only confirm or improve).
+# Round-5 search superseded the round-2 constants (whose P_2 was 5-7x
+# worse: 48557 / 172995 / 604413) and added 2^22.
+KOROBOV_A = {1 << 16: 23497, 1 << 18: 94043, 1 << 20: 125599,
+             1 << 22: 728761}
 
 
 def lattice_block(n_total: int, a_gen: int, start, count: int, d: int,

@@ -64,6 +64,7 @@ from ppls_tpu.parallel.walker import (
     SEG_STAT_FIELDS,
     WalkerResult,
     _expand_pending,
+    _order_roots_by_work,
     _run_walk,
     _WalkCarry,
 )
@@ -113,7 +114,9 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                         min_active_frac: float, exit_frac: float,
                         suspend_frac: float, target_local: int,
                         interpret: bool,
-                        max_cycles: int, fill_l: float, fill_th: float):
+                        max_cycles: int, fill_l: float, fill_th: float,
+                        rule: Rule = Rule.TRAPEZOID,
+                        sort_roots: bool = True):
     """Jitted demand-driven walker leg, memoized per configuration.
 
     Runs up to ``max_cycles`` cycles (a checkpoint leg passes a smaller
@@ -153,7 +156,7 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
         def body(carry):
             s, _ = carry
             prev = lax.psum(s.count, axis)
-            return (_shard_bag_round(s, f_theta, eps, Rule.TRAPEZOID,
+            return (_shard_bag_round(s, f_theta, eps, rule,
                                      chunk, capacity, m, axis,
                                      fill_l, fill_th), prev)
 
@@ -174,26 +177,38 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
     def cycle_body(c: _DDCarry):
         bred = breed_collective(c)
+        local = _local_bag(bred, m)
+        if sort_roots:
+            # chip-LOCAL work-ordering of the balanced root share (the
+            # same homogeneous-refill-window win as the single-chip
+            # engine; no collectives — each chip sorts its own queue)
+            local = _order_roots_by_work(local, f_theta=f_theta,
+                                         eps=eps, rule=rule,
+                                         window=2 * chunk)
 
         # local walk on this chip's balanced root share (no collectives:
         # per-chip segment counts diverge freely)
         walk = _run_walk(
-            _local_bag(bred, m), f_ds=f_ds, eps=eps, m=m,
+            local, f_ds=f_ds, eps=eps, m=m,
             seg_iters=seg_iters, max_segments=max_segments,
             min_active_frac=min_active_frac, exit_frac=exit_frac,
             suspend_frac=suspend_frac, interpret=interpret, lanes=lanes,
             gsegs0=jnp.int32(0),
             seg_stats0=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)),
-                                 jnp.int32))
+                                 jnp.int32),
+            rule=rule)
         bag2 = _expand_pending(walk, capacity, m)
 
         # local drain of a small tail (per-chip gate; no collectives in
         # either branch, so chips may disagree freely)
         def drain(b: BagState):
+            # stop_count mirrors walker._run_cycles' drain (VERDICT r4
+            # #9): a sub-min_active remainder that regrows past the
+            # local root target goes back to the walker, not to f64
             return _run_bag(b, f_theta=f_theta, eps=eps,
-                            rule=Rule.TRAPEZOID, chunk=chunk,
+                            rule=rule, chunk=chunk,
                             capacity=capacity, max_iters=1 << 20,
-                            stop_count=None)
+                            stop_count=target_local)
 
         bag3 = lax.cond(bag2.count < min_active, drain, lambda b: b, bag2)
 
@@ -295,9 +310,11 @@ def integrate_family_walker_dd(
         seg_iters: int = 512,
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
-        exit_frac: float = 0.65,
+        exit_frac: float = 0.80,   # r5: see integrate_family_walker
         suspend_frac: float = 0.5,
         max_cycles: int = 64,
+        rule: Rule = Rule.TRAPEZOID,
+        sort_roots: bool = True,
         interpret: Optional[bool] = None,
         mesh: Optional[Mesh] = None,
         n_devices: Optional[int] = None,
@@ -338,7 +355,7 @@ def integrate_family_walker_dd(
         float(min_active_frac), float(exit_frac), float(suspend_frac),
         int(target_local), bool(interpret),
         int(checkpoint_every if checkpoint_path else max_cycles),
-        fill_l, fill_th)
+        fill_l, fill_th, Rule(rule), bool(sort_roots))
 
     if _state_override is not None:
         bag_l, bag_r, bag_th, bag_meta, count0 = _state_override
@@ -394,12 +411,14 @@ def integrate_family_walker_dd(
         cycles_done += int(np.max(cycles_h))
         if checkpoint_path is None or overflow or left == 0:
             break
-        if cycles_done >= max_cycles:
-            break
-        # leg boundary: snapshot every chip's live prefix + state
+        # leg boundary: snapshot every chip's live prefix + state.
+        # Snapshot BEFORE the max_cycles break (ADVICE r4): the
+        # non-convergence path must leave the final leg's state behind,
+        # so "raise max_cycles and resume" continues from the latest
+        # cycle instead of replaying the previous leg.
         from ppls_tpu.runtime.checkpoint import save_family_checkpoint
         identity = _dd_ckpt_identity(family, float(eps), m, theta, bounds,
-                                     n_dev)
+                                     n_dev, Rule(rule))
         counts = np.asarray(count_h, dtype=np.int32)
         b = min(1 << int(max(int(counts.max()), 1)).bit_length(), store)
         bl2 = np.asarray(jax.device_get(bl.reshape(n_dev, store)[:, :b]))
@@ -421,6 +440,8 @@ def integrate_family_walker_dd(
         if _crash_after_legs is not None and legs >= _crash_after_legs:
             raise RuntimeError(
                 f"simulated crash after {legs} legs (test hook)")
+        if cycles_done >= max_cycles:
+            break
         state = (bl, br, bth, bmeta, count, acc)
         counters = (tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
                     rounds_c, segs_c, wsteps_c, maxd_c,
@@ -456,8 +477,14 @@ def integrate_family_walker_dd(
         leaves=tasks - tot["splits"],
         rounds=tot["rounds"] + tot["segs"],
         max_depth=tot["max_depth"],
-        integrand_evals=3 * tot["btasks"]
-        + 2 * wtasks - tot["wsplits"] + tot["roots"],
+        integrand_evals=(
+            3 * tot["btasks"] + 2 * wtasks - tot["wsplits"]
+            + tot["roots"]
+            + (3 * tot["roots"] if sort_roots else 0)
+            if Rule(rule) == Rule.TRAPEZOID else
+            5 * tot["btasks"] + 4 * wtasks - 2 * tot["wsplits"]
+            + tot["roots"]
+            + (5 * tot["roots"] if sort_roots else 0)),
         wall_time_s=wall,
         n_chips=n_dev,
         tasks_per_chip=tasks_per_chip,
@@ -473,9 +500,11 @@ def integrate_family_walker_dd(
 
 
 def _dd_ckpt_identity(family: str, eps: float, m: int, theta: np.ndarray,
-                      bounds: np.ndarray, n_dev: int) -> dict:
-    from ppls_tpu.runtime.checkpoint import _family_identity
-    ident = _family_identity("walker-dd", family, eps, m, theta, bounds)
+                      bounds: np.ndarray, n_dev: int,
+                      rule: Rule = Rule.TRAPEZOID) -> dict:
+    from ppls_tpu.runtime.checkpoint import _family_identity, engine_name
+    ident = _family_identity(engine_name("walker-dd", rule), family, eps,
+                             m, theta, bounds)
     ident["n_dev"] = n_dev       # per-chip state: mesh size is identity
     return ident
 
@@ -497,7 +526,8 @@ def resume_family_walker_dd(
     kwargs.pop("n_devices", None)
     n_dev = mesh.devices.size
     identity = _dd_ckpt_identity(family, float(eps), m, theta_np,
-                                 bounds_np, n_dev)
+                                 bounds_np, n_dev,
+                                 Rule(kwargs.get("rule", Rule.TRAPEZOID)))
     bag_cols, _count, acc, totals = load_family_checkpoint(path, identity)
 
     # rebuild full-width per-chip stores around the saved live prefixes
@@ -511,6 +541,17 @@ def resume_family_walker_dd(
     fill_th = float(theta_np[0])
     counts = np.asarray(bag_cols["counts"], dtype=np.int32)
     b = bag_cols["l"].shape[1]
+    # Sizing mismatch guard (ADVICE r4): the snapshot's prefix width and
+    # live counts must fit the store computed from THIS call's kwargs,
+    # or the overlay below would fail with an opaque broadcast error (or
+    # silently change breed sizing vs the saved run).
+    if b > store or int(counts.max(initial=0)) > store:
+        raise ValueError(
+            f"resume sizing mismatch: snapshot prefix width {b} (max "
+            f"live count {int(counts.max(initial=0))}) does not fit the "
+            f"store {store} computed from this call's lanes/capacity/"
+            f"chunk/roots_per_lane; resume with the original run's "
+            f"sizing parameters")
     bag_l = np.full((n_dev, store), fill_l)
     bag_r = np.full((n_dev, store), fill_l)
     bag_th = np.full((n_dev, store), fill_th)

@@ -109,6 +109,14 @@ class Checkpointer:
 # MB of mostly dead slots) never leaves the device.
 
 
+def engine_name(base: str, rule) -> str:
+    """Snapshot engine-name convention: the rule is part of the engine
+    identity (a Simpson snapshot must never resume a trapezoid run).
+    Trapezoid keeps the bare name for back-compat with older snapshots."""
+    rule = Rule(rule)
+    return base if rule == Rule.TRAPEZOID else f"{base}-{rule.value}"
+
+
 def _family_identity(engine: str, fname: str, eps: float, m: int,
                      theta: np.ndarray, bounds: np.ndarray) -> dict:
     import hashlib

@@ -88,3 +88,46 @@ def test_dd_resume_rejects_mismatched_identity(tmp_path):
     with pytest.raises(ValueError, match="different run"):
         resume_family_walker_dd(path, "sin_recip_scaled", theta, BOUNDS,
                                 1e-8, **KW)
+
+
+def test_dd_simpson_parity_on_mesh():
+    """VERDICT r4 #2: both rules behind one interface on the sharded
+    walkers. Simpson through the full collective-breed dd engine on the
+    virtual 8-mesh must match the f64 Simpson bag within the ds
+    contract and still balance the mesh."""
+    from ppls_tpu.config import Rule
+
+    theta = 1.0 + np.arange(4) / 4.0
+    r = integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS,
+                                   EPS, rule=Rule.SIMPSON, **KW)
+    b = integrate_family(get_family("sin_recip_scaled"), theta, BOUNDS,
+                         EPS, rule=Rule.SIMPSON,
+                         chunk=1 << 10, capacity=1 << 17)
+    # interpret-mode ds Simpson vs f64: borderline-flip contract (the
+    # walker module docstring), looser than the trapezoid 1e-9 above
+    assert np.max(np.abs(r.areas - b.areas)) < 1e-7
+    drift = abs(r.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 0.3, (r.metrics.tasks, b.metrics.tasks)
+    tpc = r.metrics.tasks_per_chip
+    assert max(tpc) / max(min(tpc), 1) < 3.0, tpc
+    # Simpson's O(h^6) convergence leaves only ~10k tasks across 8 chips
+    # at this eps — breed covers most of it; the assert pins ENGAGEMENT
+    # (kernel ran at all), parity above pins correctness
+    assert r.walker_fraction > 0.05, r.walker_fraction
+
+
+def test_dd_simpson_checkpoint_identity_distinct(tmp_path):
+    # a Simpson snapshot must not resume a trapezoid run (engine name
+    # carries the rule)
+    from ppls_tpu.config import Rule
+
+    theta = [1.0, 1.5]
+    path = str(tmp_path / "dd.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS, EPS,
+                                   checkpoint_path=path,
+                                   checkpoint_every=1, rule=Rule.SIMPSON,
+                                   _crash_after_legs=1, **KW)
+    with pytest.raises(ValueError, match="different run"):
+        resume_family_walker_dd(path, "sin_recip_scaled", theta, BOUNDS,
+                                EPS, **KW)   # trapezoid resume: refused

@@ -251,3 +251,43 @@ def test_walker_simpson_beats_trapezoid_on_smooth():
     assert np.max(np.abs(ws.areas - exact)) < 1e-6
     assert ws.metrics.tasks < wt.metrics.tasks / 4, (
         ws.metrics.tasks, wt.metrics.tasks)
+
+
+def test_walker_engages_on_collapsing_frontier():
+    """VERDICT r4 #9: a family mix whose BFS frontier is non-monotone —
+    collapsing far below the breed target mid-breed (63 trivial members
+    accept in round one: frontier 64 -> 2) while ONE deep member has
+    barely started — must still engage the walker, not silently
+    degrade into an f64 bag run.
+
+    What actually protects this edge (verified by cyc_stats here): each
+    _breed call resets its peak detector, so the graduated-chunk breed
+    phases and the next cycle's re-breed regrow the surviving deep
+    frontier 2 -> target even though the mixed frontier shrank
+    round-over-round; and the f64 drain stops at stop_count=target, so
+    a sub-min_active remainder that regrows is handed back to the
+    walker rather than run to completion in f64.
+
+    The floor is 0.25, not the flagship's 0.99: on a ~2.3k-task
+    workload the 2->256 regrowth itself processes a large share of all
+    tasks in the breed phases (measured fraction ~0.36; a silent
+    degradation reads ~0.0).
+    """
+    m = 64
+    theta = 1.0 + np.arange(m) / m
+    bounds = np.tile([0.7, 0.7 + 2.0 ** -10], (m, 1))
+    bounds[0] = [1e-2, 1.0]     # the deep member: ~2.3k-task subtree
+    eps = 1e-7
+    w = integrate_family_walker(F, F_DS, theta, bounds, eps, **KW)
+    b = integrate_family(F, theta, bounds, eps,
+                         chunk=1 << 10, capacity=1 << 16)
+    # ds-vs-f64 divergence on the deep member: the module contract at
+    # eps=1e-7 on oscillatory domains is ~100x-eps-level (borderline
+    # split flips), not the 3e-9 of the shallow-mix parity test above
+    assert np.max(np.abs(w.areas - b.areas)) < 1e-5
+    drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 0.05, (w.metrics.tasks, b.metrics.tasks)
+    # the deep member dominates the task count; the walker must own a
+    # solid share of it despite the collapse
+    assert w.metrics.tasks > 20 * m          # the mix IS deep-dominated
+    assert w.walker_fraction > 0.25, w.walker_fraction

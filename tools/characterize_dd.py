@@ -1,0 +1,99 @@
+"""VERDICT r4 #3: measure the demand-driven walker's overhead at mesh=1
+on the real chip vs the single-chip walker, same flagship workload, and
+compare shipped vs flagship-matched dd sizing.
+
+The dd engine's collective breed costs all_gather/psum traffic plus
+lockstep breed rounds; at mesh=1 those collectives are degenerate, so
+this bounds the ENGINE-STRUCTURE overhead (collective-breed code path,
+per-leg host sync) separately from real ICI costs (unmeasurable on a
+1-chip rig).
+
+Run on the real TPU: ``python tools/characterize_dd.py``
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+M = 1024
+EPS = 1e-10
+BOUNDS = (1e-4, 1.0)
+
+
+def median_wall(fn, n=3):
+    walls = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = fn()
+        walls.append(time.perf_counter() - t0)
+    return float(np.median(walls)), r
+
+
+def main():
+    from ppls_tpu.models.integrands import get_family, get_family_ds
+    from ppls_tpu.parallel.mesh import make_mesh
+    from ppls_tpu.parallel.sharded_walker import integrate_family_walker_dd
+    from ppls_tpu.parallel.walker import integrate_family_walker
+
+    theta = 1.0 + np.arange(M) / M
+    f, fds = get_family("sin_recip_scaled"), get_family_ds(
+        "sin_recip_scaled")
+    mesh1 = make_mesh(1)
+
+    # RTT estimate to subtract from solo walls
+    jax.device_get(jnp.zeros(8))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get(jnp.zeros(8) + 1.0)
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+    print(f"RTT ~{rtt*1e3:.0f} ms", flush=True)
+
+    def run_single():
+        return integrate_family_walker(f, fds, theta, BOUNDS, EPS,
+                                       capacity=1 << 23)
+
+    def run_dd_matched():
+        return integrate_family_walker_dd(
+            "sin_recip_scaled", theta, BOUNDS, EPS,
+            chunk=1 << 15, capacity=1 << 22, lanes=1 << 14,
+            roots_per_lane=12, mesh=mesh1)
+
+    def run_dd_shipped():
+        return integrate_family_walker_dd(
+            "sin_recip_scaled", theta, BOUNDS, EPS,
+            capacity=1 << 22, mesh=mesh1)   # shipped lanes=2^12 etc.
+
+    rows = []
+    for name, fn in (("single-chip walker", run_single),
+                     ("dd mesh=1 matched (lanes=2^14)", run_dd_matched),
+                     ("dd mesh=1 shipped (lanes=2^12)", run_dd_shipped)):
+        t0 = time.perf_counter()
+        r = fn()                      # compile + first run
+        print(f"{name}: compile+run {time.perf_counter()-t0:.0f}s",
+              flush=True)
+        wall, r = median_wall(fn, 3)
+        net = max(wall - rtt, 1e-9)
+        rate = r.metrics.tasks / net
+        rows.append((name, r.metrics.tasks, wall, rate,
+                     r.walker_fraction, r.lane_efficiency))
+        print(f"{name}: median wall {wall:.3f}s (-RTT {net:.3f}s) "
+              f"-> {rate/1e6:.0f} M subint/s, tasks={r.metrics.tasks}, "
+              f"wfrac={r.walker_fraction:.3f}, "
+              f"laneeff={r.lane_efficiency:.3f}", flush=True)
+
+    base = rows[0][3]
+    print("\nsummary (rate vs single-chip):")
+    for name, tasks, wall, rate, wf, le in rows:
+        print(f"  {name}: {rate/1e6:7.0f} M/s  ({rate/base*100:5.1f}%)")
+
+
+if __name__ == "__main__":
+    main()
