@@ -263,6 +263,9 @@ def run_cpu_baseline(theta):
 
 
 def main():
+    from ppls_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
+
     theta = 1.0 + np.arange(M) / M
     attempts_log = []
 
@@ -613,6 +616,8 @@ def main_qmc():
 
 
 if __name__ == "__main__":
+    from ppls_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
     if len(sys.argv) > 1 and sys.argv[1] == "2d":
         sys.exit(main_2d())
     if len(sys.argv) > 1 and sys.argv[1] == "qmc":

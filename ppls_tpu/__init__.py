@@ -41,7 +41,6 @@ from ppls_tpu.parallel.sharded import sharded_integrate  # noqa: E402
 from ppls_tpu.parallel.bag_engine import integrate_family, resume_family  # noqa: E402
 from ppls_tpu.parallel.walker import (  # noqa: E402
     integrate_family_walker,
-    integrate_family_walker_sharded,
     resume_family_walker,
 )
 from ppls_tpu.parallel.sharded_bag import integrate_family_sharded  # noqa: E402
@@ -72,7 +71,6 @@ __all__ = [
     "integrate_family",
     "resume_family",
     "integrate_family_walker",
-    "integrate_family_walker_sharded",
     "resume_family_walker",
     "integrate_family_sharded",
     "integrate_2d",

@@ -70,10 +70,11 @@ def build_parser() -> argparse.ArgumentParser:
                               "sharded-walker", "sharded-walker-dd"],
                      default="bag",
                      help="bag: chunked-LIFO f64; walker: Pallas ds "
-                          "flagship; sharded-bag/-walker: multi-chip "
-                          "variants; sharded-walker-dd: demand-driven "
-                          "cross-chip root rebalancing (one deep family "
-                          "spreads over the whole mesh)")
+                          "flagship; sharded-bag: multi-chip bag; "
+                          "sharded-walker / sharded-walker-dd (aliases): "
+                          "the flagship across the mesh via demand-"
+                          "driven cross-chip root rebalancing (one deep "
+                          "family spreads over the whole mesh)")
     fam.add_argument("--rule", choices=["trapezoid", "simpson"],
                      default="trapezoid",
                      help="both rules on every family engine behind one "
@@ -163,7 +164,9 @@ def _main_family(args) -> int:
             res = integrate_family_walker(f, fds, theta, bounds, args.eps,
                                           checkpoint_path=args.checkpoint,
                                           **wkw)
-    elif args.engine == "sharded-walker-dd":
+    elif args.engine in ("sharded-walker-dd", "sharded-walker"):
+        # one multi-chip flagship path since round 5 (the pmap family-
+        # deal variant was retired; see parallel/walker.py's note)
         from ppls_tpu.config import Rule
         from ppls_tpu.parallel.sharded_walker import (
             integrate_family_walker_dd, resume_family_walker_dd)
@@ -190,12 +193,7 @@ def _main_family(args) -> int:
                 args.family, theta, bounds, args.eps,
                 checkpoint_path=args.checkpoint, **skw)
     else:
-        from ppls_tpu.config import Rule
-        from ppls_tpu.parallel.walker import integrate_family_walker_sharded
-        res = integrate_family_walker_sharded(
-            f, get_family_ds(args.family), theta, bounds, args.eps,
-            chunk=args.chunk, capacity=args.capacity,
-            rule=Rule(args.rule), n_devices=args.n_devices)
+        raise SystemExit(f"unknown family engine {args.engine!r}")
 
     m = res.metrics
     exact = family_exact(args.family, args.a, args.b, theta)
@@ -308,8 +306,10 @@ def _main_qmc(args) -> int:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    from ppls_tpu.utils.compile_cache import enable_compile_cache
     from ppls_tpu.utils.tracing import trace
 
+    enable_compile_cache()
     with trace(getattr(args, "trace", None)):
         return _dispatch(args)
 

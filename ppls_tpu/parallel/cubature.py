@@ -352,12 +352,17 @@ def integrate_2d_sharded(f: Callable, bounds, eps: float,
     fx = 0.5 * (ax + bx)
     fy = 0.5 * (ay + by)
 
-    lx = np.full((n_dev, store), fx)
-    rx = np.full((n_dev, store), fx)
-    ly = np.full((n_dev, store), fy)
-    ry = np.full((n_dev, store), fy)
-    meta = np.zeros((n_dev, store), dtype=np.int32)
-    lx[0, 0], rx[0, 0], ly[0, 0], ry[0, 0] = ax, bx, ay, by
+    # device-side seeding: one root rectangle on chip 0, fill elsewhere
+    # (host np.full of the whole store would ship ~MBs-to-100s-of-MB
+    # through the tunnel per call — see walker.py's seeding note)
+    def _dev_seed(fill, r0c0, dtype=jnp.float64):
+        return jnp.full((n_dev, store), fill, dtype).at[0, 0].set(r0c0)
+
+    lx = _dev_seed(fx, ax)
+    rx = _dev_seed(fx, bx)
+    ly = _dev_seed(fy, ay)
+    ry = _dev_seed(fy, by)
+    meta = jnp.zeros((n_dev, store), dtype=jnp.int32)
     count0 = np.zeros(n_dev, dtype=np.int32)
     count0[0] = 1
 
@@ -379,11 +384,11 @@ def integrate_2d_sharded(f: Callable, bounds, eps: float,
         mesh, f, float(eps),
         Rule(rule), int(chunk), int(capacity), int(max_iters), fx, fy)
     t0 = time.perf_counter()
-    state = (jnp.asarray(np.asarray(lx).reshape(-1)),
-             jnp.asarray(np.asarray(rx).reshape(-1)),
-             jnp.asarray(np.asarray(ly).reshape(-1)),
-             jnp.asarray(np.asarray(ry).reshape(-1)),
-             jnp.asarray(np.asarray(meta).reshape(-1)),
+    state = (jnp.asarray(lx).reshape(-1),
+             jnp.asarray(rx).reshape(-1),
+             jnp.asarray(ly).reshape(-1),
+             jnp.asarray(ry).reshape(-1),
+             jnp.asarray(meta).reshape(-1),
              jnp.asarray(count0, dtype=jnp.int32),
              jnp.asarray(acc0),
              jnp.asarray(ctr["tasks"]), jnp.asarray(ctr["splits"]),
@@ -489,16 +494,14 @@ def resume_2d_sharded(path: str, f: Callable, bounds, eps: float,
     ax, bx, ay, by = (float(v) for v in bounds)
     fx = 0.5 * (ax + bx)
     fy = 0.5 * (ay + by)
-    lx = np.full((n_dev, store), fx)
-    rx = np.full((n_dev, store), fx)
-    ly = np.full((n_dev, store), fy)
-    ry = np.full((n_dev, store), fy)
-    meta = np.zeros((n_dev, store), dtype=np.int32)
-    lx[:, :b] = bag_cols["lx"]
-    rx[:, :b] = bag_cols["rx"]
-    ly[:, :b] = bag_cols["ly"]
-    ry[:, :b] = bag_cols["ry"]
-    meta[:, :b] = bag_cols["meta"]
+
+    # device-side store rebuild: only the saved prefixes transfer
+    from ppls_tpu.parallel.mesh import device_store
+    lx = device_store(n_dev, store, fx, bag_cols["lx"])
+    rx = device_store(n_dev, store, fx, bag_cols["rx"])
+    ly = device_store(n_dev, store, fy, bag_cols["ly"])
+    ry = device_store(n_dev, store, fy, bag_cols["ry"])
+    meta = device_store(n_dev, store, 0, bag_cols["meta"], jnp.int32)
 
     totals = dict(totals)
     totals["acc_per_chip"] = np.asarray(acc)

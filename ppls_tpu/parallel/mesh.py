@@ -35,6 +35,29 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = FRONTIER_AXIS) -
     return jax.make_mesh((len(devs),), (axis_name,), devices=devs)
 
 
+def device_store(n_dev: int, store: int, fill, block,
+                 dtype=jnp.float64) -> jnp.ndarray:
+    """(n_dev, store) per-chip column built ON DEVICE: jnp.full of the
+    fill value plus one prefix write of the small host ``block``
+    ((n_dev, b) seed entries or a resume snapshot's live prefixes).
+
+    Shared by every sharded engine's seed/resume path. Do NOT replace
+    with host np.full: shipping a full store through this rig's tunnel
+    costs seconds-to-tens-of-seconds per call — the round-5 dd-walker
+    characterization traced its entire apparent 20-70x overhead to
+    exactly that (fixed: mesh=1 dd throughput ~102% of single-chip).
+
+    ``fill`` may be a scalar or an (n_dev,)-shaped per-chip vector.
+    """
+    fill = jnp.asarray(fill, dtype)
+    if fill.ndim == 0:
+        base = jnp.full((n_dev, store), fill, dtype)
+    else:
+        base = jnp.broadcast_to(fill[:, None], (n_dev, store))
+    block = jnp.asarray(block, dtype)
+    return base.at[:, : block.shape[1]].set(block)
+
+
 def strided_reshard(axis: str, cols: Sequence[jnp.ndarray],
                     n_valid: jnp.ndarray, fills: Sequence,
                     out_width: int) -> Tuple[tuple, jnp.ndarray,
@@ -59,23 +82,41 @@ def strided_reshard(axis: str, cols: Sequence[jnp.ndarray],
     n_dev = lax.axis_size(axis)
     my = lax.axis_index(axis)
     width = cols[0].shape[0]
+    if out_width > width:
+        raise ValueError(f"out_width={out_width} exceeds column "
+                         f"width={width}")
     counts = lax.all_gather(n_valid, axis)               # (n_dev,)
-    offsets = jnp.cumsum(counts) - counts
     total = jnp.sum(counts)
 
-    local_pos = jnp.arange(width, dtype=jnp.int32)
-    glob_size = n_dev * width
-    valid = local_pos[None, :] < counts[:, None]
-    slot = jnp.where(valid, offsets[:, None] + local_pos[None, :],
-                     jnp.asarray(glob_size, jnp.int32))
-    flat_slot = slot.reshape(-1)
+    # Compact the n_dev gathered prefixes into ONE dense global prefix
+    # with a stable multi-operand sort (invalid rows keyed to the tail):
+    # block order is preserved, so the dense row order is identical to
+    # the round-4 scatter construction — but the sort costs ~2.4 ms at
+    # 2^19 rows where the computed-index scatter + gather it replaces
+    # measured ~65 ms (TPU serializes computed-index scatters; the
+    # round-5 dd-walker characterization traced its 20-70x mesh=1
+    # overhead to exactly this, ~2x per round per column).
+    pos = jnp.arange(width, dtype=jnp.int32)
+    valid = (pos[None, :] < counts[:, None]).reshape(-1)
+    key = jnp.logical_not(valid).astype(jnp.int32)
+    gathered = [lax.all_gather(c, axis).reshape(-1) for c in cols]
+    sorted_cols = lax.sort((key, *gathered), dimension=0,
+                           is_stable=True, num_keys=1)[1:]
+
+    # Chip d takes dense rows d, d + n_dev, d + 2*n_dev, ...: a column
+    # of the (width, n_dev) reshape — one dynamic_slice at (0, my), no
+    # computed-index gather.
     take = my + jnp.arange(out_width, dtype=jnp.int32) * n_dev
     mine = take < total
 
     outs = []
-    for col, fill in zip(cols, fills):
-        g = jnp.full(glob_size, fill, dtype=col.dtype)
-        g = g.at[flat_slot].set(lax.all_gather(col, axis).reshape(-1),
-                                mode="drop")
-        outs.append(jnp.where(mine, g[take], jnp.asarray(fill, col.dtype)))
+    for dense, fill in zip(sorted_cols, fills):
+        fillv = jnp.asarray(fill, dense.dtype)
+        # rows past `total` hold sorted-to-the-tail invalid payloads,
+        # but every such row this chip reads has take >= total and the
+        # `mine` mask below replaces it with fill
+        col2 = lax.dynamic_slice(dense.reshape(width, n_dev),
+                                 (jnp.zeros((), my.dtype), my),
+                                 (width, 1))[:, 0]
+        outs.append(jnp.where(mine, col2[:out_width], fillv))
     return tuple(outs), mine, total

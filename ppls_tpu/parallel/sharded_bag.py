@@ -49,7 +49,8 @@ from ppls_tpu.parallel.bag_engine import (
     FamilyResult,
     MAX_FAMILIES,
 )
-from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh, strided_reshard
+from ppls_tpu.parallel.mesh import (FRONTIER_AXIS, device_store,
+                                    make_mesh, strided_reshard)
 from ppls_tpu.utils.metrics import RunMetrics
 
 
@@ -263,22 +264,31 @@ def integrate_family_sharded(
     fill_th = float(theta[0])
 
     # Seed family j on chip j % n_dev, at the bottom of its local bag.
+    # Host builds only the (n_dev, seeds_per) blocks; store-sized
+    # columns are jnp.full ON DEVICE + one prefix write (the host
+    # np.full version shipped the whole store through the tunnel —
+    # see walker.py's seeding note).
     seeds_per = -(-m // n_dev)
     if seeds_per > capacity:
         raise ValueError(f"{m} seeds exceed mesh capacity")
-    bag_l = np.full((n_dev, store), fill_l)
-    bag_r = np.full((n_dev, store), fill_l)
-    bag_th = np.full((n_dev, store), fill_th)
-    bag_meta = np.zeros((n_dev, store), dtype=np.int32)
+    seed_l = np.full((n_dev, seeds_per), fill_l)
+    seed_r = np.full((n_dev, seeds_per), fill_l)
+    seed_th = np.full((n_dev, seeds_per), fill_th)
+    seed_meta = np.zeros((n_dev, seeds_per), dtype=np.int32)
     count0 = np.zeros(n_dev, dtype=np.int32)
     for j in range(m):
         c = j % n_dev
         k = count0[c]
-        bag_l[c, k] = bounds[j, 0]
-        bag_r[c, k] = bounds[j, 1]
-        bag_th[c, k] = theta[j]
-        bag_meta[c, k] = j << DEPTH_BITS
+        seed_l[c, k] = bounds[j, 0]
+        seed_r[c, k] = bounds[j, 1]
+        seed_th[c, k] = theta[j]
+        seed_meta[c, k] = j << DEPTH_BITS
         count0[c] = k + 1
+
+    bag_l = device_store(n_dev, store, fill_l, seed_l)
+    bag_r = device_store(n_dev, store, fill_l, seed_r)
+    bag_th = device_store(n_dev, store, fill_th, seed_th)
+    bag_meta = device_store(n_dev, store, 0, seed_meta, jnp.int32)
 
     run = build_sharded_family_run(
         mesh, family, float(eps), Rule(rule), int(chunk), int(capacity),
@@ -299,10 +309,10 @@ def integrate_family_sharded(
         bag_l, bag_r, bag_th, bag_meta, count0 = _state_override
 
     t0 = time.perf_counter()
-    state = (jnp.asarray(np.asarray(bag_l).reshape(-1)),
-             jnp.asarray(np.asarray(bag_r).reshape(-1)),
-             jnp.asarray(np.asarray(bag_th).reshape(-1)),
-             jnp.asarray(np.asarray(bag_meta).reshape(-1)),
+    state = (jnp.asarray(bag_l).reshape(-1),
+             jnp.asarray(bag_r).reshape(-1),
+             jnp.asarray(bag_th).reshape(-1),
+             jnp.asarray(bag_meta).reshape(-1),
              jnp.asarray(count0, dtype=jnp.int32),
              jnp.asarray(acc0),
              jnp.asarray(ctr0["tasks"]), jnp.asarray(ctr0["splits"]),
@@ -431,14 +441,12 @@ def resume_family_sharded(
             f" resume with the original run's sizing parameters")
     fill_l = float(0.5 * (bounds_np[0, 0] + bounds_np[0, 1]))
     fill_th = float(theta_np[0])
-    bag_l = np.full((n_dev, store), fill_l)
-    bag_r = np.full((n_dev, store), fill_l)
-    bag_th = np.full((n_dev, store), fill_th)
-    bag_meta = np.zeros((n_dev, store), dtype=np.int32)
-    bag_l[:, :b] = bag_cols["l"]
-    bag_r[:, :b] = bag_cols["r"]
-    bag_th[:, :b] = bag_cols["th"]
-    bag_meta[:, :b] = bag_cols["meta"]
+
+    # device-side store rebuild: only the saved prefixes transfer
+    bag_l = device_store(n_dev, store, fill_l, bag_cols["l"])
+    bag_r = device_store(n_dev, store, fill_l, bag_cols["r"])
+    bag_th = device_store(n_dev, store, fill_th, bag_cols["th"])
+    bag_meta = device_store(n_dev, store, 0, bag_cols["meta"], jnp.int32)
 
     totals = dict(totals)
     # prefer the binary-exact npz accumulator over the JSON round-trip

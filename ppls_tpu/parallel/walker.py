@@ -1614,184 +1614,14 @@ def resume_family_walker(
         _state_override=state, _totals_override=totals)
 
 
-def integrate_family_walker_sharded(
-        f_theta: Callable, f_ds: Callable, theta: Sequence[float],
-        bounds, eps: float,
-        chunk: int = 1 << 15,
-        capacity: int = 1 << 22,
-        lanes: int = DEFAULT_LANES,
-        roots_per_lane: int = 12,
-        seg_iters: int = 512,
-        max_segments: int = 1 << 18,
-        min_active_frac: float = 0.1,
-        exit_frac: float = 0.80,   # r5: see integrate_family_walker
-        suspend_frac: float = 0.5,
-        max_cycles: int = 64,
-        rule: Rule = Rule.TRAPEZOID,
-        sort_roots: bool = True,
-        interpret: Optional[bool] = None,
-        mesh=None, n_devices: Optional[int] = None) -> WalkerResult:
-    """The flagship walker across a ``jax.sharding.Mesh``.
-
-    Decomposition: FAMILIES are dealt round-robin over the mesh axis and
-    each chip runs the complete breed/walk/expand/drain cycle engine on
-    its own subset — the natural parallel axis for BASELINE config #3
-    ("1024 independent 1D integrals"), with per-chip queues exactly like
-    the reference's per-worker task streams. (Task-level demand-driven
-    rebalancing across chips lives in ``sharded_bag.py``; combining the
-    two — per-chip walkers fed from a globally rebalanced root queue —
-    is the planned follow-up.) There are NO collectives at all, so
-    per-chip cycle counts diverge freely; one final gather assembles
-    the areas. ``capacity``/``lanes`` are PER CHIP.
-
-    Results match the single-chip walker per family up to banking-order
-    f64 noise (~1e-12; tested on the virtual mesh).
-    """
-    from ppls_tpu.parallel.mesh import make_mesh
-
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    if lanes % 128:
-        raise ValueError(f"lanes must be a multiple of 128, got {lanes}")
-    if mesh is None:
-        mesh = make_mesh(n_devices)
-    n_dev = mesh.devices.size
-
-    theta = np.asarray(theta, dtype=np.float64)
-    m = theta.shape[0]
-    bounds = np.asarray(bounds, dtype=np.float64)
-    if bounds.ndim == 1:
-        bounds = np.tile(bounds.reshape(1, 2), (m, 1))
-    from ppls_tpu.models.integrands import check_ds_domain
-    check_ds_domain(f_ds, bounds, theta)
-
-    target, breed_chunk, slack_chunk = walker_sizing(
-        lanes, roots_per_lane, capacity, chunk)
-    store = capacity + 2 * slack_chunk
-    m_local = -(-m // n_dev)
-
-    # Deal family g to chip g % n_dev, local slot g // n_dev; chips whose
-    # subset is short get zero-width dummy seeds (accept immediately with
-    # area 0; their slots are dropped at reassembly).
-    bag_l = np.empty((n_dev, store))
-    bag_r = np.empty((n_dev, store))
-    bag_th = np.empty((n_dev, store))
-    bag_meta = np.zeros((n_dev, store), dtype=np.int32)
-    counts = np.zeros(n_dev, dtype=np.int32)
-    for c in range(n_dev):
-        mine = np.arange(c, m, n_dev)
-        # chips with no families fall back to global family 0's domain:
-        # fills must be IN-DOMAIN for some family (dead/dummy lanes still
-        # evaluate the integrand — initial_bag's dead-slot note; an
-        # out-of-domain point can NaN or hit the emulated-f64
-        # transcendental slow path).
-        f0 = int(mine[0]) if mine.size else 0
-        fill = float(0.5 * (bounds[f0, 0] + bounds[f0, 1]))
-        bag_l[c, :] = fill
-        bag_r[c, :] = fill
-        bag_th[c, :] = float(theta[f0])
-        for jj in range(m_local):
-            g = c + jj * n_dev
-            if g < m:
-                bag_l[c, jj] = bounds[g, 0]
-                bag_r[c, jj] = bounds[g, 1]
-                bag_th[c, jj] = theta[g]
-            else:   # dummy: zero-width at the fill point
-                bag_l[c, jj] = fill
-                bag_r[c, jj] = fill
-            bag_meta[c, jj] = jj << DEPTH_BITS
-        counts[c] = m_local
-
-    kw = dict(f_theta=f_theta, f_ds=f_ds, eps=float(eps), m=int(m_local),
-              seg_iters=int(seg_iters), max_segments=int(max_segments),
-              min_active_frac=float(min_active_frac),
-              exit_frac=float(exit_frac),
-              suspend_frac=float(suspend_frac),
-              interpret=bool(interpret), lanes=int(lanes),
-              capacity=int(capacity), breed_chunk=int(breed_chunk),
-              target=int(target), max_cycles=int(max_cycles),
-              rule=Rule(rule), sort_roots=bool(sort_roots))
-
-    def chip_body(bl, br, bth, bmeta, cnt):
-        bag = BagState(
-            bag_l=bl, bag_r=br, bag_th=bth, bag_meta=bmeta,
-            count=cnt,
-            acc=jnp.zeros(m_local, jnp.float64),
-            tasks=jnp.zeros((), jnp.int64),
-            splits=jnp.zeros((), jnp.int64),
-            iters=jnp.zeros((), jnp.int64),
-            max_depth=jnp.zeros((), jnp.int32),
-            overflow=jnp.zeros((), bool),
-        )
-        out = _run_cycles(bag, **kw)
-        return (out.acc, out.tasks, out.splits,
-                out.btasks, out.wtasks, out.wsplits,
-                out.roots, out.rounds, out.segs, out.wsteps,
-                out.maxd, out.cycles, out.overflow,
-                out.bag.count)
-
-    # No collectives anywhere in the engine, so each chip's program is
-    # fully independent (per-chip cycle counts diverge freely) — pmap
-    # expresses that directly; shard_map's varying-manual-axes tracking
-    # would require pcast plumbing through every internal while_loop for
-    # zero semantic benefit here.
-    run = jax.pmap(chip_body, devices=list(mesh.devices.flatten()))
-
-    t0 = time.perf_counter()
-    out = run(jnp.asarray(bag_l), jnp.asarray(bag_r),
-              jnp.asarray(bag_th), jnp.asarray(bag_meta),
-              jnp.asarray(counts))
-    (acc_c, tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c, rounds_c,
-     segs_c, wsteps_c, maxd_c, cycles_c, ovf_c, left_c) = \
-        jax.device_get(out)
-    wall = time.perf_counter() - t0
-
-    if bool(np.any(ovf_c)):
-        raise RuntimeError("sharded walker bag overflowed; raise capacity")
-    if int(np.sum(left_c)) > 0:
-        raise RuntimeError(
-            f"sharded walker did not converge ({int(np.sum(left_c))} "
-            f"tasks left); raise max_cycles")
-
-    # reassemble the round-robin family deal
-    areas = np.empty(m, dtype=np.float64)
-    acc_c = np.asarray(acc_c, dtype=np.float64)
-    for c in range(n_dev):
-        mine = np.arange(c, m, n_dev)
-        areas[mine] = acc_c[c, : mine.size]
-    if not np.all(np.isfinite(areas)):
-        bad = int(np.sum(~np.isfinite(areas)))
-        raise FloatingPointError(
-            f"sharded walker produced {bad}/{areas.size} non-finite areas")
-
-    tasks_per_chip = [int(t) for t in np.asarray(tasks_c)]
-    tasks = sum(tasks_per_chip)
-    wtasks = int(np.sum(wt_c))
-    segs = int(np.sum(segs_c))
-    metrics = RunMetrics(
-        tasks=tasks,
-        splits=int(np.sum(splits_c)),
-        leaves=tasks - int(np.sum(splits_c)),
-        rounds=int(np.sum(rounds_c)) + segs,
-        max_depth=int(np.max(maxd_c)),
-        integrand_evals=(
-            3 * int(np.sum(bt_c)) + 2 * wtasks - int(np.sum(ws_c))
-            + int(np.sum(roots_c))
-            + (3 * int(np.sum(roots_c)) if sort_roots else 0)
-            if Rule(rule) == Rule.TRAPEZOID else
-            5 * int(np.sum(bt_c)) + 4 * wtasks - 2 * int(np.sum(ws_c))
-            + int(np.sum(roots_c))
-            + (5 * int(np.sum(roots_c)) if sort_roots else 0)),
-        wall_time_s=wall,
-        n_chips=n_dev,
-        tasks_per_chip=tasks_per_chip,
-    )
-    denom = int(np.sum(wsteps_c)) * lanes
-    return WalkerResult(
-        areas=areas,
-        metrics=metrics,
-        lane_efficiency=wtasks / denom if denom else 0.0,
-        walker_fraction=wtasks / tasks if tasks else 0.0,
-        cycles=int(np.max(cycles_c)),
-        lanes=int(lanes),
-    )
+# NOTE (round 5): the pmap-based ``integrate_family_walker_sharded``
+# (round-robin family deal, per-chip cycle engines, zero collectives)
+# was RETIRED in favor of the demand-driven engine
+# (``sharded_walker.integrate_family_walker_dd``). Rationale, with the
+# measured numbers (tools/characterize_dd.py, v5e, flagship workload):
+# the dd engine's mesh=1 throughput is ~102% of this file's single-chip
+# engine once its seed state is built on device — the apparent 20-70x
+# "collective overhead" of rounds 3-4 was host-built store transfer
+# over the tunnel, not collectives — so the pmap path's only advantage
+# (no collectives) was worth ~0%, while it could not balance skewed
+# families, could not checkpoint, and rode a deprecation-tracked API.

@@ -1,0 +1,32 @@
+"""Persistent XLA compilation cache (opt-out via PPLS_NO_COMPILE_CACHE).
+
+Full walker-cycle programs take minutes to compile on this rig's
+remote-compile path, and every process (bench, CLI, TPU test lane,
+tools) used to pay that again: the round-5 TPU lane spent ~14 of its
+15:39 minutes recompiling programs the bench had already built.
+Verified on the tunneled backend: a 232 s compile replays from the
+on-disk cache in ~3 s in a fresh process.
+
+Keyed by HLO hash, so stale entries are impossible — a code change
+simply misses and recompiles.
+"""
+
+import os
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point JAX at a persistent on-disk compilation cache and return
+    its path (None when disabled via PPLS_NO_COMPILE_CACHE=1)."""
+    if os.environ.get("PPLS_NO_COMPILE_CACHE"):
+        return None
+    import jax
+
+    path = (path or os.environ.get("PPLS_COMPILE_CACHE")
+            or os.path.join(os.path.expanduser("~"), ".cache",
+                            "ppls_tpu_xla"))
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache anything that took noticeable compile time; tiny programs
+    # recompile faster than they deserialize
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    return path

@@ -35,6 +35,13 @@ if not TPU_LANE:
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compile cache: the TPU lane's full-cycle programs take
+# minutes each on the remote-compile path; cached replays take seconds
+# (utils/compile_cache.py). Safe for the CPU lane too (HLO-hash keyed).
+from ppls_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 
 def pytest_collection_modifyitems(config, items):
     """Skip @pytest.mark.tpu tests unless a real accelerator is visible."""

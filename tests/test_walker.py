@@ -126,23 +126,27 @@ def test_walker_rejects_bad_lanes():
 
 
 def test_walker_sharded_matches_single_chip():
-    # Family-sharded walkers on the virtual 8-device mesh: same per-
-    # family computations up to banking-order/borderline-flip ds noise.
+    # The multi-chip flagship path (the demand-driven engine — the pmap
+    # family-deal variant was retired in round 5, see walker.py's note)
+    # on the virtual 8-device mesh: same per-family computations up to
+    # banking-order/borderline-flip ds noise vs the single-chip walker.
     from ppls_tpu.parallel.mesh import make_mesh
-    from ppls_tpu.parallel.walker import integrate_family_walker_sharded
+    from ppls_tpu.parallel.sharded_walker import integrate_family_walker_dd
 
     theta = 1.0 + np.arange(12) / 12.0
     eps = 1e-7
-    s = integrate_family_walker_sharded(F, F_DS, theta, BOUNDS, eps,
-                                        mesh=make_mesh(8), **KW)
+    s = integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS, eps,
+                                   mesh=make_mesh(8), chunk=1 << 8, **KW)
     b = integrate_family_walker(F, F_DS, theta, BOUNDS, eps, **KW)
-    assert np.max(np.abs(s.areas - b.areas)) < 3e-9
+    assert np.max(np.abs(s.areas - b.areas)) < 1e-7
     drift = abs(s.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
-    assert drift < 1e-3
+    assert drift < 0.01
     assert s.metrics.n_chips == 8
     assert len(s.metrics.tasks_per_chip) == 8
     assert sum(s.metrics.tasks_per_chip) == s.metrics.tasks
-    assert s.walker_fraction > 0.3
+    # engagement: areas alone can't tell an all-f64 run from a walker
+    # run — the Pallas kernel must own a real share on the mesh too
+    assert s.walker_fraction > 0.2, s.walker_fraction
 
 
 def test_walker_gauss_family():
@@ -167,16 +171,18 @@ def test_walker_gauss_family():
 
 
 def test_walker_sharded_more_chips_than_families():
-    # Chips with no assigned families idle on in-domain dummy seeds.
+    # More chips than seed families: the collective breed re-shards the
+    # three trees over all 8 chips; idle-at-seed chips still join.
     from ppls_tpu.parallel.mesh import make_mesh
-    from ppls_tpu.parallel.walker import integrate_family_walker_sharded
+    from ppls_tpu.parallel.sharded_walker import integrate_family_walker_dd
 
     theta = np.array([1.0, 1.5, 2.0])
-    s = integrate_family_walker_sharded(F, F_DS, theta, BOUNDS, 1e-6,
-                                        mesh=make_mesh(8), **KW)
+    s = integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS,
+                                   1e-6, mesh=make_mesh(8),
+                                   chunk=1 << 8, **KW)
     b = integrate_family_walker(F, F_DS, theta, BOUNDS, 1e-6, **KW)
     assert np.all(np.isfinite(s.areas))
-    assert np.max(np.abs(s.areas - b.areas)) < 3e-9
+    assert np.max(np.abs(s.areas - b.areas)) < 1e-7
 
 
 def test_ds_domain_guard_rejects_out_of_range():
@@ -202,11 +208,11 @@ def test_ds_domain_guard_rejects_out_of_range():
 
 
 def test_ds_domain_guard_sharded_entry():
-    from ppls_tpu.parallel.walker import integrate_family_walker_sharded
+    from ppls_tpu.parallel.sharded_walker import integrate_family_walker_dd
     with pytest.raises(ValueError, match="Cody-Waite"):
-        integrate_family_walker_sharded(F, F_DS, [2.0], (1e-7, 1.0), 1e-6,
-                                        capacity=1 << 14, lanes=256,
-                                        n_devices=2)
+        integrate_family_walker_dd("sin_recip_scaled", [2.0], (1e-7, 1.0),
+                                   1e-6, capacity=1 << 14, lanes=256,
+                                   n_devices=2)
 
 
 def test_walker_simpson_matches_bag_simpson():

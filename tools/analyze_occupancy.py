@@ -22,6 +22,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from ppls_tpu.utils.compile_cache import enable_compile_cache
+enable_compile_cache()
+
 import jax
 import jax.numpy as jnp
 import numpy as np

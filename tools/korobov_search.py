@@ -25,6 +25,13 @@ Run (CPU, ~1 min for the three shipped sizes; 2^22 adds ~2 min):
     python tools/korobov_search.py --full     # + 2^22
 
 and paste the printed table into ``ppls_tpu/parallel/qmc.py``.
+
+Validation (round 5, real v5e): the shipped table's N=2^22 generator
+integrates all six 8D Genz families to worst relative error 3.8e-4
+(8 random shifts, seed 17; oscillatory is the worst case — stderr
+5.5e-6, consistent with lattice bias, not shift noise), well inside
+the bench gate of 1e-2; N=2^18 measures 3.4e-4 with the new table vs
+1.1e-3 with the superseded round-2 constants on the same suite.
 """
 
 import argparse
