@@ -72,8 +72,10 @@ import numpy as np
 M = 1024           # family size (BASELINE.json config #3: 1024 integrals)
 EPS = 1e-10
 BOUNDS = (1e-4, 1.0)
-REPEATS = 10       # pipelined runs; fixed ~0.3 s of tunnel overhead
-                   # (final RTT + collect chain) amortizes across them
+REPEATS = 16       # pipelined runs; the pipeline's fixed ~0.25 s of
+                   # tunnel overhead (final RTT + collect chain) is
+                   # ~19% of a 10-run pipeline at ~0.13 s/run — 16
+                   # runs cut that to ~12% for +0.8 s of bench time
 CPU_SAMPLE = 8     # C-baseline scales actually timed
 CPU_MAX_PASSES = 5  # fastest-of-k passes for a contention-stable C rate
 CPU_TARGET_COV = 0.10
@@ -263,9 +265,6 @@ def run_cpu_baseline(theta):
 
 
 def main():
-    from ppls_tpu.utils.compile_cache import enable_compile_cache
-    enable_compile_cache()
-
     theta = 1.0 + np.arange(M) / M
     attempts_log = []
 
@@ -285,7 +284,7 @@ def main():
 
     f_theta = get_family("sin_recip_scaled")
     f_ds = get_family_ds("sin_recip_scaled")
-    # The engine defaults (lanes=2^14, seg_iters=512, exit_frac=0.80,
+    # The engine defaults (lanes=2^14, seg_iters=2048, exit_frac=0.80,
     # suspend_frac=0.5, sort_roots=True) are the round-5 sweep winners
     # on v5e (work-sorted root windows; tools/analyze_occupancy.py).
     kw = dict(capacity=1 << 23)

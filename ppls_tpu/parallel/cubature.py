@@ -354,14 +354,18 @@ def integrate_2d_sharded(f: Callable, bounds, eps: float,
 
     # device-side seeding: one root rectangle on chip 0, fill elsewhere
     # (host np.full of the whole store would ship ~MBs-to-100s-of-MB
-    # through the tunnel per call — see walker.py's seeding note)
-    def _dev_seed(fill, r0c0, dtype=jnp.float64):
-        return jnp.full((n_dev, store), fill, dtype).at[0, 0].set(r0c0)
+    # through the tunnel per call — see mesh.device_store)
+    from ppls_tpu.parallel.mesh import device_store
 
-    lx = _dev_seed(fx, ax)
-    rx = _dev_seed(fx, bx)
-    ly = _dev_seed(fy, ay)
-    ry = _dev_seed(fy, by)
+    def _seed_col(fill, r0c0):
+        block = np.full((n_dev, 1), fill)
+        block[0, 0] = r0c0
+        return device_store(n_dev, store, fill, block)
+
+    lx = _seed_col(fx, ax)
+    rx = _seed_col(fx, bx)
+    ly = _seed_col(fy, ay)
+    ry = _seed_col(fy, by)
     meta = jnp.zeros((n_dev, store), dtype=jnp.int32)
     count0 = np.zeros(n_dev, dtype=np.int32)
     count0[0] = 1

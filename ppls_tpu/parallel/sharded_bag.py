@@ -207,6 +207,42 @@ def build_sharded_family_run(mesh: Mesh, family: str, eps: float,
     ))
 
 
+def round_robin_seed_state(theta: np.ndarray, bounds: np.ndarray,
+                           n_dev: int, store: int, capacity: int,
+                           fill_l: float, fill_th: float):
+    """Deal family j to chip j % n_dev at the bottom of its local bag;
+    returns device-built (n_dev, store) columns + per-chip counts.
+
+    Shared by the sharded bag and the demand-driven walker (one seeding
+    scheme, one capacity guard). Host materializes only the
+    (n_dev, seeds_per) blocks; the stores are jnp.full on device
+    (mesh.device_store — see its note on why host np.full is banned).
+    """
+    m = theta.shape[0]
+    seeds_per = max(-(-m // n_dev), 1)
+    if seeds_per > capacity:
+        raise ValueError(f"{m} seed tasks exceed per-chip "
+                         f"capacity {capacity} on {n_dev} chips")
+    seed_l = np.full((n_dev, seeds_per), fill_l)
+    seed_r = np.full((n_dev, seeds_per), fill_l)
+    seed_th = np.full((n_dev, seeds_per), fill_th)
+    seed_meta = np.zeros((n_dev, seeds_per), dtype=np.int32)
+    count0 = np.zeros(n_dev, dtype=np.int32)
+    for j in range(m):
+        c = j % n_dev
+        k = count0[c]
+        seed_l[c, k] = bounds[j, 0]
+        seed_r[c, k] = bounds[j, 1]
+        seed_th[c, k] = theta[j]
+        seed_meta[c, k] = j << DEPTH_BITS
+        count0[c] = k + 1
+    return (device_store(n_dev, store, fill_l, seed_l),
+            device_store(n_dev, store, fill_l, seed_r),
+            device_store(n_dev, store, fill_th, seed_th),
+            device_store(n_dev, store, 0, seed_meta, jnp.int32),
+            count0)
+
+
 def _sharded_bag_identity(family: str, eps: float, m: int,
                           theta: np.ndarray, bounds: np.ndarray,
                           n_dev: int, rule: Rule) -> dict:
@@ -263,32 +299,8 @@ def integrate_family_sharded(
     fill_l = float(0.5 * (bounds[0, 0] + bounds[0, 1]))
     fill_th = float(theta[0])
 
-    # Seed family j on chip j % n_dev, at the bottom of its local bag.
-    # Host builds only the (n_dev, seeds_per) blocks; store-sized
-    # columns are jnp.full ON DEVICE + one prefix write (the host
-    # np.full version shipped the whole store through the tunnel —
-    # see walker.py's seeding note).
-    seeds_per = -(-m // n_dev)
-    if seeds_per > capacity:
-        raise ValueError(f"{m} seeds exceed mesh capacity")
-    seed_l = np.full((n_dev, seeds_per), fill_l)
-    seed_r = np.full((n_dev, seeds_per), fill_l)
-    seed_th = np.full((n_dev, seeds_per), fill_th)
-    seed_meta = np.zeros((n_dev, seeds_per), dtype=np.int32)
-    count0 = np.zeros(n_dev, dtype=np.int32)
-    for j in range(m):
-        c = j % n_dev
-        k = count0[c]
-        seed_l[c, k] = bounds[j, 0]
-        seed_r[c, k] = bounds[j, 1]
-        seed_th[c, k] = theta[j]
-        seed_meta[c, k] = j << DEPTH_BITS
-        count0[c] = k + 1
-
-    bag_l = device_store(n_dev, store, fill_l, seed_l)
-    bag_r = device_store(n_dev, store, fill_l, seed_r)
-    bag_th = device_store(n_dev, store, fill_th, seed_th)
-    bag_meta = device_store(n_dev, store, 0, seed_meta, jnp.int32)
+    bag_l, bag_r, bag_th, bag_meta, count0 = round_robin_seed_state(
+        theta, bounds, n_dev, store, capacity, fill_l, fill_th)
 
     run = build_sharded_family_run(
         mesh, family, float(eps), Rule(rule), int(chunk), int(capacity),

@@ -289,35 +289,16 @@ def _dd_sizing(lanes: int, capacity: int, chunk: int,
 
 
 def _seed_state(bounds: np.ndarray, theta: np.ndarray, n_dev: int,
-                store: int, fill_l: float, fill_th: float):
-    """Round-robin family seeds; the first collective breed rounds
-    rebalance everything anyway, the deal just avoids an empty chip 0
-    corner case.
-
-    Host builds only the (n_dev, seeds_per) seed blocks; the
-    store-sized columns are jnp.full ON DEVICE with one prefix write —
-    the round-4 host np.full version shipped the whole ~150 MB store
-    through the tunnel per call (see walker.py's seeding note)."""
-    m = theta.shape[0]
-    seeds_per = max(-(-m // n_dev), 1)
-    seed_l = np.full((n_dev, seeds_per), fill_l)
-    seed_r = np.full((n_dev, seeds_per), fill_l)
-    seed_th = np.full((n_dev, seeds_per), fill_th)
-    seed_meta = np.zeros((n_dev, seeds_per), dtype=np.int32)
-    count0 = np.zeros(n_dev, dtype=np.int32)
-    for j in range(m):
-        chip = j % n_dev
-        k = count0[chip]
-        seed_l[chip, k] = bounds[j, 0]
-        seed_r[chip, k] = bounds[j, 1]
-        seed_th[chip, k] = theta[j]
-        seed_meta[chip, k] = j << DEPTH_BITS
-        count0[chip] = k + 1
-
-    return (device_store(n_dev, store, fill_l, seed_l),
-            device_store(n_dev, store, fill_l, seed_r),
-            device_store(n_dev, store, fill_th, seed_th),
-            device_store(n_dev, store, 0, seed_meta, jnp.int32), count0)
+                store: int, capacity: int, fill_l: float,
+                fill_th: float):
+    """Round-robin family seeds (the shared sharded-bag scheme —
+    ``sharded_bag.round_robin_seed_state``, device-built stores +
+    capacity guard); the first collective breed rounds rebalance
+    everything anyway, the deal just avoids an empty chip 0 corner
+    case."""
+    from ppls_tpu.parallel.sharded_bag import round_robin_seed_state
+    return round_robin_seed_state(theta, bounds, n_dev, store, capacity,
+                                  fill_l, fill_th)
 
 
 def integrate_family_walker_dd(
@@ -326,7 +307,7 @@ def integrate_family_walker_dd(
         capacity: int = 1 << 20,
         lanes: int = 1 << 12,
         roots_per_lane: int = 12,
-        seg_iters: int = 512,
+        seg_iters: int = 2048,  # see walker.py
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
         exit_frac: float = 0.80,   # r5: see integrate_family_walker
@@ -380,7 +361,7 @@ def integrate_family_walker_dd(
         bag_l, bag_r, bag_th, bag_meta, count0 = _state_override
     else:
         bag_l, bag_r, bag_th, bag_meta, count0 = _seed_state(
-            bounds, theta, n_dev, store, fill_l, fill_th)
+            bounds, theta, n_dev, store, capacity, fill_l, fill_th)
 
     # All per-chip counters live on-device and are passed back in across
     # legs, so totals are simply the latest values and a resumed run

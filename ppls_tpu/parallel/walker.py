@@ -1236,7 +1236,8 @@ def integrate_family_walker(
         capacity: int = 1 << 23,
         lanes: int = DEFAULT_LANES,
         roots_per_lane: int = 12,
-        seg_iters: int = 512,
+        seg_iters: int = 2048,  # cap only: early-exit ends segments; r5 probe
+        #                           showed 512's forced cap boundaries cost ~1%
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
         exit_frac: float = 0.80,    # r5 sweep: with work-sorted root
@@ -1563,7 +1564,8 @@ def resume_family_walker(
         capacity: int = 1 << 23,
         lanes: int = DEFAULT_LANES,
         roots_per_lane: int = 12,
-        seg_iters: int = 512,
+        seg_iters: int = 2048,  # cap only: early-exit ends segments; r5 probe
+        #                           showed 512's forced cap boundaries cost ~1%
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
         exit_frac: float = 0.80,   # r5: see integrate_family_walker

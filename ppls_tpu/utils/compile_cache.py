@@ -1,14 +1,18 @@
 """Persistent XLA compilation cache (opt-out via PPLS_NO_COMPILE_CACHE).
 
-Full walker-cycle programs take minutes to compile on this rig's
-remote-compile path, and every process (bench, CLI, TPU test lane,
-tools) used to pay that again: the round-5 TPU lane spent ~14 of its
-15:39 minutes recompiling programs the bench had already built.
-Verified on the tunneled backend: a 232 s compile replays from the
-on-disk cache in ~3 s in a fresh process.
-
 Keyed by HLO hash, so stale entries are impossible — a code change
 simply misses and recompiles.
+
+Measured reach on this rig (round 5): XLA-only programs replay across
+processes (a 232 s compile returned in ~3 s from a fresh process), but
+programs embedding Mosaic/Pallas custom calls — the walker cycle
+engines — MISS across processes (the flagship recompiled in ~300 s
+from a warm 245 MB cache; the serialized kernel payload appears to
+carry process-varying bytes that perturb the key). Net: the bag/2D/
+QMC/sharded non-walker programs and all within-process reuse benefit;
+the walker's cross-process compile cost remains until the upstream
+key instability is fixed. Left enabled because it never hurts
+correctness and already removes minutes from mixed workloads.
 """
 
 import os

@@ -6,11 +6,18 @@ on this host: per-launch overhead is ~0.07 ms and the tunneled device
 adds ~100 ms per sync, so K separate launches measure dispatch, not
 compute (see the round-3 ceiling analysis in the git log).
 
-Run: ``python tools/profile_walker.py`` (real TPU). Typical v5e output:
-~1.5 G lane-steps/s at full occupancy; at ~1.5 steps per subinterval
-that is a ~1 G subintervals/s kernel ceiling, against which the engine's
-lane efficiency (WalkerResult.lane_efficiency) positions the current
-run.
+Run: ``python tools/profile_walker.py`` (real TPU).
+
+ROUND-5 CORRECTION: the single-dispatch wall time here includes ONE
+tunnel RTT (~120-220 ms on this rig), which at the default workload is
+comparable to the compute itself — the round-3 "1.5 G lane-steps/s"
+ceiling derived from this tool was RTT-polluted. Measuring the same
+kernel by two-point slope (64 vs 512 outer restarts, differencing
+cancels the constant overhead) gives ~4.55 G lane-steps/s at 2^14
+lanes on v5e — i.e. the kernel is ~3x faster than round 3 believed,
+and the engine's lane_efficiency (structural max ~2/3 for the
+trapezoid DFS: ~1.5 steps per task) is the honest utilization number
+to optimize. Prefer the slope method for any future ceiling numbers.
 """
 
 import time
