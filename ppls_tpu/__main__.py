@@ -18,6 +18,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m ppls_tpu",
         description="TPU-native adaptive quadrature (ppls_tpu)",
+        # no prefix abbreviation: the ROOT parser classifies every argv
+        # string before subcommand dispatch, so a subcommand's exact
+        # flag (`qmc --n`) would otherwise die as an "ambiguous"
+        # abbreviation of the root's --n-devices/--n-workers
+        allow_abbrev=False,
     )
     p.add_argument("--integrand", default="cosh4",
                    help="registered integrand name (default: cosh4, the "
@@ -82,6 +87,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "including the sharded walkers")
     fam.add_argument("--chunk", type=int, default=1 << 13)
     fam.add_argument("--capacity", type=int, default=1 << 20)
+    fam.add_argument("--refill-slots", type=int, default=0,
+                     help="walker engine only: R > 0 deals R work-"
+                          "sorted roots per lane into a private VMEM "
+                          "bank and the kernel refills its own lanes — "
+                          "zero boundary sorts (the flagship bench "
+                          "config uses 8); 0 = legacy XLA-boundary "
+                          "refill")
     fam.add_argument("--n-devices", type=int, default=None)
     fam.add_argument("--checkpoint", default=None,
                      help="snapshot path (bag, walker, sharded-bag, and "
@@ -156,7 +168,8 @@ def _main_family(args) -> int:
                                               resume_family_walker)
         fds = get_family_ds(args.family)
         wkw = dict(chunk=args.chunk, capacity=args.capacity,
-                   rule=Rule(args.rule))
+                   rule=Rule(args.rule),
+                   refill_slots=args.refill_slots)
         if args.checkpoint and os.path.exists(args.checkpoint):
             res = resume_family_walker(args.checkpoint, f, fds, theta,
                                        bounds, args.eps, **wkw)

@@ -294,7 +294,8 @@ def _build_sharded_2d_run(mesh, f: Callable, eps: float,
                 out.overflow[None])
 
     sharded = P(axis)
-    return jax.jit(jax.shard_map(
+    from ppls_tpu.parallel.mesh import shard_map_compat
+    return jax.jit(shard_map_compat(
         shard_body, mesh=mesh,
         in_specs=(sharded,) * 13, out_specs=(sharded,) * 12))
 

@@ -18,6 +18,31 @@ from jax.sharding import Mesh
 FRONTIER_AXIS = "d"
 
 
+def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs,
+                     check_vma: Optional[bool] = None):
+    """``jax.shard_map`` across the jax versions this repo meets.
+
+    ``jax.shard_map`` (with its ``check_vma`` flag) only exists in newer
+    jax; this environment's 0.4.x exposes the same transform as
+    ``jax.experimental.shard_map.shard_map`` with the flag spelled
+    ``check_rep``. Every sharded engine routes through this shim so the
+    whole multi-chip test surface runs on either API.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    from jax.experimental.shard_map import shard_map as sm_exp
+    # The legacy checker has no replication rule for `while` — every
+    # engine here runs its cycle loop as lax.while_loop under shard_map
+    # — so the check must be off (the replication points are explicit
+    # psums either way; the checker is a static validator, not a
+    # semantics change).
+    return sm_exp(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = FRONTIER_AXIS) -> Mesh:
     """1-D device mesh over the frontier axis.
 
@@ -79,7 +104,7 @@ def strided_reshard(axis: str, cols: Sequence[jnp.ndarray],
     gate a collective while_loop; a per-chip flag would let chips exit
     on different rounds and desynchronize the collectives).
     """
-    n_dev = lax.axis_size(axis)
+    n_dev = lax.psum(1, axis)   # lax.axis_size is newer-jax only
     my = lax.axis_index(axis)
     width = cols[0].shape[0]
     if out_width > width:

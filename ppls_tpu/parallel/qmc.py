@@ -31,7 +31,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh
+from ppls_tpu.parallel.mesh import (FRONTIER_AXIS, make_mesh,
+                                    shard_map_compat)
 from ppls_tpu.utils.metrics import RunMetrics
 
 # Korobov generators selected by the P_2 worst-case criterion, d=8,
@@ -85,7 +86,7 @@ def _build_qmc_run(mesh: Mesh, fn_name: str, fn: Callable, n_total: int,
         total = lax.psum(partial, axis)                # ONE collective
         return (total / n_total)[None, :]              # (1, n_shifts)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         shard_body, mesh=mesh,
         in_specs=(P(), P(), P()),
         out_specs=P(axis, None),

@@ -35,7 +35,8 @@ from ppls_tpu.models.integrands import get_integrand
 from ppls_tpu.ops.rules import EVALS_PER_TASK, eval_batch
 from ppls_tpu.ops.reduction import kahan_add
 from ppls_tpu.parallel.device_engine import compact_children
-from ppls_tpu.parallel.mesh import FRONTIER_AXIS, make_mesh, strided_reshard
+from ppls_tpu.parallel.mesh import (FRONTIER_AXIS, make_mesh,
+                                    shard_map_compat, strided_reshard)
 from ppls_tpu.utils.metrics import RunMetrics
 
 
@@ -57,7 +58,7 @@ class ShardState(NamedTuple):
 def _shard_round(state: ShardState, f, eps: float, rule: Rule,
                  cap: int, axis: str, fill: float = 1.0) -> ShardState:
     """One sharded wavefront round. ``cap`` is capacity per chip."""
-    n_dev = lax.axis_size(axis)
+    n_dev = lax.psum(1, axis)   # lax.axis_size is newer-jax only
 
     # --- evaluate local shard (the worker step, aquadPartA.c:183-202) ---
     value, _err, split = eval_batch(state.l, state.r, f, eps, rule)
@@ -137,7 +138,7 @@ def build_sharded_run(mesh: Mesh, integrand: str, eps: float, rule: Rule,
 
     sharded = P(axis)
     per_chip = P(axis)  # per-chip scalars stored as (n_dev,) arrays
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map_compat(
         shard_body, mesh=mesh,
         in_specs=(sharded,) * 3 + (per_chip,) * 6,
         out_specs=(sharded,) * 3 + (per_chip,) * 6,

@@ -50,7 +50,8 @@ from ppls_tpu.parallel.bag_engine import (
     MAX_FAMILIES,
 )
 from ppls_tpu.parallel.mesh import (FRONTIER_AXIS, device_store,
-                                    make_mesh, strided_reshard)
+                                    make_mesh, shard_map_compat,
+                                    strided_reshard)
 from ppls_tpu.utils.metrics import RunMetrics
 
 
@@ -200,7 +201,7 @@ def build_sharded_family_run(mesh: Mesh, family: str, eps: float,
                 out.overflow[None])
 
     sharded = P(axis)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         shard_body, mesh=mesh,
         in_specs=(sharded,) * 4 + (sharded,) * 8,
         out_specs=(sharded,) * 4 + (sharded,) * 7,

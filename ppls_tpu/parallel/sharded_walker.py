@@ -65,7 +65,7 @@ from ppls_tpu.parallel.bag_engine import (
     _run_bag,
 )
 from ppls_tpu.parallel.mesh import (FRONTIER_AXIS, device_store,
-                                    make_mesh)
+                                    make_mesh, shard_map_compat)
 from ppls_tpu.parallel.sharded_bag import _ShardBag, _shard_bag_round
 from ppls_tpu.parallel.walker import (
     MAX_REL_DEPTH,
@@ -98,6 +98,7 @@ class _DDCarry(NamedTuple):
     rounds: jnp.ndarray     # i64 collective breed + local drain rounds
     segs: jnp.ndarray       # i64 walker segments
     wsteps: jnp.ndarray     # i64 walker kernel iterations
+    srows: jnp.ndarray      # i64 live rows err-scored by the root sort
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32 (replicated by construction)
     overflow: jnp.ndarray   # bool (replicated via psum)
@@ -118,14 +119,16 @@ def _local_bag(c: _DDCarry, m: int) -> BagState:
 
 @functools.lru_cache(maxsize=32)
 def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
-                        chunk: int, capacity: int, m: int, lanes: int,
+                        breed_chunk: int, capacity: int, m: int,
+                        lanes: int,
                         seg_iters: int, max_segments: int,
                         min_active_frac: float, exit_frac: float,
                         suspend_frac: float, target_local: int,
                         interpret: bool,
                         max_cycles: int, fill_l: float, fill_th: float,
                         rule: Rule = Rule.TRAPEZOID,
-                        sort_roots: bool = True):
+                        sort_roots: bool = True,
+                        sort_skip_ratio: float = 8.0):
     """Jitted demand-driven walker leg, memoized per configuration.
 
     Runs up to ``max_cycles`` cycles (a checkpoint leg passes a smaller
@@ -166,7 +169,7 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             s, _ = carry
             prev = lax.psum(s.count, axis)
             return (_shard_bag_round(s, f_theta, eps, rule,
-                                     chunk, capacity, m, axis,
+                                     breed_chunk, capacity, m, axis,
                                      fill_l, fill_th), prev)
 
         out, _ = lax.while_loop(cond, body, (s0, jnp.int32(0)))
@@ -190,10 +193,18 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
         if sort_roots:
             # chip-LOCAL work-ordering of the balanced root share (the
             # same homogeneous-refill-window win as the single-chip
-            # engine; no collectives — each chip sorts its own queue)
-            local = _order_roots_by_work(local, f_theta=f_theta,
-                                         eps=eps, rule=rule,
-                                         window=2 * chunk)
+            # engine; no collectives — each chip sorts its own queue).
+            # window = 2 * breed_chunk, matching walker._run_cycles
+            # (ADVICE r5 #3: a 2*chunk window covered only ~8k of a
+            # ~49k-root per-chip queue at the dd defaults, so most
+            # multi-chip refill batches were NOT work-sorted);
+            # _dd_sizing's store slack >= 2 * breed_chunk covers it.
+            local, srows_d = _order_roots_by_work(
+                local, f_theta=f_theta, eps=eps, rule=rule,
+                window=2 * breed_chunk, skip_ratio=sort_skip_ratio)
+            srows_d = srows_d.astype(jnp.int64)
+        else:
+            srows_d = jnp.zeros((), jnp.int64)
 
         # local walk on this chip's balanced root share (no collectives:
         # per-chip segment counts diverge freely)
@@ -215,7 +226,7 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             # #9): a sub-min_active remainder that regrows past the
             # local root target goes back to the walker, not to f64
             return _run_bag(b, f_theta=f_theta, eps=eps,
-                            rule=rule, chunk=chunk,
+                            rule=rule, chunk=breed_chunk,
                             capacity=capacity, max_iters=1 << 20,
                             stop_count=target_local)
 
@@ -237,6 +248,7 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             rounds=bred.rounds + bag3.iters,
             segs=c.segs + walk.segs.astype(jnp.int64),
             wsteps=c.wsteps + walk.steps.astype(jnp.int64),
+            srows=c.srows + srows_d,
             maxd=jnp.maximum(jnp.maximum(bred.maxd, bag3.max_depth),
                              jnp.max(walk.lanes.maxd)),
             cycles=c.cycles + 1,
@@ -245,29 +257,30 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
     def shard_body(bag_l, bag_r, bag_th, bag_meta, count, acc, tasks,
                    splits, btasks, wtasks, wsplits, roots, rounds, segs,
-                   wsteps, maxd, cycles, overflow):
+                   wsteps, srows, maxd, cycles, overflow):
         c = _DDCarry(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
                      bag_meta=bag_meta, count=count[0], acc=acc[0],
                      tasks=tasks[0], splits=splits[0], btasks=btasks[0],
                      wtasks=wtasks[0], wsplits=wsplits[0], roots=roots[0],
                      rounds=rounds[0], segs=segs[0], wsteps=wsteps[0],
+                     srows=srows[0],
                      maxd=maxd[0], cycles=cycles[0], overflow=overflow[0])
         out = lax.while_loop(cycle_cond, cycle_body, c)
         return (out.bag_l, out.bag_r, out.bag_th, out.bag_meta,
                 out.count[None], out.acc[None], out.tasks[None],
                 out.splits[None], out.btasks[None], out.wtasks[None],
                 out.wsplits[None], out.roots[None], out.rounds[None],
-                out.segs[None], out.wsteps[None], out.maxd[None],
-                out.cycles[None], out.overflow[None])
+                out.segs[None], out.wsteps[None], out.srows[None],
+                out.maxd[None], out.cycles[None], out.overflow[None])
 
     sh = P(axis)
-    n_state = 18
+    n_state = 19
     # check_vma=False: the Pallas segment kernel's out_shape carries no
     # varying-manual-axes annotation, so the static VMA checker cannot
     # type it (every carried value here is per-chip varying anyway; the
     # only replication points are the explicit psums, which work the
     # same without the checker).
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         shard_body, mesh=mesh, check_vma=False,
         in_specs=(sh,) * n_state, out_specs=(sh,) * n_state))
 
@@ -315,6 +328,7 @@ def integrate_family_walker_dd(
         max_cycles: int = 64,
         rule: Rule = Rule.TRAPEZOID,
         sort_roots: bool = True,
+        sort_skip_ratio: float = 8.0,
         interpret: Optional[bool] = None,
         mesh: Optional[Mesh] = None,
         n_devices: Optional[int] = None,
@@ -355,7 +369,8 @@ def integrate_family_walker_dd(
         float(min_active_frac), float(exit_frac), float(suspend_frac),
         int(target_local), bool(interpret),
         int(checkpoint_every if checkpoint_path else max_cycles),
-        fill_l, fill_th, Rule(rule), bool(sort_roots))
+        fill_l, fill_th, Rule(rule), bool(sort_roots),
+        float(sort_skip_ratio))
 
     if _state_override is not None:
         bag_l, bag_r, bag_th, bag_meta, count0 = _state_override
@@ -367,7 +382,7 @@ def integrate_family_walker_dd(
     # legs, so totals are simply the latest values and a resumed run
     # reports exact cumulative metrics.
     CTR64 = ("tasks", "splits", "btasks", "wtasks", "wsplits", "roots",
-             "rounds", "segs", "wsteps")
+             "rounds", "segs", "wsteps", "srows")
     per_chip = {k: np.zeros(n_dev, dtype=np.int64) for k in CTR64}
     per_chip["maxd"] = np.zeros(n_dev, dtype=np.int32)
     acc0 = np.zeros((n_dev, m), dtype=np.float64)
@@ -375,8 +390,11 @@ def integrate_family_walker_dd(
     if _totals_override is not None:
         acc0 = np.asarray(_totals_override["acc_per_chip"])
         for k in CTR64:
-            per_chip[k] = np.asarray(_totals_override["pc_" + k],
-                                     dtype=np.int64)
+            # .get: snapshots from before the device-counted sort
+            # accounting lack "pc_srows" — resume them with zeros
+            per_chip[k] = np.asarray(
+                _totals_override.get("pc_" + k, per_chip[k]),
+                dtype=np.int64)
         per_chip["maxd"] = np.asarray(_totals_override["pc_maxd"],
                                       dtype=np.int32)
         cycles_done = int(_totals_override["cycles"])
@@ -396,16 +414,19 @@ def integrate_family_walker_dd(
     while True:
         out = run(*state, *counters)
         (bl, br, bth, bmeta, count, acc, tasks_c, splits_c, bt_c, wt_c,
-         ws_c, roots_c, rounds_c, segs_c, wsteps_c, maxd_c, cycles_c,
-         ovf_c) = out
+         ws_c, roots_c, rounds_c, segs_c, wsteps_c, srows_c, maxd_c,
+         cycles_c, ovf_c) = out
         (count_h, tasks_h, splits_h, bt_h, wt_h, ws_h, roots_h, rounds_h,
-         segs_h, wsteps_h, maxd_h, cycles_h, ovf_h) = jax.device_get(
+         segs_h, wsteps_h, srows_h, maxd_h, cycles_h,
+         ovf_h) = jax.device_get(
              (count, tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
-              rounds_c, segs_c, wsteps_c, maxd_c, cycles_c, ovf_c))
+              rounds_c, segs_c, wsteps_c, srows_c, maxd_c, cycles_c,
+              ovf_c))
         left = int(np.sum(count_h))
         overflow = bool(np.any(ovf_h))
         for k, v in zip(CTR64, (tasks_h, splits_h, bt_h, wt_h, ws_h,
-                                roots_h, rounds_h, segs_h, wsteps_h)):
+                                roots_h, rounds_h, segs_h, wsteps_h,
+                                srows_h)):
             per_chip[k] = np.asarray(v, dtype=np.int64)
         per_chip["maxd"] = np.asarray(maxd_h, dtype=np.int32)
         cycles_done += int(np.max(cycles_h))
@@ -444,7 +465,7 @@ def integrate_family_walker_dd(
             break
         state = (bl, br, bth, bmeta, count, acc)
         counters = (tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
-                    rounds_c, segs_c, wsteps_c, maxd_c,
+                    rounds_c, segs_c, wsteps_c, srows_c, maxd_c,
                     jnp.zeros(n_dev, dtype=jnp.int32), ovf_c)
     acc_h = np.asarray(jax.device_get(acc))
     wall = time.perf_counter() - t0
@@ -477,14 +498,16 @@ def integrate_family_walker_dd(
         leaves=tasks - tot["splits"],
         rounds=tot["rounds"] + tot["segs"],
         max_depth=tot["max_depth"],
+        # sort-pass cost from the DEVICE-COUNTED live-row score count
+        # (srows), not the consumed-root proxy (ADVICE r5 #4: the proxy
+        # undercounted re-scored remainders and overcounted roots the
+        # window never reached)
         integrand_evals=(
             3 * tot["btasks"] + 2 * wtasks - tot["wsplits"]
-            + tot["roots"]
-            + (3 * tot["roots"] if sort_roots else 0)
+            + tot["roots"] + 3 * tot["srows"]
             if Rule(rule) == Rule.TRAPEZOID else
             5 * tot["btasks"] + 4 * wtasks - 2 * tot["wsplits"]
-            + tot["roots"]
-            + (5 * tot["roots"] if sort_roots else 0)),
+            + tot["roots"] + 5 * tot["srows"]),
         wall_time_s=wall,
         n_chips=n_dev,
         tasks_per_chip=tasks_per_chip,
@@ -496,6 +519,10 @@ def integrate_family_walker_dd(
         lane_efficiency=wtasks / denom if denom else 0.0,
         walker_fraction=wtasks / tasks if tasks else 0.0,
         cycles=tot["cycles"],
+        lanes=int(lanes),
+        # mesh-aggregate kernel iterations (per-chip lanes each): the
+        # numerator of the multi-chip headroom split
+        kernel_steps=tot["wsteps"],
     )
 
 

@@ -33,10 +33,23 @@ Orchestration (all device-resident, 3 jit programs):
 1. BREED: the f64 bag engine (exact reference semantics,
    ``aquadPartA.c:183-202``) refines the seed intervals until the bag
    holds >= roots_per_lane * LANES tasks — the walker's root queue.
-2. WALK: segments of K kernel iterations; between segments, finished
-   lanes bank their accumulators (exact_segment_sum by family) and
-   take fresh roots from the queue (one monotone gather). Stops when
-   the queue is dry and lane occupancy drops below a threshold.
+2. WALK: in the IN-KERNEL-REFILL mode (``refill_slots`` = R > 0, the
+   flagship bench configuration) the work-sorted root queue is dealt
+   round-robin into a per-lane
+   private VMEM root bank ONCE per phase and the kernel refills its
+   own lanes — finished roots bank into a per-slot result bank inside
+   the kernel, a segment boundary happens only on bank-dry or step
+   cap, and per-family credit is ONE exact segment-sum at phase end:
+   zero boundary sorts (the reference farmer's "never idle a worker
+   while the bag is non-empty", aquadPartA.c:156-165, moved into the
+   kernel). In the legacy XLA-boundary mode (R = 0), segments run
+   until occupancy drops to a threshold, then finished lanes bank
+   their accumulators (exact_segment_sum by family) and take fresh
+   roots at an XLA boundary — since round 6 with ONE fused keyed sort
+   (the lane state is permuted so the contiguous top-of-queue window
+   applies positionally) instead of the former two routing sorts.
+   Either way the phase stops when the queue/bank is dry and lane
+   occupancy drops below the suspension floor.
 3. MOP-UP: un-walked state is converted BACK into explicit bag tasks —
    a suspended DFS position (i, d) expands into its pending right
    siblings ((i >> k) + 1 at depth d - k for each zero bit k) plus the
@@ -175,7 +188,7 @@ def _ctz(k):
 
 def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                      interpret: bool = False, early_exit: bool = False,
-                     rule: Rule = Rule.TRAPEZOID):
+                     rule: Rule = Rule.TRAPEZOID, refill_slots: int = 0):
     """Build the segment kernel: up to seg_iters walker steps over all
     lanes.
 
@@ -189,6 +202,26 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
     waiting for the XLA-level bank/refill boundary (the round-3 design
     ran fixed 32/256-step segments; measured lane efficiency 0.30 —
     most of the loss was parked lanes inside segments, VERDICT r3 #2).
+
+    With ``refill_slots`` = R > 0 the kernel REFILLS ITS OWN LANES: it
+    additionally takes a pre-dealt ROOT BANK — 7 VMEM arrays of shape
+    (R, rows, 128) holding R private roots per lane (a_h, a_l, w_h,
+    w_l, th_h, th_l, meta), dealt round-robin from the work-sorted
+    queue so each lane's slot sequence is a stratified (comparable-
+    work) sample — plus a per-lane ``slot`` cursor and a per-lane
+    ``nslots`` validity count. Whenever enough lanes have parked
+    (>= ``batch``, the third SMEM scalar) or occupancy dips to the
+    threshold, a refill event fires INSIDE the kernel: each parked
+    lane banks its finished root's ds accumulator into a per-slot
+    RESULT BANK (two (R, rows, 128) outputs; per-family credit happens
+    once per phase at the XLA level via one exact segment-sum over the
+    dealt meta grid) and takes its next private root, entering through
+    the same _MODE_INIT path as an XLA refill. A segment boundary then
+    happens only when the bank is dry or the step cap is hit — the
+    reference farmer's "never idle a worker while the bag is
+    non-empty" (aquadPartA.c:156-165) moved into the kernel, replacing
+    ~100-step segments bracketed by XLA sort/segment-sum boundaries
+    with bank-lifetime segments and ZERO boundary sorts.
     """
     eps32 = np.float32(eps)
 
@@ -402,6 +435,168 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
 
     n_fields = len(WalkState._fields)
 
+    if refill_slots:
+        R = int(refill_slots)
+
+        def kernel_rf(*refs):
+            thresh_ref, cap_ref, batch_ref = refs[:3]
+            nslots_ref = refs[3]
+            bank_refs = refs[4:11]      # a_h, a_l, w_h, w_l, th_h, th_l,
+            #                             meta — each (R, rows, 128)
+            slot_ref = refs[11]
+            in_refs = refs[12:12 + n_fields]
+            out_refs = refs[12 + n_fields:12 + 2 * n_fields]
+            slot_out_ref = refs[12 + 2 * n_fields]
+            resh_ref = refs[13 + 2 * n_fields]
+            resl_ref = refs[14 + 2 * n_fields]
+            steps_ref = refs[15 + 2 * n_fields]
+
+            s0 = WalkState(*(r[:] for r in in_refs))
+            slot0 = slot_ref[:]
+            nslots = nslots_ref[:]
+            thresh = thresh_ref[0, 0]
+            cap = cap_ref[0, 0]
+            batch = batch_ref[0, 0]
+            z32 = jnp.zeros_like(s0.fl_h)
+            zi = jnp.zeros_like(s0.i)
+
+            def counts(st, sl):
+                # f32 accumulation: exact for lanes <= 2^24 and avoids
+                # the int64-promoting integer-sum path Mosaic cannot
+                # lower under global x64 (same trick as kernel_ee)
+                parked = (st.flags & _PARKED) != 0
+                ovf = (st.flags & _OVF) != 0
+                takeable = jnp.logical_and(
+                    jnp.logical_and(parked, jnp.logical_not(ovf)),
+                    sl < nslots)
+                live = jnp.sum(jnp.logical_not(parked)
+                               .astype(jnp.float32)).astype(jnp.int32)
+                nref = jnp.sum(takeable.astype(jnp.float32)
+                               ).astype(jnp.int32)
+                return live, nref
+
+            def do_refill(op):
+                st, sl, resh, resl = op
+                parked = (st.flags & _PARKED) != 0
+                ovf = (st.flags & _OVF) != 0
+                take = jnp.logical_and(
+                    jnp.logical_and(parked, jnp.logical_not(ovf)),
+                    sl < nslots)
+                prev = sl - 1
+                # per-lane indexed read of the private root bank and
+                # indexed write of the result bank, as static chains of
+                # R masked selects (Mosaic has no cross-lane gather;
+                # events are rare — ~(1-exit_frac)^-1 steps apart — so
+                # the amortized cost is a few percent of a step)
+                a_h, a_l = st.a_h, st.a_l
+                w_h, w_l = st.w_h, st.w_l
+                th_h, th_l = st.th_h, st.th_l
+                meta = zi
+                resh = list(resh)
+                resl = list(resl)
+                for k in range(R):
+                    mk = jnp.logical_and(take, sl == k)
+                    a_h = jnp.where(mk, bank_refs[0][k], a_h)
+                    a_l = jnp.where(mk, bank_refs[1][k], a_l)
+                    w_h = jnp.where(mk, bank_refs[2][k], w_h)
+                    w_l = jnp.where(mk, bank_refs[3][k], w_l)
+                    th_h = jnp.where(mk, bank_refs[4][k], th_h)
+                    th_l = jnp.where(mk, bank_refs[5][k], th_l)
+                    meta = jnp.where(mk, bank_refs[6][k], meta)
+                    bk = jnp.logical_and(take, prev == k)
+                    resh[k] = jnp.where(bk, st.acc_h, resh[k])
+                    resl[k] = jnp.where(bk, st.acc_l, resl[k])
+
+                def pick(new, old):
+                    return jnp.where(take, new, old)
+
+                st2 = WalkState(
+                    a_h=a_h, a_l=a_l, w_h=w_h, w_l=w_l,
+                    th_h=th_h, th_l=th_l,
+                    fl_h=pick(z32, st.fl_h), fl_l=pick(z32, st.fl_l),
+                    fr_h=pick(z32, st.fr_h), fr_l=pick(z32, st.fr_l),
+                    fm_h=pick(z32, st.fm_h), fm_l=pick(z32, st.fm_l),
+                    fq_h=pick(z32, st.fq_h), fq_l=pick(z32, st.fq_l),
+                    acc_h=pick(z32, st.acc_h), acc_l=pick(z32, st.acc_l),
+                    i=pick(zi, st.i), d=pick(zi, st.d),
+                    base_d=pick(meta & DEPTH_MASK, st.base_d),
+                    fam=pick(meta >> DEPTH_BITS, st.fam),
+                    flags=jnp.where(take, jnp.int32(_MODE_INIT),
+                                    st.flags),
+                    tasks=st.tasks, splits=st.splits, maxd=st.maxd,
+                )
+                return st2, jnp.where(take, sl + 1, sl), \
+                    tuple(resh), tuple(resl)
+
+            live0, nref0 = counts(s0, slot0)
+            resh0 = tuple(z32 for _ in range(R))
+            resl0 = tuple(z32 for _ in range(R))
+
+            def cond(c):
+                k, st, sl, live, nref, resh, resl = c
+                return jnp.logical_or(
+                    k == 0,
+                    jnp.logical_and(
+                        k < cap,
+                        jnp.logical_or(live > thresh, nref > 0)))
+
+            def body(c):
+                k, st, sl, live, nref, resh, resl = c
+                # refill BEFORE the step: freshly parked lanes from the
+                # previous step join the candidate pool, and a fully
+                # parked start (phase seeding) refills on iteration 0
+                do = jnp.logical_and(
+                    nref > 0,
+                    jnp.logical_or(nref >= batch, live <= thresh))
+                st, sl, resh, resl = lax.cond(
+                    do, do_refill, lambda op: op, (st, sl, resh, resl))
+                st = step(st)
+                live, nref = counts(st, sl)
+                return k + 1, st, sl, live, nref, resh, resl
+
+            k, out, slot_o, _, _, resh, resl = lax.while_loop(
+                cond, body,
+                (jnp.int32(0), s0, slot0, live0, nref0, resh0, resl0))
+            for r, v in zip(out_refs, out):
+                r[:] = v
+            slot_out_ref[:] = slot_o
+            for kk in range(R):
+                resh_ref[kk] = resh[kk]
+                resl_ref[kk] = resl[kk]
+            steps_ref[0, 0] = k
+
+        def run_segment_rf(state: WalkState, slot, thresh, cap, batch,
+                           nslots, bank):
+            """One refill-kernel launch. ``bank`` is the 7-tuple of
+            (R, rows, 128) dealt root arrays; returns (state, slot,
+            resbank_h, resbank_l, steps)."""
+            shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                           for x in state)
+            bank_shape = (R,) + state.a_h.shape
+            smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+            vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+            out = pl.pallas_call(
+                kernel_rf,
+                out_shape=shapes + (
+                    jax.ShapeDtypeStruct(state.i.shape, jnp.int32),
+                    jax.ShapeDtypeStruct(bank_shape, jnp.float32),
+                    jax.ShapeDtypeStruct(bank_shape, jnp.float32),
+                    jax.ShapeDtypeStruct((1, 1), jnp.int32)),
+                in_specs=[smem, smem, smem]
+                + [vmem] * (1 + 7 + 1)
+                + [vmem] * n_fields,
+                out_specs=(vmem,) * n_fields + (vmem, vmem, vmem, smem),
+                interpret=interpret,
+            )(thresh.reshape(1, 1).astype(jnp.int32),
+              cap.reshape(1, 1).astype(jnp.int32),
+              batch.reshape(1, 1).astype(jnp.int32),
+              nslots, *bank, slot, *state)
+            return (WalkState(*out[:n_fields]), out[n_fields],
+                    out[n_fields + 1], out[n_fields + 2],
+                    out[n_fields + 3][0, 0])
+
+        return run_segment_rf
+
     if not early_exit:
         def kernel(*refs):
             in_refs = refs[:n_fields]
@@ -495,7 +690,7 @@ SEG_STAT_FIELDS = ("steps", "live_at_exit", "queue_left", "refilled")
 # column order of the per-cycle stats ring (one row per engine cycle)
 CYCLE_STAT_FIELDS = ("bred_roots", "breed_iters", "roots_consumed",
                      "walker_tasks", "walker_steps", "segments",
-                     "expand_tasks", "drain_tasks")
+                     "expand_tasks", "drain_tasks", "sort_rows")
 
 
 class _WalkCarry(NamedTuple):
@@ -539,30 +734,46 @@ def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
 
 
 def _order_roots_by_work(bag: BagState, *, f_theta: Callable, eps: float,
-                         rule: Rule, window: int) -> BagState:
+                         rule: Rule, window: int,
+                         skip_ratio: float = 0.0):
     """Sort the top ``window`` of the bred root queue ascending by the
     one-step f64 error estimate — a monotone proxy for subtree work
     (per-level error decay is ~8x for the trapezoid rule, so remaining
     depth ~ log2(err/eps)/3 and subtree size ~ 2^depth).
 
-    Why: _bank_and_refill hands each refill batch a CONTIGUOUS window
-    off the queue top. The round-4 engine's windows mixed subtree sizes
-    freely — the round-5 seg_stats decomposition measured segments
-    early-exiting after ~48 steps with ~35% of lanes parked on trivial
-    roots while deep roots ran thousands of steps: steps-weighted
-    occupancy 0.81. Work-sorted windows make lanes park TOGETHER
-    (homogeneous batches), and consuming biggest-first leaves the
-    cheap roots for the dry-queue tail where parked lanes cost the
+    Why: refill hands each batch a CONTIGUOUS window off the queue top
+    (and the in-kernel refill deals the sorted queue round-robin over
+    lanes — a stratified deal, so each lane's private slot sequence
+    carries a comparable work total). The round-4 engine's windows
+    mixed subtree sizes freely — the round-5 seg_stats decomposition
+    measured segments early-exiting after ~48 steps with ~35% of lanes
+    parked on trivial roots while deep roots ran thousands of steps:
+    steps-weighted occupancy 0.81. Work-sorted windows make lanes park
+    TOGETHER (homogeneous batches), and consuming biggest-first leaves
+    the cheap roots for the dry-queue tail where parked lanes cost the
     least. This is the demand-driven farmer's fairness
     (aquadPartA.c:156-165) upgraded with a work model: don't just keep
     every lane fed, feed lanes in a batch comparably-sized work.
 
-    Cost: 3 f64 evals + one multi-operand sort over ``window`` rows per
-    cycle — about one extra breed iteration (~3% of run evals).
-    Queues deeper than ``window`` leave their bottom unsorted (consumed
-    last, by then the walk is tail-dominated anyway); after _breed,
-    count <= 2*target <= window by the breeding stop condition, so in
-    practice the whole queue is sorted.
+    With ``skip_ratio`` > 0 the multi-operand sort is SKIPPED (via
+    lax.cond) whenever the live window's finite error spread is already
+    below that ratio — a homogeneous window gains nothing from ordering
+    (for the trapezoid rule one refinement level is an ~8x error step,
+    so ratio 8 means "all roots within one level of each other"). The
+    err scoring still runs every cycle: it is what the decision reads,
+    and it is the dominant share of this pass's integrand evals.
+
+    Returns ``(bag, scored_rows)`` where ``scored_rows`` is the number
+    of LIVE rows err-scored by this pass (int32) — the exact eval-count
+    basis for the sort-pass accounting (ADVICE r5 #4: the old
+    per-consumed-root accounting both under- and over-counted).
+
+    Cost: 3 f64 evals per live window row + (usually) one multi-operand
+    sort over ``window`` rows per cycle. Queues deeper than ``window``
+    leave their bottom unsorted (consumed last, by then the walk is
+    tail-dominated anyway); after _breed, count <= 2*target <= window
+    by the breeding stop condition, so in practice the whole queue is
+    sorted.
     """
     count = bag.count
     s = jnp.maximum(count - window, 0)
@@ -574,23 +785,76 @@ def _order_roots_by_work(bag: BagState, *, f_theta: Callable, eps: float,
                                    rule)
     idx = jnp.arange(window, dtype=jnp.int32)
     live = idx < (count - s)
+    scored = (count - s).astype(jnp.int32)
+    # NaN-proofing (ADVICE r5 #1): lax.sort's total order places NaN
+    # LAST — after the +inf-keyed dead rows — so a live root whose
+    # one-step estimate is NaN would be pushed out of the live prefix
+    # and silently dropped (a zero-width fill row promoted in its
+    # place), converting the engine's loud NaN guard into a silently
+    # wrong finite area. Mapping NaN to +inf keeps the row inside the
+    # live prefix: the sort is stable and live rows precede dead rows
+    # in input order at equal key, so the NaN still surfaces loudly
+    # when the task is processed.
+    err_key = jnp.where(jnp.isnan(err), jnp.inf, err)
     # dead rows (past the live prefix) key to +inf: ascending sort lands
     # them above the live prefix, exactly where they already were
-    key = jnp.where(live, err, jnp.inf)
-    _key, sl, sr, sth, smeta = lax.sort((key, l, r, th, meta),
-                                        dimension=0, is_stable=True,
-                                        num_keys=1)
+    key = jnp.where(live, err_key, jnp.inf)
+
+    def do_sort(cols):
+        cl, cr, cth, cmeta = cols
+        _key, sl, sr, sth, smeta = lax.sort((key, cl, cr, cth, cmeta),
+                                            dimension=0, is_stable=True,
+                                            num_keys=1)
+        return sl, sr, sth, smeta
+
+    cols = (l, r, th, meta)
+    if skip_ratio > 0.0:
+        fin = jnp.logical_and(live, jnp.isfinite(err_key))
+        emax = jnp.max(jnp.where(fin, err_key, -jnp.inf))
+        emin = jnp.min(jnp.where(fin, err_key, jnp.inf))
+        # skip only when every live key is finite (a NaN/inf row MUST
+        # ride the sort into the live prefix ordering) and the finite
+        # spread is within one work level
+        all_fin = jnp.sum(jnp.logical_and(live, jnp.logical_not(fin)),
+                          dtype=jnp.int32) == 0
+        homogeneous = jnp.logical_and(
+            jnp.logical_and(all_fin, emax > 0),
+            emax <= skip_ratio * jnp.maximum(emin, 1e-300))
+        sl, sr, sth, smeta = lax.cond(homogeneous, lambda c: c, do_sort,
+                                      cols)
+    else:
+        sl, sr, sth, smeta = do_sort(cols)
     return bag._replace(
         bag_l=lax.dynamic_update_slice(bag.bag_l, sl, (s,)),
         bag_r=lax.dynamic_update_slice(bag.bag_r, sr, (s,)),
         bag_th=lax.dynamic_update_slice(bag.bag_th, sth, (s,)),
-        bag_meta=lax.dynamic_update_slice(bag.bag_meta, smeta, (s,)))
+        bag_meta=lax.dynamic_update_slice(bag.bag_meta, smeta, (s,))), \
+        scored
 
 
 def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
     """Credit finished lanes' accumulators to their families and hand
-    them fresh roots (one monotone gather from the root queue). Root
-    endpoint values are left to the kernel's INIT/LOAD steps."""
+    them fresh roots with ONE keyed sort. Root endpoint values are left
+    to the kernel's INIT/LOAD steps.
+
+    FUSED BOUNDARY SORT (round 6): the boundary used to run TWO sorts —
+    (take_key, lane_ids) to compute which lane owns root p, then a
+    second routing sort carrying the root payload back to lane order.
+    The walker kernel treats lanes symmetrically (every per-lane datum
+    lives in the state arrays themselves), so instead of routing roots
+    to scattered parked lanes, we PERMUTE THE LANES: one stable sort of
+    the whole lane state keyed by refill rank parks the refillable
+    lanes in a contiguous prefix, where the top-of-queue window applies
+    POSITIONALLY — root p (p-th from the top) lands at position p with
+    no second sort and no gather. The sort carries more columns
+    (the full state vs 4 payload columns) but halves the boundary's
+    sort launches and their scheduling gaps — the per-op gap, not
+    bytes, dominated the measured boundary cost (VERDICT r5 Missing
+    #3). Lane identity is not meaningful across segments: cumulative
+    per-lane counters (tasks/splits/maxd) are only ever read as sums/
+    maxes, and per-family credit is an exact permutation-invariant
+    segment sum.
+    """
     s = c.lanes
     parked = ((s.flags & _PARKED) != 0).reshape(-1)
     has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
@@ -611,29 +875,37 @@ def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
     refillable = jnp.logical_and(parked, jnp.logical_not(ovf))
     rank = jnp.cumsum(refillable, dtype=jnp.int32) - 1
     avail = c.bag.count - c.cursor
-    take = jnp.logical_and(refillable, rank < avail)
+    # Sort key: refillable lanes by rank (-> contiguous prefix, in lane
+    # order), everything else keyed `lanes` (stable sort keeps them in
+    # lane order after the prefix).
+    key = jnp.where(refillable, rank, jnp.int32(lanes))
     # MISCOMPILE GUARD — do not remove. Without this barrier XLA's
     # simplifier mis-folds the routing when the lane state entering a
     # walk phase is a compile-time constant (the fresh-lane seeding
-    # refill): observed on both CPU and TPU backends as `take` landing
-    # on every 8th lane while `cursor` still advances by sum(take)'s
-    # correct value — consumed roots silently vanish (round-4 width-
-    # conservation debug). Round 3 never hit it because the fenced-ds
-    # endpoint evaluation here acted as an accidental barrier; when the
-    # evals moved into the kernel (_MODE_INIT) the folding appeared.
-    # Forcing materialization of the routing mask restores correctness;
-    # cost is ~us per boundary on i32/bool vectors.
-    take, rank = lax.optimization_barrier((take, rank))
+    # refill): observed on both CPU and TPU backends as the routing
+    # mask landing on every 8th lane while `cursor` still advances by
+    # the correct count — consumed roots silently vanish (round-4
+    # width-conservation debug). Round 3 never hit it because the
+    # fenced-ds endpoint evaluation here acted as an accidental
+    # barrier; when the evals moved into the kernel (_MODE_INIT) the
+    # folding appeared. Forcing materialization of the routing key
+    # restores correctness; cost is ~us per boundary on an i32 vector.
+    key = lax.optimization_barrier(key)
+
+    sorted_cols = lax.sort(
+        (key,) + tuple(x.reshape(-1) for x in s),
+        dimension=0, is_stable=True, num_keys=1)
+    sp = WalkState(*(x.reshape(rows, 128) for x in sorted_cols[1:]))
 
     # Consume from the TOP of the bred bag (cursor counts consumed
     # roots), so the unconsumed remainder [0, count - cursor) remains a
-    # valid bag prefix that _expand_pending can reuse in place — and the
-    # taken roots are a CONTIGUOUS window, fetched with one dynamic
-    # slice and routed to the scattered parked lanes by two small sorts.
-    # The obvious per-lane gather (bag[count-1-cursor-rank]) costs
-    # ~4.8 ms per refill at lanes=2^15 on v5e (computed-index gathers
-    # from HBM serialize); slice + route measures ~50x cheaper.
-    top = c.bag.count - c.cursor
+    # valid bag prefix that _expand_pending can reuse in place — and
+    # the taken roots are a CONTIGUOUS window, fetched with contiguous
+    # slices only and applied positionally to the sorted lane prefix.
+    # (The obvious per-lane gather (bag[count-1-cursor-rank]) costs
+    # ~4.8 ms per refill at lanes=2^15 on v5e — computed-index gathers
+    # from HBM serialize.)
+    top = avail
     start = jnp.maximum(top - lanes, 0)
     span_len = top - start           # = min(lanes, top)
 
@@ -645,16 +917,10 @@ def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
         dbl = jnp.concatenate([sl_, sl_])
         return lax.dynamic_slice(dbl, (lanes - span_len,), (lanes,))
 
-    lane_ids = jnp.arange(lanes, dtype=jnp.int32)
-    take_key = jnp.where(take, rank, jnp.int32(lanes))
-    _, lane_perm = lax.sort((take_key, lane_ids), dimension=0,
-                            is_stable=True, num_keys=1)
-    # position p (root p-from-top) belongs to lane lane_perm[p]; sorting
-    # by lane_perm restores lane order with the root payload alongside.
-    _, rl, rr, rth, rmeta = lax.sort(
-        (lane_perm, top_window(c.bag.bag_l), top_window(c.bag.bag_r),
-         top_window(c.bag.bag_th), top_window(c.bag.bag_meta)),
-        dimension=0, is_stable=True, num_keys=1)
+    rl = top_window(c.bag.bag_l)
+    rr = top_window(c.bag.bag_r)
+    rth = top_window(c.bag.bag_th)
+    rmeta = top_window(c.bag.bag_meta)
 
     def to_ds(x):
         hi = x.astype(jnp.float32)
@@ -673,7 +939,14 @@ def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
     fam_new = (rmeta >> DEPTH_BITS).reshape(rows, 128)
     based_new = (rmeta & DEPTH_MASK).reshape(rows, 128)
 
-    take2 = take.reshape(rows, 128)
+    # After the state sort, refillable lanes occupy positions
+    # [0, n_ref) in rank order; the first min(n_ref, avail) of them
+    # take root p = their position.
+    n_ref = jnp.sum(refillable, dtype=jnp.int32)
+    n_taken = jnp.minimum(n_ref, avail)
+    pos = jnp.arange(lanes, dtype=jnp.int32)
+    take2 = (pos < n_taken).reshape(rows, 128)
+    retire2 = jnp.logical_and(pos >= n_taken, pos < n_ref).reshape(rows, 128)
     z32 = jnp.zeros((rows, 128), jnp.float32)
     zi = jnp.zeros((rows, 128), jnp.int32)
 
@@ -682,29 +955,27 @@ def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
 
     # Finished lanes that got no root go idle (parked | no-root); banked
     # lanes' accumulators reset; OVF lanes keep their flags AND state.
-    bank2 = bank.reshape(rows, 128)
-    retire = jnp.logical_and(refillable, jnp.logical_not(take))
-    flags = s.flags
+    bank2 = jnp.logical_and((sp.flags & _PARKED) != 0,
+                            (sp.flags & _NO_ROOT) == 0)
+    flags = sp.flags
     flags = jnp.where(take2, jnp.int32(_MODE_INIT), flags)  # fresh INIT
-    flags = jnp.where(retire.reshape(rows, 128),
-                      jnp.int32(_PARKED | _NO_ROOT), flags)
+    flags = jnp.where(retire2, jnp.int32(_PARKED | _NO_ROOT), flags)
 
     new_lanes = WalkState(
-        a_h=pick(a_h, s.a_h), a_l=pick(a_l, s.a_l),
-        w_h=pick(w_h, s.w_h), w_l=pick(w_l, s.w_l),
-        th_h=pick(th_h, s.th_h), th_l=pick(th_l, s.th_l),
-        fl_h=pick(z32, s.fl_h), fl_l=pick(z32, s.fl_l),
-        fr_h=pick(z32, s.fr_h), fr_l=pick(z32, s.fr_l),
-        fm_h=pick(z32, s.fm_h), fm_l=pick(z32, s.fm_l),
-        fq_h=pick(z32, s.fq_h), fq_l=pick(z32, s.fq_l),
-        acc_h=jnp.where(bank2, z32, s.acc_h),
-        acc_l=jnp.where(bank2, z32, s.acc_l),
-        i=pick(zi, s.i), d=pick(zi, s.d),
-        base_d=pick(based_new, s.base_d), fam=pick(fam_new, s.fam),
+        a_h=pick(a_h, sp.a_h), a_l=pick(a_l, sp.a_l),
+        w_h=pick(w_h, sp.w_h), w_l=pick(w_l, sp.w_l),
+        th_h=pick(th_h, sp.th_h), th_l=pick(th_l, sp.th_l),
+        fl_h=pick(z32, sp.fl_h), fl_l=pick(z32, sp.fl_l),
+        fr_h=pick(z32, sp.fr_h), fr_l=pick(z32, sp.fr_l),
+        fm_h=pick(z32, sp.fm_h), fm_l=pick(z32, sp.fm_l),
+        fq_h=pick(z32, sp.fq_h), fq_l=pick(z32, sp.fq_l),
+        acc_h=jnp.where(bank2, z32, sp.acc_h),
+        acc_l=jnp.where(bank2, z32, sp.acc_l),
+        i=pick(zi, sp.i), d=pick(zi, sp.d),
+        base_d=pick(based_new, sp.base_d), fam=pick(fam_new, sp.fam),
         flags=flags,
-        tasks=s.tasks, splits=s.splits, maxd=s.maxd,
+        tasks=sp.tasks, splits=sp.splits, maxd=sp.maxd,
     )
-    n_taken = jnp.sum(take, dtype=jnp.int32)
     return _WalkCarry(lanes=new_lanes, bag=c.bag,
                       cursor=c.cursor + n_taken, acc=acc,
                       segs=c.segs + 1, steps=c.steps,
@@ -823,22 +1094,210 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
     return out._replace(acc=acc)
 
 
-def _expand_pending(c: _WalkCarry, capacity: int, m: int) -> BagState:
+class _KernelRefillExtras(NamedTuple):
+    """Kernel-refill phase residue the XLA orchestration still needs:
+    which dealt roots were actually taken (expand must re-push the
+    untaken ones) and how many were consumed (stats)."""
+
+    slot: jnp.ndarray        # (rows, 128) i32 — roots taken per lane
+    nslots: jnp.ndarray      # (rows, 128) i32 — roots dealt per lane
+    dealt_l: jnp.ndarray     # (R*lanes,) f64 dealt window, biggest-first
+    dealt_r: jnp.ndarray
+    dealt_th: jnp.ndarray
+    dealt_meta: jnp.ndarray  # (R*lanes,) i32
+    taken: jnp.ndarray       # i32 — roots consumed this phase
+
+
+def _fresh_lanes(lanes: int) -> WalkState:
+    rows = lanes // 128
+    z32 = jnp.zeros((rows, 128), jnp.float32)
+    zi = jnp.zeros((rows, 128), jnp.int32)
+    ones = jnp.ones((rows, 128), jnp.float32)
+    return WalkState(
+        a_h=ones, a_l=z32, w_h=ones, w_l=z32, th_h=ones, th_l=z32,
+        fl_h=z32, fl_l=z32, fr_h=z32, fr_l=z32,
+        fm_h=z32, fm_l=z32, fq_h=z32, fq_l=z32,
+        acc_h=z32, acc_l=z32,
+        i=zi, d=zi, base_d=zi, fam=zi,
+        flags=jnp.full((rows, 128), _PARKED | _NO_ROOT, jnp.int32),
+        tasks=zi, splits=zi, maxd=zi,
+    )
+
+
+def _run_walk_kernel_refill(
+        bag: BagState, *, f_ds: Callable, eps: float, m: int,
+        seg_iters: int, max_segments: int, min_active_frac: float,
+        exit_frac: float, suspend_frac: float, interpret: bool,
+        lanes: int, gsegs0, seg_stats0, rule: Rule = Rule.TRAPEZOID,
+        refill_slots: int = 8):
+    """One walk phase with IN-KERNEL refill (traced inline inside
+    :func:`_run_cycles`; the XLA-boundary twin is :func:`_run_walk`).
+
+    The phase deals the top ``min(count, R*lanes)`` work-sorted roots
+    round-robin into a per-lane private root bank ONCE, then launches
+    the refill kernel until the bank is dry and occupancy drops to the
+    suspension floor (or the step budget runs out). Between launches
+    (step-cap boundaries only) NOTHING is sorted, summed, or routed —
+    the per-launch XLA work is a stats row and a result-bank
+    accumulation. Per-family credit happens once, at phase end: one
+    exact segment-sum over (result bank + every lane's in-flight
+    accumulator). Compare the legacy path: per ~100-step segment, two
+    routing sorts + one segment-sum + slice/where routing — measured
+    as ~half of flagship wall time in round 5 (VERDICT r5 Missing #3).
+
+    Returns ``(carry, extras)``: a :class:`_WalkCarry` (cursor set to
+    the dealt-window width so the untouched queue remainder stays a
+    reusable prefix) plus :class:`_KernelRefillExtras` for
+    :func:`_expand_pending` to re-push untaken dealt roots.
+    """
+    R = int(refill_slots)
+    run_segment = make_walk_kernel(f_ds, eps, seg_iters,
+                                   interpret=interpret, rule=rule,
+                                   refill_slots=R)
+    rows = lanes // 128
+    cap_roots = R * lanes
+    min_active = jnp.int32(int(lanes * min_active_frac))
+    suspend_thresh = jnp.int32(int(lanes * suspend_frac))
+    floor = jnp.maximum(min_active, suspend_thresh)
+    # refill cadence: top lanes up once ~batch of them have parked —
+    # the in-kernel analog of exit_frac's boundary cadence
+    batch = jnp.int32(max(lanes - int(lanes * exit_frac), 1))
+    step_budget = jnp.int32(max_segments * seg_iters)
+
+    top = bag.count
+    # engagement gate (mirrors _run_walk's cond): a queue below the
+    # engagement floor is not worth spinning the kernel up for — leave
+    # it in place for the f64 drain
+    navail = jnp.where(top >= min_active,
+                       jnp.minimum(top, cap_roots), 0)
+    start = jnp.maximum(top - navail, 0)
+
+    def deal(col):
+        # w[p] = col[top - 1 - p] for p < navail (top-of-queue,
+        # biggest-first), via contiguous slices only: reverse the
+        # slice, then rotate by (cap_roots - navail) through a doubled
+        # dynamic slice (the same trick as _bank_and_refill's
+        # top_window; computed-index gathers from HBM serialize).
+        sl_ = lax.dynamic_slice(
+            col, (jnp.maximum(top - cap_roots, 0),), (cap_roots,))[::-1]
+        dbl = jnp.concatenate([sl_, sl_])
+        return lax.dynamic_slice(dbl, (cap_roots - navail,),
+                                 (cap_roots,))
+
+    dl = deal(bag.bag_l)
+    dr = deal(bag.bag_r)
+    dth = deal(bag.bag_th)
+    dmeta = deal(bag.bag_meta)
+    # pad rows (p >= navail) wrap into garbage: their values never
+    # reach a lane (nslots gates every take) but their meta feeds the
+    # phase-end segment-sum's id vector — clamp to family 0 / value 0
+    p_ids = jnp.arange(cap_roots, dtype=jnp.int32)
+    dmeta = jnp.where(p_ids < navail, dmeta, 0)
+
+    def to_ds3(x):
+        hi = x.astype(jnp.float32)
+        lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+        return hi.reshape(R, rows, 128), lo.reshape(R, rows, 128)
+
+    a_h, a_l = to_ds3(dl)
+    w_h, w_l = to_ds3(dr - dl)
+    th_h, th_l = to_ds3(dth)
+    bank = (a_h, a_l, w_h, w_l, th_h, th_l,
+            dmeta.reshape(R, rows, 128))
+    # round-robin deal: root p goes to lane (p % lanes), slot
+    # (p // lanes) — lane l holds ceil((navail - l) / lanes) roots
+    lane_ids = jnp.arange(lanes, dtype=jnp.int32)
+    nslots = jnp.clip((navail - lane_ids + lanes - 1) // lanes,
+                      0, R).astype(jnp.int32).reshape(rows, 128)
+
+    lane0 = _fresh_lanes(lanes)
+    slot0 = jnp.zeros((rows, 128), jnp.int32)
+    resbank0 = jnp.zeros((R, rows, 128), jnp.float32)
+
+    def takeable_count(s: WalkState, slot):
+        parked = (s.flags & _PARKED) != 0
+        ovf = (s.flags & _OVF) != 0
+        return jnp.sum(jnp.logical_and(
+            jnp.logical_and(parked, jnp.logical_not(ovf)),
+            slot < nslots), dtype=jnp.int32)
+
+    def cond(c):
+        s, slot, resh, resl, steps, segs, gsegs, stats, taken = c
+        live = lanes - _idle_lanes(s)
+        return jnp.logical_and(
+            steps < step_budget,
+            jnp.logical_or(live > floor, takeable_count(s, slot) > 0))
+
+    def body(c):
+        s, slot, resh, resl, steps, segs, gsegs, stats, taken = c
+        cap = jnp.clip(step_budget - steps, 1, seg_iters)
+        s2, slot2, rh, rl, si = run_segment(s, slot, floor, cap, batch,
+                                            nslots, bank)
+        live_exit = lanes - _idle_lanes(s2)
+        taken2 = jnp.sum(slot2, dtype=jnp.int32)
+        row = jnp.stack([si, live_exit, top - taken,
+                         taken2 - taken]).astype(jnp.int32)
+        stats = lax.dynamic_update_slice(
+            stats, row[None, :],
+            (jnp.minimum(gsegs, S_CAP - 1), jnp.int32(0)))
+        # result-bank entries are written at most once per (slot, lane)
+        # across the whole phase (slot is monotone), so accumulating
+        # per-launch banks by plain addition is exact
+        return (s2, slot2, resh + rh, resl + rl, steps + si, segs + 1,
+                gsegs + 1, stats, taken2)
+
+    (s, slot, resh, resl, steps, segs, gsegs, stats, taken) = \
+        lax.while_loop(cond, body, (
+            lane0, slot0, resbank0, resbank0, jnp.int32(0),
+            jnp.int32(0), jnp.asarray(gsegs0, jnp.int32), seg_stats0,
+            jnp.int32(0)))
+
+    # Phase-end credit, ONE exact segment-sum: completed-root results
+    # from the bank (ids from the dealt meta grid) + every lane's
+    # in-flight accumulator for its CURRENT root (finished-but-dry,
+    # suspended mid-walk, or depth-overflow lanes alike; never-fed
+    # lanes keep _NO_ROOT and a zero accumulator).
+    has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
+    lane_contrib = jnp.where(
+        has_root,
+        s.acc_h.astype(jnp.float64).reshape(-1)
+        + s.acc_l.astype(jnp.float64).reshape(-1),
+        0.0)
+    grid_contrib = (resh.astype(jnp.float64)
+                    + resl.astype(jnp.float64)).reshape(-1)
+    ids = jnp.concatenate([s.fam.reshape(-1), dmeta >> DEPTH_BITS])
+    contrib = jnp.concatenate([lane_contrib, grid_contrib])
+    acc = segment_sum_auto(ids, contrib, m, lanes + cap_roots)
+
+    carry = _WalkCarry(lanes=s, bag=bag, cursor=navail, acc=acc,
+                       segs=segs, steps=steps, gsegs=gsegs,
+                       seg_stats=stats)
+    extras = _KernelRefillExtras(slot=slot, nslots=nslots, dealt_l=dl,
+                                 dealt_r=dr, dealt_th=dth,
+                                 dealt_meta=dmeta, taken=taken)
+    return carry, extras
+
+
+def _expand_pending(c: _WalkCarry, capacity: int, m: int,
+                    kx: Optional[_KernelRefillExtras] = None) -> BagState:
     """Convert un-walked state back into explicit bag tasks.
 
-    Roots were consumed from the TOP of the bred bag (_bank_and_refill),
-    so the never-consumed remainder [0, count - cursor) is already a
-    valid bag prefix and is reused in place. Only the suspended lanes'
-    pending sets — the current node (i, d) plus the right sibling
-    (i >> k) + 1 at depth d - k for every zero bit k < d — go through a
-    sort-compaction, a static (MAX_REL_DEPTH + 1) * lanes rows, and are
-    pushed on top of the remainder. (The previous design concatenated
-    the whole bag store into the sort: ~9 M rows for ~1 M of payload at
-    the flagship config — the sort dominated the cycle cost.)
+    Roots were consumed from the TOP of the bred bag (_bank_and_refill,
+    or the kernel-refill deal), so the never-consumed remainder
+    [0, count - cursor) is already a valid bag prefix and is reused in
+    place. Only the suspended lanes' pending sets — the current node
+    (i, d) plus the right sibling (i >> k) + 1 at depth d - k for every
+    zero bit k < d — go through a sort-compaction, a static
+    (MAX_REL_DEPTH + 1) * lanes rows (+ refill_slots * lanes untaken
+    dealt-root rows when ``kx`` is passed by a kernel-refill phase),
+    and are pushed on top of the remainder. (The previous design
+    concatenated the whole bag store into the sort: ~9 M rows for ~1 M
+    of payload at the flagship config — the sort dominated the cycle
+    cost.)
 
-    The caller guarantees (MAX_REL_DEPTH + 1) * lanes <= 2 * breed_chunk
-    (the bag's slack region), so the push window never clamps even when
-    the remainder fills the whole capacity.
+    The caller guarantees the pending-grid row count fits the bag's
+    slack region (walker_sizing), so the push window never clamps even
+    when the remainder fills the whole capacity.
     """
     s = c.lanes
     has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
@@ -883,6 +1342,26 @@ def _expand_pending(c: _WalkCarry, capacity: int, m: int) -> BagState:
     meta_n = ((fam_l[None, :] << DEPTH_BITS)
               + jnp.minimum(based[None, :] + node_d, DEPTH_MASK))
     th_n = jnp.broadcast_to(th[None, :], ln.shape)
+
+    if kx is not None:
+        # kernel-refill phases consume the dealt window lane-privately:
+        # slots a lane never reached (it suspended on a deep root, or
+        # overflowed) are whole un-started roots — append them to the
+        # pending grid so the next cycle re-breeds them. Dealt arrays
+        # are flat with p = slot * lanes + lane (the round-robin deal),
+        # so a (R, L) reshape aligns with the per-lane slot cursors.
+        n_lanes = i_l.shape[0]
+        Rk = kx.dealt_meta.shape[0] // n_lanes
+        kk = jnp.arange(Rk, dtype=jnp.int32)[:, None]
+        slot_f = kx.slot.reshape(-1)[None, :]
+        nsl_f = kx.nslots.reshape(-1)[None, :]
+        valid_u = jnp.logical_and(kk >= slot_f, kk < nsl_f)
+        ln = jnp.concatenate([ln, kx.dealt_l.reshape(Rk, n_lanes)])
+        rn = jnp.concatenate([rn, kx.dealt_r.reshape(Rk, n_lanes)])
+        th_n = jnp.concatenate([th_n, kx.dealt_th.reshape(Rk, n_lanes)])
+        meta_n = jnp.concatenate(
+            [meta_n, kx.dealt_meta.reshape(Rk, n_lanes)])
+        valid = jnp.concatenate([valid, valid_u])
 
     # compact the pending grid to a dense prefix (the engine's standard
     # sort-compaction) and push it on top of the unconsumed remainder.
@@ -935,6 +1414,7 @@ class _CycleCarry(NamedTuple):
     rounds: jnp.ndarray     # i64 bag iterations (breed + drain)
     segs: jnp.ndarray       # i64 walker segments (boundaries)
     wsteps: jnp.ndarray     # i64 walker kernel iterations
+    srows: jnp.ndarray      # i64 live rows err-scored by the root sort
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32
     overflow: jnp.ndarray   # bool
@@ -948,7 +1428,8 @@ class _CycleCarry(NamedTuple):
                      "max_segments", "min_active_frac", "exit_frac", "suspend_frac",
                      "interpret",
                      "lanes", "capacity", "breed_chunk", "target",
-                     "max_cycles", "rule", "sort_roots"))
+                     "max_cycles", "rule", "sort_roots", "refill_slots",
+                     "sort_skip_ratio"))
 def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 f_ds: Callable,
                 eps: float, m: int, seg_iters: int, max_segments: int,
@@ -958,7 +1439,9 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 capacity: int, breed_chunk: int, target: int,
                 max_cycles: int,
                 rule: Rule = Rule.TRAPEZOID,
-                sort_roots: bool = True) -> _CycleCarry:
+                sort_roots: bool = True,
+                refill_slots: int = 0,
+                sort_skip_ratio: float = 8.0) -> _CycleCarry:
     """The full engine as ONE device program:
 
         while bag not empty:
@@ -995,17 +1478,28 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=breed_chunk,
                       capacity=capacity, target=target, rule=rule)
         if sort_roots:
-            bred = _order_roots_by_work(bred, f_theta=f_theta, eps=eps,
-                                        rule=rule,
-                                        window=2 * breed_chunk)
-        walk = _run_walk(bred, f_ds=f_ds, eps=eps, m=m,
-                         seg_iters=seg_iters, max_segments=max_segments,
-                         min_active_frac=min_active_frac,
-                         exit_frac=exit_frac, suspend_frac=suspend_frac,
-                         interpret=interpret, lanes=lanes,
-                         gsegs0=c.segs.astype(jnp.int32),
-                         seg_stats0=c.seg_stats, rule=rule)
-        bag2 = _expand_pending(walk, capacity, m)
+            bred, srows_d = _order_roots_by_work(
+                bred, f_theta=f_theta, eps=eps, rule=rule,
+                window=2 * breed_chunk, skip_ratio=sort_skip_ratio)
+            srows_d = srows_d.astype(jnp.int64)
+        else:
+            srows_d = jnp.zeros((), jnp.int64)
+        wkw = dict(f_ds=f_ds, eps=eps, m=m, seg_iters=seg_iters,
+                   max_segments=max_segments,
+                   min_active_frac=min_active_frac,
+                   exit_frac=exit_frac, suspend_frac=suspend_frac,
+                   interpret=interpret, lanes=lanes,
+                   gsegs0=c.segs.astype(jnp.int32),
+                   seg_stats0=c.seg_stats, rule=rule)
+        if refill_slots:
+            walk, kx = _run_walk_kernel_refill(
+                bred, refill_slots=refill_slots, **wkw)
+            roots_taken = kx.taken.astype(jnp.int64)
+        else:
+            walk = _run_walk(bred, **wkw)
+            kx = None
+            roots_taken = walk.cursor.astype(jnp.int64)
+        bag2 = _expand_pending(walk, capacity, m, kx)
 
         # Drain in f64 ONLY below the walker's own engagement threshold
         # (walk's cond would refuse to run there, so the cycle loop could
@@ -1039,9 +1533,9 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         bag_splits = bred.splits + bag3.splits
         cyc_row = jnp.stack([
             bred.count.astype(jnp.int64), bred.iters,
-            walk.cursor.astype(jnp.int64), wt,
+            roots_taken, wt,
             walk.steps.astype(jnp.int64), walk.segs.astype(jnp.int64),
-            bag2.count.astype(jnp.int64), bag3.tasks])
+            bag2.count.astype(jnp.int64), bag3.tasks, srows_d])
         cyc_stats = lax.dynamic_update_slice(
             c.cyc_stats, cyc_row[None, :],
             (jnp.minimum(c.cycles, C_CAP - 1), jnp.int32(0)))
@@ -1060,10 +1554,11 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             btasks=c.btasks + bag_tasks,
             wtasks=c.wtasks + wt,
             wsplits=c.wsplits + ws,
-            roots=c.roots + walk.cursor.astype(jnp.int64),
+            roots=c.roots + roots_taken,
             rounds=c.rounds + bred.iters + bag3.iters,
             segs=c.segs + walk.segs.astype(jnp.int64),
             wsteps=c.wsteps + walk.steps.astype(jnp.int64),
+            srows=c.srows + srows_d,
             maxd=jnp.maximum(
                 jnp.maximum(c.maxd, jnp.max(walk.lanes.maxd)),
                 jnp.maximum(bred.max_depth, bag3.max_depth)),
@@ -1081,7 +1576,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         bag=bag,
         acc=acc0 if acc0 is not None else jnp.zeros(m, jnp.float64),
         tasks=z64, splits=z64, btasks=z64, wtasks=z64, wsplits=z64,
-        roots=z64, rounds=z64, segs=z64, wsteps=z64,
+        roots=z64, rounds=z64, segs=z64, wsteps=z64, srows=z64,
         maxd=jnp.zeros((), jnp.int32), cycles=jnp.zeros((), jnp.int32),
         overflow=jnp.zeros((), bool),
         seg_stats=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)), jnp.int32),
@@ -1098,11 +1593,17 @@ def walker_sizing(lanes: int, roots_per_lane: int, capacity: int,
     Returns ``(target, breed_chunk, slack_chunk)``: the breed root
     target, the breeding pop width, and the bag-store slack that keeps
     both bag_step's push windows and _expand_pending's static pending
-    grid from ever clamping (see integrate_family_walker).
+    grid from ever clamping (see integrate_family_walker). The pending
+    grid includes up to ``roots_per_lane * lanes`` untaken dealt-root
+    rows under kernel refill (refill_slots <= roots_per_lane is
+    enforced), and the slack covers it in BOTH refill modes so one
+    prebuilt seed state serves either.
     """
     target = min(roots_per_lane * lanes, capacity // 2)
     breed_chunk = max(1 << int(target - 1).bit_length(), chunk)
-    slack_chunk = max(breed_chunk, -(-(MAX_REL_DEPTH + 1) * lanes // 2))
+    slack_chunk = max(
+        breed_chunk,
+        -(-(MAX_REL_DEPTH + 1 + roots_per_lane) * lanes // 2))
     return target, breed_chunk, slack_chunk
 
 
@@ -1146,6 +1647,15 @@ class WalkerResult:
     seg_stats: Optional[np.ndarray] = None
     cycle_stats: Optional[np.ndarray] = None
     lanes: int = 0
+    kernel_steps: int = 0        # walker kernel iterations executed —
+    #                              kernel lane-steps = kernel_steps *
+    #                              lanes, the numerator of the bench's
+    #                              kernel_wall_frac / kernel_ceiling_frac
+    #                              headroom pair (VERDICT r5 #5)
+    refill_slots: int = 0        # in-kernel refill R of the run (0 =
+    #                              legacy XLA-boundary refill); decides
+    #                              how occupancy_summary may read the
+    #                              seg-stats rows
 
     def occupancy_summary(self) -> Optional[dict]:
         """Compact per-run occupancy breakdown from the stats rings
@@ -1159,6 +1669,14 @@ class WalkerResult:
         recorded), but it tracks the exact ``lane_efficiency`` (=
         tasks / lane-steps, structural max ~2/3 for the trapezoid DFS)
         within a few percent on every measured run.
+
+        IN-KERNEL REFILL runs (``refill_slots`` > 0) record a different
+        row shape — ``refilled`` counts a whole launch's in-kernel
+        takes (up to R*lanes) and ``live_exit`` is sampled only at
+        bank-dry/step-cap exits — so the boundary reconstruction above
+        is invalid there: ``est_occupancy`` is reported as None (the
+        honest occupancy number for that mode is ``lane_efficiency``
+        against its ~2/3 structural cap) and ``mode`` labels the rows.
         """
         ss = self.seg_stats
         if ss is None or len(ss) == 0 or not self.lanes:
@@ -1166,22 +1684,29 @@ class WalkerResult:
         ss = np.asarray(ss, dtype=np.float64)
         steps, live_exit, queue_left, refilled = ss.T
         lanes = float(self.lanes)
-        # row i's `refilled` records the boundary AFTER segment i's walk
-        # (_run_walk writes [si_used, live_exit, queue_left, refill] post
-        # _bank_and_refill), so segment i+1 starts with live_exit[i] +
-        # refilled[i] live lanes.
-        live_start = np.empty_like(live_exit)
-        live_start[0] = lanes            # initial seeding fills all lanes
-        live_start[1:] = np.minimum(lanes, live_exit[:-1] + refilled[:-1])
-        occ = (live_start + live_exit) / (2 * lanes)
         tot = steps.sum()
-        w = steps / tot if tot else steps
         dry = queue_left <= 0
+        if self.refill_slots:
+            est_occ = None
+        else:
+            # row i's `refilled` records the boundary AFTER segment i's
+            # walk (_run_walk writes [si_used, live_exit, queue_left,
+            # refill] post _bank_and_refill), so segment i+1 starts
+            # with live_exit[i] + refilled[i] live lanes.
+            live_start = np.empty_like(live_exit)
+            live_start[0] = lanes        # initial seeding fills all lanes
+            live_start[1:] = np.minimum(lanes,
+                                        live_exit[:-1] + refilled[:-1])
+            occ = (live_start + live_exit) / (2 * lanes)
+            w = steps / tot if tot else steps
+            est_occ = round(float((occ * w).sum()), 4)
         out = {
+            "mode": ("in-kernel-refill" if self.refill_slots
+                     else "xla-boundary"),
             "segments": int(len(ss)),
             "kernel_steps": int(tot),
             "mean_steps_per_segment": round(float(steps.mean()), 1),
-            "est_occupancy": round(float((occ * w).sum()), 4),
+            "est_occupancy": est_occ,
             "dry_queue_steps_frac": round(
                 float(steps[dry].sum() / tot) if tot else 0.0, 4),
             "refilled_roots": int(refilled.sum()),
@@ -1215,9 +1740,7 @@ class WalkerDispatch(NamedTuple):
     t0: float
     lanes: int
     rule: Rule = Rule.TRAPEZOID
-    sort_window: int = 0        # rows err-scored per cycle by
-    #                             _order_roots_by_work (0 = disabled);
-    #                             feeds the integrand_evals accounting
+    refill_slots: int = 0
 
 
 # NOTE on pipelined wall times: a WalkerDispatch's t0 is its DISPATCH
@@ -1250,6 +1773,20 @@ def integrate_family_walker(
         max_cycles: int = 64,
         rule: Rule = Rule.TRAPEZOID,
         sort_roots: bool = True,
+        refill_slots: int = 0,      # R > 0: IN-KERNEL refill — deal R
+        #                             work-sorted roots per lane into a
+        #                             private VMEM bank and let the
+        #                             kernel refill its own lanes; a
+        #                             segment boundary then happens only
+        #                             on bank-dry or step cap, with ZERO
+        #                             boundary sorts (make_walk_kernel).
+        #                             Requires refill_slots <=
+        #                             roots_per_lane (store sizing).
+        sort_skip_ratio: float = 8.0,   # skip the root-ordering sort
+        #                             when the live window's finite
+        #                             error spread is within this ratio
+        #                             (~one refinement level); 0
+        #                             disables the skip
         interpret: Optional[bool] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 1,
@@ -1291,6 +1828,14 @@ def integrate_family_walker(
         interpret = jax.default_backend() != "tpu"
     if lanes % 128:
         raise ValueError(f"lanes must be a multiple of 128, got {lanes}")
+    if refill_slots < 0 or refill_slots > roots_per_lane:
+        # walker_sizing's expand-pending slack covers at most
+        # roots_per_lane untaken dealt roots per lane; a larger deal
+        # would let the pending-grid push window clamp and corrupt
+        # live bag entries.
+        raise ValueError(
+            f"refill_slots must be in [0, roots_per_lane={roots_per_lane}]"
+            f", got {refill_slots}")
     theta = np.asarray(theta, dtype=np.float64)
     m = theta.shape[0]
     bounds = np.asarray(bounds, dtype=np.float64)
@@ -1341,12 +1886,14 @@ def integrate_family_walker(
               interpret=bool(interpret), lanes=int(lanes),
               capacity=int(capacity), breed_chunk=int(breed_chunk),
               target=int(target), rule=Rule(rule),
-              sort_roots=bool(sort_roots))
-    sort_window = 2 * breed_chunk if sort_roots else 0
+              sort_roots=bool(sort_roots),
+              refill_slots=int(refill_slots),
+              sort_skip_ratio=float(sort_skip_ratio))
     if checkpoint_path is None:
         out = _run_cycles(state, max_cycles=int(max_cycles), **kw)
         d = WalkerDispatch(out=out, t0=t0, lanes=int(lanes),
-                           rule=Rule(rule), sort_window=sort_window)
+                           rule=Rule(rule),
+                           refill_slots=int(refill_slots))
         return d if _dispatch_only else collect_family_walker(d)
     else:
         from ppls_tpu.parallel.bag_engine import _family_ckpt_identity
@@ -1357,8 +1904,8 @@ def integrate_family_walker(
                                          f_theta, float(eps),
                                          m, theta, bounds)
         tot = dict(tasks=0, splits=0, btasks=0, wtasks=0, wsplits=0,
-                   roots=0, rounds=0, segs=0, wsteps=0, max_depth=0,
-                   cycles=0)
+                   roots=0, rounds=0, segs=0, wsteps=0, srows=0,
+                   max_depth=0, cycles=0)
         if _totals_override is not None:
             # the accumulator re-enters the DEVICE addition chain via
             # acc0, so legging/resuming reassociates nothing
@@ -1375,11 +1922,11 @@ def integrate_family_walker(
             out = _run_cycles(bag, acc_dev,
                               max_cycles=int(checkpoint_every), **kw)
             (l_tasks, l_splits, l_bt, l_wt, l_ws, l_roots,
-             l_rounds, l_segs, l_wst, l_maxd, l_cycles, l_ovf,
+             l_rounds, l_segs, l_wst, l_srows, l_maxd, l_cycles, l_ovf,
              left, l_seg_stats, l_cyc_stats) = jax.device_get(
                  (out.tasks, out.splits, out.btasks, out.wtasks,
                   out.wsplits, out.roots, out.rounds, out.segs,
-                  out.wsteps, out.maxd,
+                  out.wsteps, out.srows, out.maxd,
                   out.cycles, out.overflow, out.bag.count,
                   out.seg_stats, out.cyc_stats))
             leg_seg_stats.append(
@@ -1391,7 +1938,7 @@ def integrate_family_walker(
                          ("btasks", l_bt), ("wtasks", l_wt),
                          ("wsplits", l_ws), ("roots", l_roots),
                          ("rounds", l_rounds), ("segs", l_segs),
-                         ("wsteps", l_wst),
+                         ("wsteps", l_wst), ("srows", l_srows),
                          ("cycles", l_cycles)):
                 tot[k] += int(v)
             tot["max_depth"] = max(tot["max_depth"], int(l_maxd))
@@ -1420,30 +1967,21 @@ def integrate_family_walker(
                 break
             bag = out.bag
         acc = np.asarray(jax.device_get(acc_dev))
-        (tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
-         wsteps, maxd, cycles) = (
-             tot["tasks"], tot["splits"], tot["btasks"],
-             tot["wtasks"], tot["wsplits"], tot["roots"],
-             tot["rounds"], tot["segs"], tot["wsteps"],
-             tot["max_depth"], tot["cycles"])
         seg_stats_np = (np.concatenate(leg_seg_stats)[:S_CAP]
                         if leg_seg_stats else None)
         cyc_stats_np = (np.concatenate(leg_cyc_stats)[:C_CAP]
                         if leg_cyc_stats else None)
     wall = time.perf_counter() - t0
     return _assemble_result(
-        acc, dict(tasks=tasks, splits=splits, btasks=btasks,
-                  wtasks=wtasks, wsplits=wsplits, roots=roots,
-                  rounds=rounds, segs=segs, wsteps=wsteps,
-                  max_depth=maxd, cycles=cycles),
+        acc, dict(tot),
         left=left, overflow=overflow, wall=wall, lanes=lanes,
         seg_stats=seg_stats_np, cyc_stats=cyc_stats_np, rule=Rule(rule),
-        sort_window=sort_window, checkpoint_path=checkpoint_path)
+        refill_slots=int(refill_slots), checkpoint_path=checkpoint_path)
 
 
 def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
                      seg_stats, cyc_stats, rule: Rule = Rule.TRAPEZOID,
-                     sort_window: int = 0,
+                     refill_slots: int = 0,
                      checkpoint_path=None) -> WalkerResult:
     """Validate a finished run and build its :class:`WalkerResult`."""
     if bool(overflow):
@@ -1467,6 +2005,7 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
     wtasks = int(tot["wtasks"])
     segs = int(tot["segs"])
     roots = int(tot["roots"])
+    srows = int(tot.get("srows", 0))
     metrics = RunMetrics(
         tasks=tasks,
         splits=int(tot["splits"]),
@@ -1483,20 +2022,23 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         # evaluate 5 per task. Suspended roots never reach their final
         # leaf, so both overstate by at most one eval per lane suspended
         # at phase end (~1e-4 relative).
-        # + the root-ordering pass: each consumed root was err-scored
-        # once by _order_roots_by_work (3 f64 evals, 5 for Simpson).
-        # Dead/padding window rows and re-scores of unconsumed
-        # remainders are excluded, matching the engine-wide convention
-        # (bag chunks and walker lanes also evaluate padding without
-        # counting it).
+        # + the root-ordering pass: `srows` is the DEVICE-COUNTED number
+        # of live window rows err-scored by _order_roots_by_work across
+        # all cycles (3 f64 evals each, 5 for Simpson) — exact, unlike
+        # the old per-consumed-root proxy, which undercounted re-scored
+        # unconsumed remainders and overcounted never-scored roots
+        # whenever the window missed part of the queue (ADVICE r5 #4).
+        # Dead/padding window rows are still excluded, matching the
+        # engine-wide convention (bag chunks and walker lanes also
+        # evaluate padding without counting it).
         integrand_evals=(
             3 * int(tot["btasks"])
             + 2 * wtasks - int(tot["wsplits"]) + roots
-            + (3 * roots if sort_window else 0)
+            + 3 * srows
             if Rule(rule) == Rule.TRAPEZOID else
             5 * int(tot["btasks"])
             + 4 * wtasks - 2 * int(tot["wsplits"]) + roots
-            + (5 * roots if sort_window else 0)),
+            + 5 * srows),
         wall_time_s=wall,
         n_chips=1,
         tasks_per_chip=[tasks],
@@ -1511,6 +2053,8 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         seg_stats=seg_stats,
         cycle_stats=cyc_stats,
         lanes=int(lanes),
+        kernel_steps=int(tot["wsteps"]),
+        refill_slots=int(refill_slots),
     )
 
 
@@ -1519,11 +2063,11 @@ def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
     assemble the :class:`WalkerResult` (one small host pull)."""
     out = d.out
     (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
-     wsteps, maxd, cycles, overflow, left, seg_stats_np,
+     wsteps, srows, maxd, cycles, overflow, left, seg_stats_np,
      cyc_stats_np) = jax.device_get(
          (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
           out.wsplits, out.roots, out.rounds, out.segs, out.wsteps,
-          out.maxd, out.cycles, out.overflow, out.bag.count,
+          out.srows, out.maxd, out.cycles, out.overflow, out.bag.count,
           out.seg_stats, out.cyc_stats))
     seg_stats_np = np.asarray(seg_stats_np)[:min(int(segs), S_CAP)]
     cyc_stats_np = np.asarray(cyc_stats_np)[:min(int(cycles), C_CAP)]
@@ -1531,10 +2075,10 @@ def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
         np.asarray(acc),
         dict(tasks=tasks, splits=splits, btasks=btasks, wtasks=wtasks,
              wsplits=wsplits, roots=roots, rounds=rounds, segs=segs,
-             wsteps=wsteps, max_depth=maxd, cycles=cycles),
+             wsteps=wsteps, srows=srows, max_depth=maxd, cycles=cycles),
         left=left, overflow=overflow,
         wall=time.perf_counter() - d.t0, lanes=d.lanes, rule=d.rule,
-        sort_window=d.sort_window,
+        refill_slots=d.refill_slots,
         seg_stats=seg_stats_np, cyc_stats=cyc_stats_np)
 
 
@@ -1573,6 +2117,8 @@ def resume_family_walker(
         max_cycles: int = 64,
         rule: Rule = Rule.TRAPEZOID,
         sort_roots: bool = True,
+        refill_slots: int = 0,
+        sort_skip_ratio: float = 8.0,
         interpret: Optional[bool] = None,
         checkpoint_every: int = 1) -> WalkerResult:
     """Continue an interrupted checkpointed walker run from its last
@@ -1604,6 +2150,9 @@ def resume_family_walker(
     # estimate it as segs * seg_iters (the pre-adaptive identity) so the
     # reported lane_efficiency stays meaningful instead of inflated.
     totals.setdefault("wsteps", int(totals.get("segs", 0)) * int(seg_iters))
+    # snapshots from before the device-counted sort accounting lack
+    # "srows"; 0 keeps the evals estimate conservative for old legs.
+    totals.setdefault("srows", 0)
     totals["acc"] = acc
     return integrate_family_walker(
         f_theta, f_ds, theta, bounds, eps, chunk=chunk, capacity=capacity,
@@ -1611,6 +2160,7 @@ def resume_family_walker(
         max_segments=max_segments, min_active_frac=min_active_frac,
         exit_frac=exit_frac, suspend_frac=suspend_frac,
         max_cycles=max_cycles, rule=rule, sort_roots=sort_roots,
+        refill_slots=refill_slots, sort_skip_ratio=sort_skip_ratio,
         interpret=interpret,
         checkpoint_path=path, checkpoint_every=checkpoint_every,
         _state_override=state, _totals_override=totals)

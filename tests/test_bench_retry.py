@@ -135,6 +135,40 @@ def test_watchdog_exhaustion_fails_not_wedges(monkeypatch):
     assert len(attempts) == bench.MAX_ATTEMPTS - 1
 
 
+def test_headroom_metrics_derivation_from_seg_stats():
+    """The acceptance derivation (ISSUE r6): kernel_wall_frac /
+    kernel_ceiling_frac come from the seg-stats step counter — kernel
+    lane-steps = sum(steps column) * lanes, kernel seconds estimated as
+    lane-steps / same-day ceiling."""
+    import numpy as np
+
+    # a fake seg-stats ring: [steps, live_at_exit, queue_left, refilled]
+    ss = np.array([[100, 200, 50, 10],
+                   [250, 180, 0, 0],
+                   [150, 90, 0, 0]], dtype=np.int64)
+    kernel_steps = int(ss[:, 0].sum())          # 500 — the wsteps counter
+    lanes = 1 << 14
+    wall_s = 2.0
+    ceiling = 4.55e9
+    rec = bench.headroom_metrics(kernel_steps, lanes, wall_s, ceiling)
+    lane_steps = 500 * lanes
+    assert rec["kernel_lane_steps"] == lane_steps
+    assert rec["kernel_lane_steps_per_sec"] == round(lane_steps / 2.0, 1)
+    want = round((lane_steps / 2.0) / ceiling, 4)
+    assert rec["kernel_ceiling_frac"] == want
+    # the two fracs are one number read two ways (kernel seconds are
+    # ESTIMATED via the ceiling): share-of-wall == share-of-ceiling
+    assert rec["kernel_wall_frac"] == rec["kernel_ceiling_frac"]
+    assert 0.0 < rec["kernel_ceiling_frac"] < 1.0
+
+
+def test_headroom_metrics_without_ceiling():
+    rec = bench.headroom_metrics(500, 128, 1.0, None)
+    assert rec["kernel_wall_frac"] is None
+    assert rec["kernel_ceiling_frac"] is None
+    assert rec["kernel_lane_steps_per_sec"] == round(500 * 128 / 1.0, 1)
+
+
 def test_watchdog_passes_results_and_errors_through():
     assert bench.with_deadline(lambda: 7, 5.0) == 7
     with pytest.raises(ValueError, match="inner"):

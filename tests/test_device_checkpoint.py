@@ -97,3 +97,22 @@ def test_walker_kill_and_resume_bit_identical(tmp_path):
     assert np.array_equal(res.areas, base.areas)          # bit-for-bit
     assert res.metrics.tasks == base.metrics.tasks
     assert res.cycles == base.cycles
+
+
+def test_walker_kernel_refill_kill_and_resume_bit_identical(tmp_path):
+    # The in-kernel-refill engine checkpoints at the same cycle
+    # boundaries (all lane/bank state is folded back into the bag by
+    # expand-pending), so kill-and-resume must stay bit-identical there
+    # too — the flagship bench config's resume path.
+    kw = dict(WALK_KW, refill_slots=1)      # roots_per_lane=1 cap
+    base = integrate_family_walker(F, F_DS, THETA, BOUNDS, EPS, **kw)
+    path = str(tmp_path / "walker_rf.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker(F, F_DS, THETA, BOUNDS, EPS, **kw,
+                                checkpoint_path=path, checkpoint_every=2,
+                                _crash_after_legs=2)
+    res = resume_family_walker(path, F, F_DS, THETA, BOUNDS, EPS,
+                               **kw, checkpoint_every=2)
+    assert np.array_equal(res.areas, base.areas)          # bit-for-bit
+    assert res.metrics.tasks == base.metrics.tasks
+    assert res.cycles == base.cycles

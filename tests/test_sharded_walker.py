@@ -54,6 +54,37 @@ def test_multi_family_parity():
     assert max(tpc) / min(tpc) < 2.0, tpc
 
 
+def test_dd_sort_window_uses_breed_chunk(monkeypatch):
+    """ADVICE r5 #3 lock: the dd engine's work-ordering window must be
+    2 * breed_chunk (from _dd_sizing), matching walker._run_cycles.
+    (The r5 advice misread the old parameter name — the call site
+    already passed breed_chunk through an argument NAMED `chunk`, so
+    behavior was correct; the parameter is now named breed_chunk and
+    this test pins the window against any future regression to the
+    caller's raw pop-chunk.) Captures the window actually passed
+    inside the freshly-built shard program."""
+    import ppls_tpu.parallel.sharded_walker as SW
+    from ppls_tpu.parallel.walker import _order_roots_by_work as real
+
+    seen = {}
+
+    def spy(bag, **kwargs):
+        seen["window"] = kwargs.get("window")
+        return real(bag, **kwargs)
+
+    monkeypatch.setattr(SW, "_order_roots_by_work", spy)
+    # chunk differs from every other dd test in this process so
+    # build_dd_walker_run's lru_cache cannot serve a program traced
+    # before the spy was installed
+    kw = dict(KW, chunk=1 << 7)
+    r = integrate_family_walker_dd("sin_recip_scaled", [1.0], BOUNDS,
+                                   1e-6, **kw)
+    assert np.all(np.isfinite(r.areas))
+    _tl, breed_chunk, _store = SW._dd_sizing(
+        kw["lanes"], kw["capacity"], kw["chunk"], kw["roots_per_lane"])
+    assert seen["window"] == 2 * breed_chunk, (seen, breed_chunk)
+
+
 def test_dd_kill_and_resume_matches_uninterrupted(tmp_path):
     # VERDICT r3 #7: kill-and-resume on the virtual 8-mesh reproduces
     # the uninterrupted areas exactly (leg boundaries replay identical

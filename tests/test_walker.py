@@ -259,6 +259,171 @@ def test_walker_simpson_beats_trapezoid_on_smooth():
         ws.metrics.tasks, wt.metrics.tasks)
 
 
+def _toy_bag(l, r, th, meta, store=8):
+    """Hand-built BagState for unit-testing the root-ordering pass."""
+    import jax.numpy as jnp
+    from ppls_tpu.parallel.bag_engine import BagState
+    n = len(l)
+    pad = store - n
+    f64 = lambda x, fill: jnp.asarray(list(x) + [fill] * pad,
+                                      dtype=jnp.float64)
+    return BagState(
+        bag_l=f64(l, 0.25), bag_r=f64(r, 0.75),
+        bag_th=f64(th, 1.0),
+        bag_meta=jnp.asarray(list(meta) + [0] * pad, dtype=jnp.int32),
+        count=jnp.int32(n),
+        acc=jnp.zeros(1, jnp.float64),
+        tasks=jnp.zeros((), jnp.int64), splits=jnp.zeros((), jnp.int64),
+        iters=jnp.zeros((), jnp.int64),
+        max_depth=jnp.zeros((), jnp.int32),
+        overflow=jnp.zeros((), bool))
+
+
+# f(x, th) = th * x^2: constant curvature, so the one-step trapezoid
+# error estimate of a unit interval is proportional to th — the test
+# can dial each root's work score (and inject NaN) through th alone.
+def _quad_family(x, th):
+    return th * x * x
+
+
+def test_order_roots_nan_key_stays_in_live_prefix():
+    """ADVICE r5 #1 regression: a live root whose one-step error
+    estimate is NaN must stay INSIDE the live prefix of the sorted
+    queue. The pre-fix key (jnp.where(live, err, inf)) let lax.sort's
+    total order place the NaN row after the +inf-keyed dead rows —
+    outside the live prefix, silently dropping the root's whole
+    subtree and promoting a dead fill row in its place; this test
+    fails on that key and passes on the NaN->inf mapping."""
+    from ppls_tpu.config import Rule
+    from ppls_tpu.parallel.walker import _order_roots_by_work
+
+    bag = _toy_bag(l=[0.0, 1.0, 2.0, 3.0], r=[1.0, 2.0, 3.0, 4.0],
+                   th=[4.0, 1.0, np.nan, 2.0], meta=[10, 11, 12, 13])
+    out, scored = _order_roots_by_work(
+        bag, f_theta=_quad_family, eps=1e-6, rule=Rule.TRAPEZOID,
+        window=8)
+    assert int(scored) == 4
+    live_meta = sorted(np.asarray(out.bag_meta[:4]).tolist())
+    # the drop check: all four roots — including the NaN one — survive
+    # in the live prefix
+    assert live_meta == [10, 11, 12, 13], live_meta
+    # ascending work order with the NaN root keyed +inf: last live slot
+    live_th = np.asarray(out.bag_th[:4])
+    assert live_th[:3].tolist() == [1.0, 2.0, 4.0], live_th
+    assert np.isnan(live_th[3])
+
+
+def test_order_roots_homogeneous_window_skips_sort():
+    """A window whose finite error spread is within sort_skip_ratio
+    (~one refinement level) is left untouched — the sort is pure cost
+    on an already-homogeneous queue; a wider spread still sorts."""
+    import numpy as np
+    from ppls_tpu.config import Rule
+    from ppls_tpu.parallel.walker import _order_roots_by_work
+
+    # errors proportional to th: spread 3.0/1.5 = 2 < 8
+    kw = dict(f_theta=_quad_family, eps=1e-6, rule=Rule.TRAPEZOID,
+              window=8)
+    bag = _toy_bag(l=[0.0, 1.0, 2.0], r=[1.0, 2.0, 3.0],
+                   th=[3.0, 1.5, 2.0], meta=[20, 21, 22])
+    out, _ = _order_roots_by_work(bag, skip_ratio=8.0, **kw)
+    assert np.asarray(out.bag_th[:3]).tolist() == [3.0, 1.5, 2.0]
+    out, _ = _order_roots_by_work(bag, skip_ratio=0.0, **kw)
+    assert np.asarray(out.bag_th[:3]).tolist() == [1.5, 2.0, 3.0]
+    # spread 16 > 8: the skip must NOT engage
+    bag = _toy_bag(l=[0.0, 1.0, 2.0], r=[1.0, 2.0, 3.0],
+                   th=[16.0, 1.0, 2.0], meta=[30, 31, 32])
+    out, _ = _order_roots_by_work(bag, skip_ratio=8.0, **kw)
+    assert np.asarray(out.bag_th[:3]).tolist() == [1.0, 2.0, 16.0]
+
+
+KW_RF = dict(KW, roots_per_lane=2, refill_slots=2)
+
+
+def test_walker_kernel_refill_parity_vs_bag():
+    # The in-kernel-refill engine (zero boundary sorts; the flagship
+    # bench configuration) must meet the same parity contract as the
+    # XLA-boundary engine.
+    eps = 1e-7
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps, **KW_RF)
+    b = _bag(eps)
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9
+    drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 1e-3, (w.metrics.tasks, b.metrics.tasks)
+    assert w.walker_fraction > 0.5, w.walker_fraction
+    assert w.kernel_steps > 0
+    # in-kernel-refill runs can't reconstruct boundary occupancy from
+    # the seg-stats endpoints — the summary must say so, not guess
+    occ = w.occupancy_summary()
+    assert occ["mode"] == "in-kernel-refill"
+    assert occ["est_occupancy"] is None
+
+
+def test_walker_kernel_refill_deterministic():
+    w1 = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-6, **KW_RF)
+    w2 = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-6, **KW_RF)
+    assert np.array_equal(w1.areas, w2.areas)
+    assert w1.metrics.tasks == w2.metrics.tasks
+
+
+def test_walker_kernel_refill_depth_overflow_mopup(monkeypatch):
+    # An OVF lane inside the refill kernel must never take another
+    # private root (its pending (i, d) set feeds the mop-up), and its
+    # untaken slots must be re-pushed. seg_iters differs from the other
+    # refill tests so the jit cache cannot reuse a kernel traced with
+    # the original depth cap.
+    import ppls_tpu.parallel.walker as W
+    monkeypatch.setattr(W, "MAX_REL_DEPTH", 4)
+    eps = 1e-7
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps,
+                                capacity=1 << 16, lanes=256,
+                                roots_per_lane=2, refill_slots=2,
+                                seg_iters=34, max_cycles=256)
+    b = _bag(eps)
+    assert np.max(np.abs(w.areas - b.areas)) < 3e-9
+    drift = abs(w.metrics.tasks - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 0.05
+
+
+def test_walker_kernel_refill_simpson():
+    from ppls_tpu.config import Rule
+    from ppls_tpu.models.integrands import family_exact
+    eps = 1e-12
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, eps,
+                                rule=Rule.SIMPSON, **KW_RF)
+    exact = np.asarray(family_exact("sin_recip_scaled", *BOUNDS, THETA))
+    assert np.max(np.abs(w.areas - exact)) < 1e-8
+    assert w.walker_fraction > 0.3, w.walker_fraction
+
+
+def test_walker_refill_slots_validation():
+    with pytest.raises(ValueError, match="refill_slots"):
+        integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-6,
+                                refill_slots=3, **KW)   # roots_per_lane=1
+    with pytest.raises(ValueError, match="refill_slots"):
+        integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-6,
+                                refill_slots=-1, **KW)
+
+
+def test_cycle_stats_record_sort_rows():
+    # ADVICE r5 #4: the sort-pass eval accounting is backed by a
+    # device-side live-row count, recorded per cycle in the stats ring.
+    from ppls_tpu.parallel.walker import CYCLE_STAT_FIELDS
+    w = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-7, **KW)
+    cs = w.cycle_stats
+    assert cs is not None and len(cs)
+    j = CYCLE_STAT_FIELDS.index("sort_rows")
+    k = CYCLE_STAT_FIELDS.index("roots_consumed")
+    assert cs[:, j].sum() > 0
+    # every consumed root came off a scored window top, so the scored
+    # total can never undercut the consumed total
+    assert cs[:, j].sum() >= cs[:, k].sum()
+    w0 = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-7,
+                                 sort_roots=False, **KW)
+    cs0 = w0.cycle_stats
+    assert cs0[:, j].sum() == 0
+
+
 def test_walker_engages_on_collapsing_frontier():
     """VERDICT r4 #9: a family mix whose BFS frontier is non-monotone —
     collapsing far below the breed target mid-breed (63 trivial members

@@ -50,7 +50,11 @@ def main():
     theta = 1.0 + np.arange(M) / M
     f_theta = get_family("sin_recip_scaled")
     f_ds = get_family_ds("sin_recip_scaled")
-    kw = dict(capacity=1 << 23)
+    # match bench.py's flagship config (in-kernel refill); set
+    # PPLS_ANALYZE_REFILL_SLOTS=0 to decompose the legacy boundary path
+    kw = dict(capacity=1 << 23,
+              refill_slots=int(os.environ.get(
+                  "PPLS_ANALYZE_REFILL_SLOTS", "8")))
 
     sec("tunnel RTT (trivial device_get x5)")
     x = jnp.zeros(8)
@@ -108,6 +112,7 @@ def main():
     print(f"dispatch-all {t_disp:.3f} s; collect deltas "
           f"{[round(x,3) for x in deltas]} s; total {total:.3f} s "
           f"-> sustained {tasks/total/1e6:.0f} M/s")
+    pipe_total, pipe_tasks, pipe_rs = total, tasks, rs
 
     sec("single-dispatch x5 via fori-style re-dispatch of SAME state")
     # All 5 dispatches share one prebuilt initial state: dispatch cost is
@@ -142,23 +147,67 @@ def main():
           f"{[round(x,3) for x in deltas]} s; total {total:.3f} s "
           f"-> sustained {tot_tasks/total/1e6:.0f} M/s")
 
-    sec("seg_stats occupancy breakdown (from warm run)")
+    sec("occupancy summary (WalkerResult.occupancy_summary — the same "
+        "reconstruction the bench artifact carries)")
+    print(res.occupancy_summary())
+
+    sec("headroom: kernel wall split vs profiled ceiling")
+    # kernel seconds ~= kernel lane-steps / ceiling (ISSUE r6 / VERDICT
+    # r5 #5). Ceiling: slope-profiled on-TPU in this same run, or the
+    # PPLS_CEILING_GSTEPS override (G lane-steps/s) off-TPU.
+    ceiling = None
+    env_c = os.environ.get("PPLS_CEILING_GSTEPS")
+    if env_c:
+        ceiling = float(env_c) * 1e9
+    elif jax.default_backend() == "tpu":
+        from profile_walker import kernel_ceiling_slope
+        prof = kernel_ceiling_slope()
+        ceiling = prof["lane_steps_per_sec"]
+        print(f"slope ceiling: {ceiling/1e9:.2f} G lane-steps/s "
+              f"(outer {prof['outer_lo']} vs {prof['outer_hi']})")
+    if ceiling:
+        lane_steps = res.kernel_steps * DEFAULT_LANES
+        pipe_rate = pipe_tasks / pipe_total   # the pipeline-of-5 above
+        ach = (sum(r.kernel_steps for r in pipe_rs) * DEFAULT_LANES
+               / pipe_total)
+        print(f"pipeline of 5: {ach/1e9:.2f} G lane-steps/s achieved "
+              f"-> kernel_ceiling_frac {ach/ceiling:.3f} "
+              f"(out-of-kernel share {1 - ach/ceiling:.3f}) at "
+              f"{pipe_rate/1e6:.0f} M subint/s")
+        print(f"warm solo run: {lane_steps} lane-steps "
+              f"~= {lane_steps/ceiling*1e3:.1f} ms of kernel at ceiling")
+    else:
+        print("no ceiling (off-TPU and no PPLS_CEILING_GSTEPS); "
+              "skipping the split")
+
+    sec("seg_stats occupancy breakdown (detail, from warm run)")
     ss = res.seg_stats
     if ss is None or not len(ss):
         print("no seg_stats")
+    elif res.refill_slots:
+        # in-kernel-refill rows: `refilled` counts a launch's in-kernel
+        # takes and live_exit is sampled only at bank-dry/step-cap, so
+        # the boundary live-lane reconstruction below does not apply
+        # (occupancy_summary above already reports est_occupancy=None)
+        print(f"in-kernel refill run (R={res.refill_slots}): boundary "
+              f"reconstruction not applicable; first 12 rows "
+              f"[steps, live_exit, queue_left, refilled]:")
+        print(ss[:12].tolist())
     else:
         steps = ss[:, 0].astype(np.float64)
         live_exit = ss[:, 1].astype(np.float64)
         queue_left = ss[:, 2].astype(np.float64)
         refilled = ss[:, 3].astype(np.float64)
         lanes = DEFAULT_LANES
-        # live at segment start ~= live at previous exit + that boundary's
-        # refills (segment 0 starts fully seeded)
+        # live at segment start ~= previous exit + the PREVIOUS row's
+        # refills: row i records the boundary AFTER segment i's walk
+        # (ADVICE r5 #2 — this loop used refilled[k], skewing every
+        # cited occupancy number by one segment; occupancy_summary had
+        # the correct convention, now shared above)
         live_start = np.empty_like(live_exit)
-        live_start[0] = min(lanes, refilled[0] if refilled[0] else lanes)
         live_start[0] = lanes  # initial seeding fills all lanes
         for k in range(1, len(ss)):
-            live_start[k] = min(lanes, live_exit[k - 1] + refilled[k])
+            live_start[k] = min(lanes, live_exit[k - 1] + refilled[k - 1])
         # trapezoidal estimate of within-segment mean occupancy
         occ = (live_start + live_exit) / (2 * lanes)
         w = steps / steps.sum()

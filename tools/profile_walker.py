@@ -1,4 +1,4 @@
-"""Walker kernel ceiling measurement (the round-3 methodology).
+"""Walker kernel ceiling measurement.
 
 Measures the Pallas segment kernel's raw lane-step rate with ONE device
 dispatch around K restarted segments — the only reliable way to time it
@@ -6,7 +6,8 @@ on this host: per-launch overhead is ~0.07 ms and the tunneled device
 adds ~100 ms per sync, so K separate launches measure dispatch, not
 compute (see the round-3 ceiling analysis in the git log).
 
-Run: ``python tools/profile_walker.py`` (real TPU).
+Run: ``python tools/profile_walker.py`` (real TPU). Prints both the
+single-dispatch number and the SLOPE ceiling.
 
 ROUND-5 CORRECTION: the single-dispatch wall time here includes ONE
 tunnel RTT (~120-220 ms on this rig), which at the default workload is
@@ -17,7 +18,10 @@ cancels the constant overhead) gives ~4.55 G lane-steps/s at 2^14
 lanes on v5e — i.e. the kernel is ~3x faster than round 3 believed,
 and the engine's lane_efficiency (structural max ~2/3 for the
 trapezoid DFS: ~1.5 steps per task) is the honest utilization number
-to optimize. Prefer the slope method for any future ceiling numbers.
+to optimize. ``kernel_ceiling_slope`` (round 6) implements exactly
+that two-point method and is what ``bench.py`` re-profiles each round
+for the JSON's ``kernel_wall_frac``/``kernel_ceiling_frac`` headroom
+pair — always quote the slope number, never the single-dispatch one.
 """
 
 import time
@@ -96,8 +100,53 @@ def kernel_ceiling(lanes: int = 1 << 15, seg_iters: int = 256,
     }
 
 
+def kernel_ceiling_slope(lanes: int = 1 << 14, seg_iters: int = 256,
+                         outer_lo: int = 64, outer_hi: int = 512,
+                         eps: float = 1e-10):
+    """Two-point-slope kernel ceiling (the round-5 methodology — the
+    number to quote): time the SAME restarted-segment program at two
+    outer-restart counts and difference, so every constant cost (the
+    tunnel RTT, dispatch, the warmup sync) cancels:
+
+        ceiling = (steps_hi - steps_lo) / (wall_hi - wall_lo)
+
+    Defaults profile the bench's lanes=2^14 operating point. This is
+    what ``bench.py`` runs same-day for its ``kernel_wall_frac`` /
+    ``kernel_ceiling_frac`` headroom fields.
+    """
+    lo = kernel_ceiling(lanes=lanes, seg_iters=seg_iters,
+                        outer=outer_lo, eps=eps)
+    hi = kernel_ceiling(lanes=lanes, seg_iters=seg_iters,
+                        outer=outer_hi, eps=eps)
+    d_steps = (outer_hi - outer_lo) * seg_iters * lanes
+    d_wall = hi["wall_s"] - lo["wall_s"]
+    if d_wall <= 0:
+        raise RuntimeError(
+            f"non-positive slope window ({d_wall:.4f} s between "
+            f"outer={outer_lo} and outer={outer_hi}); rerun — a "
+            f"contended host or a tunnel hiccup inverted the timings")
+    return {
+        "lane_steps_per_sec": d_steps / d_wall,
+        "method": "two-point-slope",
+        "outer_lo": outer_lo,
+        "outer_hi": outer_hi,
+        "wall_lo_s": lo["wall_s"],
+        "wall_hi_s": hi["wall_s"],
+        "lanes": lanes,
+        "seg_iters": seg_iters,
+        # the RTT-polluted single-dispatch rates, kept for comparison
+        "single_dispatch_lo": lo["lane_steps_per_sec"],
+        "single_dispatch_hi": hi["lane_steps_per_sec"],
+    }
+
+
 if __name__ == "__main__":
     r = kernel_ceiling()
     print(f"kernel: {r['lane_steps_per_sec']/1e9:.2f} G lane-steps/s, "
           f"{r['tasks_per_sec_full_occupancy']/1e6:.0f} M subintervals/s "
-          f"at full occupancy ({r['wall_s']*1e3:.0f} ms, one dispatch)")
+          f"at full occupancy ({r['wall_s']*1e3:.0f} ms, one dispatch — "
+          f"RTT-polluted, see module docstring)")
+    s = kernel_ceiling_slope()
+    print(f"kernel SLOPE ceiling: {s['lane_steps_per_sec']/1e9:.2f} G "
+          f"lane-steps/s at lanes={s['lanes']} "
+          f"(outer {s['outer_lo']} vs {s['outer_hi']}; quote this one)")
