@@ -88,17 +88,32 @@ def build_parser() -> argparse.ArgumentParser:
     fam.add_argument("--chunk", type=int, default=1 << 13)
     fam.add_argument("--capacity", type=int, default=1 << 20)
     fam.add_argument("--refill-slots", type=int, default=0,
-                     help="walker engine only: R > 0 deals R work-"
-                          "sorted roots per lane into a private VMEM "
-                          "bank and the kernel refills its own lanes — "
-                          "zero boundary sorts (the flagship bench "
-                          "config uses 8); 0 = legacy XLA-boundary "
-                          "refill")
+                     help="walker and sharded-walker-dd engines: R > 0 "
+                          "deals R work-sorted roots per lane into a "
+                          "private VMEM bank and the kernel refills its "
+                          "own lanes — zero boundary sorts; on the dd "
+                          "engine also collapses the per-cycle "
+                          "collective breed chain to one phase-granular "
+                          "rebalance (the flagship bench config uses "
+                          "8); 0 = legacy XLA-boundary refill")
     fam.add_argument("--n-devices", type=int, default=None)
     fam.add_argument("--checkpoint", default=None,
                      help="snapshot path (bag, walker, sharded-bag, and "
                           "sharded-walker-dd engines); resumes from it "
                           "if it exists")
+    fam.add_argument("--watchdog", type=float, default=None,
+                     metavar="SECONDS",
+                     help="run the engine under a hang watchdog "
+                          "(runtime.guard): on deadline expiry the run "
+                          "is retried ONCE — resuming from --checkpoint "
+                          "when a snapshot exists, so a wedged device "
+                          "loses at most one leg of work instead of "
+                          "hanging forever. Size it WELL ABOVE the "
+                          "worst healthy run time (cold compile "
+                          "included): a timed-out attempt cannot be "
+                          "killed, and a too-short deadline makes it "
+                          "race the retry (~900s is a safe floor on a "
+                          "cold rig)")
     fam.add_argument("--json", action="store_true", dest="as_json")
 
     t2d = sub.add_parser(
@@ -128,7 +143,7 @@ def build_parser() -> argparse.ArgumentParser:
     qmc.add_argument("--genz", default="all",
                      help="Genz family name, or 'all'")
     qmc.add_argument("--n", type=int, default=1 << 18,
-                     help="lattice size (2^16/2^18/2^20)")
+                     help="lattice size (2^16/2^18/2^20/2^22)")
     qmc.add_argument("--shifts", type=int, default=8)
     qmc.add_argument("--dim", type=int, default=8)
     qmc.add_argument("--seed", type=int, default=0,
@@ -151,17 +166,24 @@ def _main_family(args) -> int:
     f = get_family(args.family)
     kw = dict(chunk=args.chunk, capacity=args.capacity)
 
+    # Every branch builds a zero-arg callable that RESUMES from the
+    # snapshot when one exists and runs fresh otherwise — which makes
+    # it self-recovering under the watchdog below: a retried attempt
+    # after a mid-run hang picks up whatever leg snapshot the wedged
+    # attempt managed to write.
     if args.engine == "bag":
         from ppls_tpu.config import Rule
         from ppls_tpu.parallel.bag_engine import (integrate_family,
                                                   resume_family)
         kw["rule"] = Rule(args.rule)
-        if args.checkpoint and os.path.exists(args.checkpoint):
-            res = resume_family(args.checkpoint, f, theta, bounds,
-                                args.eps, **kw)
-        else:
-            res = integrate_family(f, theta, bounds, args.eps,
-                                   checkpoint_path=args.checkpoint, **kw)
+
+        def engine_call():
+            if args.checkpoint and os.path.exists(args.checkpoint):
+                return resume_family(args.checkpoint, f, theta, bounds,
+                                     args.eps, **kw)
+            return integrate_family(f, theta, bounds, args.eps,
+                                    checkpoint_path=args.checkpoint,
+                                    **kw)
     elif args.engine == "walker":
         from ppls_tpu.config import Rule
         from ppls_tpu.parallel.walker import (integrate_family_walker,
@@ -170,13 +192,15 @@ def _main_family(args) -> int:
         wkw = dict(chunk=args.chunk, capacity=args.capacity,
                    rule=Rule(args.rule),
                    refill_slots=args.refill_slots)
-        if args.checkpoint and os.path.exists(args.checkpoint):
-            res = resume_family_walker(args.checkpoint, f, fds, theta,
-                                       bounds, args.eps, **wkw)
-        else:
-            res = integrate_family_walker(f, fds, theta, bounds, args.eps,
-                                          checkpoint_path=args.checkpoint,
-                                          **wkw)
+
+        def engine_call():
+            if args.checkpoint and os.path.exists(args.checkpoint):
+                return resume_family_walker(args.checkpoint, f, fds,
+                                            theta, bounds, args.eps,
+                                            **wkw)
+            return integrate_family_walker(
+                f, fds, theta, bounds, args.eps,
+                checkpoint_path=args.checkpoint, **wkw)
     elif args.engine in ("sharded-walker-dd", "sharded-walker"):
         # one multi-chip flagship path since round 5 (the pmap family-
         # deal variant was retired; see parallel/walker.py's note)
@@ -184,12 +208,15 @@ def _main_family(args) -> int:
         from ppls_tpu.parallel.sharded_walker import (
             integrate_family_walker_dd, resume_family_walker_dd)
         dkw = dict(chunk=args.chunk, capacity=args.capacity,
-                   n_devices=args.n_devices, rule=Rule(args.rule))
-        if args.checkpoint and os.path.exists(args.checkpoint):
-            res = resume_family_walker_dd(args.checkpoint, args.family,
-                                          theta, bounds, args.eps, **dkw)
-        else:
-            res = integrate_family_walker_dd(
+                   n_devices=args.n_devices, rule=Rule(args.rule),
+                   refill_slots=args.refill_slots)
+
+        def engine_call():
+            if args.checkpoint and os.path.exists(args.checkpoint):
+                return resume_family_walker_dd(
+                    args.checkpoint, args.family, theta, bounds,
+                    args.eps, **dkw)
+            return integrate_family_walker_dd(
                 args.family, theta, bounds, args.eps,
                 checkpoint_path=args.checkpoint, **dkw)
     elif args.engine == "sharded-bag":
@@ -198,15 +225,35 @@ def _main_family(args) -> int:
                                                    resume_family_sharded)
         skw = dict(rule=Rule(args.rule), chunk=args.chunk,
                    capacity=args.capacity, n_devices=args.n_devices)
-        if args.checkpoint and os.path.exists(args.checkpoint):
-            res = resume_family_sharded(args.checkpoint, args.family,
-                                        theta, bounds, args.eps, **skw)
-        else:
-            res = integrate_family_sharded(
+
+        def engine_call():
+            if args.checkpoint and os.path.exists(args.checkpoint):
+                return resume_family_sharded(args.checkpoint,
+                                             args.family, theta, bounds,
+                                             args.eps, **skw)
+            return integrate_family_sharded(
                 args.family, theta, bounds, args.eps,
                 checkpoint_path=args.checkpoint, **skw)
     else:
         raise SystemExit(f"unknown family engine {args.engine!r}")
+
+    if args.watchdog:
+        from ppls_tpu.runtime.guard import run_with_watchdog
+
+        def first_attempt():
+            # CLI-level hang-injection hook (consumed on first use):
+            # proves the watchdog + checkpoint-resume recovery path
+            # end-to-end without a real wedged device
+            if os.environ.pop("PPLS_CLI_INJECT_HANG", None):
+                import threading
+                threading.Event().wait(args.watchdog + 60)
+            return engine_call()
+
+        res = run_with_watchdog(first_attempt, args.watchdog,
+                                what=f"{args.engine} engine",
+                                resume_fn=engine_call)
+    else:
+        res = engine_call()
 
     m = res.metrics
     exact = family_exact(args.family, args.a, args.b, theta)

@@ -392,6 +392,41 @@ register_integrand_2d(
     doc="Sharply peaked 2D Gaussian at (0.5, 0.5), sigma=0.05: the "
         "clustered-refinement stress case of BASELINE config #4.")
 
+_G2R_S = 0.05    # gauss2d_ring ridge width
+_G2R_R0 = 0.3    # gauss2d_ring radius
+
+
+def _gauss2d_ring(x, y):
+    r = jnp.sqrt((x - 0.5) ** 2 + (y - 0.5) ** 2)
+    u = (r - _G2R_R0) / _G2R_S
+    return jnp.exp(-(u ** 2))
+
+
+def _gauss2d_ring_exact(ax, bx, ay, by):
+    # Polar closed form over the plane: 2*pi * int_0^inf r *
+    # exp(-((r - r0)/s)^2) dr = 2*pi * (s*r0*(sqrt(pi)/2)*(1 +
+    # erf(r0/s)) + (s^2/2)*exp(-(r0/s)^2)). Valid for the standard
+    # [0,1]^2 domain: the ridge sits >= 4 sigma inside it, so the
+    # truncated tail mass is < 3e-9 absolute (erfc(4) bound) — far
+    # below the trapezoid gate at the bench's eps.
+    if (ax, bx, ay, by) != (0.0, 1.0, 0.0, 1.0):
+        raise ValueError("gauss2d_ring's closed form assumes the "
+                         "standard [0,1]^2 domain (ridge well inside)")
+    s, r0 = _G2R_S, _G2R_R0
+    q = r0 / s
+    return 2.0 * math.pi * (
+        s * r0 * (math.sqrt(math.pi) / 2.0) * (1.0 + math.erf(q))
+        + 0.5 * s * s * math.exp(-q * q))
+
+
+register_integrand_2d(
+    "gauss2d_ring", _gauss2d_ring, _gauss2d_ring_exact,
+    doc="Gaussian ridge along the circle r=0.3 (width sigma=0.05): "
+        "refinement hugs a 1D curve, so the cell count scales like "
+        "curve-length/h — the deep timed workload of the 2D bench "
+        "(~6M cells at eps=1e-12 vs ~53k for gauss2d_peak at 1e-10). "
+        "C twin: backends/csrc/aquad_seq.c 2d mode, fid2=1.")
+
 register_integrand_2d(
     "cos_prod", lambda x, y: jnp.cos(x) * jnp.cos(y),
     lambda ax, bx, ay, by: ((math.sin(bx) - math.sin(ax))

@@ -141,14 +141,15 @@ class CubatureResult:
         return None if self.exact is None else abs(self.area - self.exact)
 
 
-def integrate_2d(f: Callable, bounds, eps: float,
-                 rule: Rule = Rule.SIMPSON,
-                 chunk: int = 1 << 12,
-                 capacity: int = 1 << 20,
-                 max_iters: int = 1 << 20,
-                 exact: Optional[float] = None) -> CubatureResult:
-    """Adaptively integrate ``f(x, y)`` over the rectangle
-    ``bounds = (ax, bx, ay, by)`` with per-cell tolerance ``eps``."""
+def seed_rect_state(bounds, chunk: int = 1 << 12,
+                    capacity: int = 1 << 20) -> RectBag:
+    """Build the 2D engine's seed state ONCE for reuse across repeated
+    runs of the same problem (pass as ``_state_override=`` to
+    :func:`integrate_2d` / :func:`dispatch_2d`) — the 2D twin of
+    ``walker.seed_family_walker_state``: the seed is pure input, and
+    its ~10 eager device ops cost more than a whole run's device time
+    on a tunneled rig, so the pipelined bench must not pay them per
+    dispatch (sustained-pipelined-v2 methodology)."""
     ax, bx, ay, by = (float(v) for v in bounds)
     if chunk > capacity:
         raise ValueError(f"chunk={chunk} exceeds capacity={capacity}")
@@ -157,7 +158,7 @@ def integrate_2d(f: Callable, bounds, eps: float,
     store = capacity + 4 * chunk
     fx = 0.5 * (ax + bx)
     fy = 0.5 * (ay + by)
-    state = RectBag(
+    return RectBag(
         lx=jnp.full(store, fx).at[0].set(ax),
         rx=jnp.full(store, fx).at[0].set(bx),
         ly=jnp.full(store, fy).at[0].set(ay),
@@ -171,19 +172,53 @@ def integrate_2d(f: Callable, bounds, eps: float,
         max_depth=jnp.zeros((), jnp.int32),
         overflow=jnp.zeros((), bool),
     )
+
+
+class RectDispatch(NamedTuple):
+    """In-flight 2D run (device arrays only, no host sync) — redeem
+    with :func:`collect_2d`; queue several to pipeline on-device with
+    one host round-trip at the end (see walker.WalkerDispatch)."""
+
+    out: RectBag
+    t0: float
+    rule: Rule
+    capacity: int
+    max_iters: int
+    exact: Optional[float] = None
+
+
+def dispatch_2d(f: Callable, bounds, eps: float,
+                rule: Rule = Rule.SIMPSON,
+                chunk: int = 1 << 12,
+                capacity: int = 1 << 20,
+                max_iters: int = 1 << 20,
+                exact: Optional[float] = None,
+                _state_override: Optional[RectBag] = None
+                ) -> RectDispatch:
+    """Launch a 2D cubature run WITHOUT waiting for it."""
+    state = (_state_override if _state_override is not None
+             else seed_rect_state(bounds, chunk, capacity))
     t0 = time.perf_counter()
     out = _run_rect_bag(state, f=f, eps=float(eps), rule=Rule(rule),
                         chunk=int(chunk), capacity=int(capacity),
                         max_iters=int(max_iters))
+    return RectDispatch(out=out, t0=t0, rule=Rule(rule),
+                        capacity=int(capacity), max_iters=int(max_iters),
+                        exact=exact)
+
+
+def collect_2d(d: RectDispatch) -> CubatureResult:
+    """Block on an in-flight :class:`RectDispatch`, validate, assemble."""
+    out = d.out
     acc, count, tasks, splits, iters, maxd, overflow = jax.device_get(
         (out.acc, out.count, out.tasks, out.splits, out.iters,
          out.max_depth, out.overflow))
-    wall = time.perf_counter() - t0
+    wall = time.perf_counter() - d.t0
 
     if bool(overflow):
-        raise RuntimeError(f"rect bag overflowed capacity={capacity}")
+        raise RuntimeError(f"rect bag overflowed capacity={d.capacity}")
     if int(count) > 0:
-        raise RuntimeError(f"max_iters={max_iters} exceeded")
+        raise RuntimeError(f"max_iters={d.max_iters} exceeded")
     area = float(acc)
     if not np.isfinite(area):
         raise FloatingPointError("2D cubature produced a non-finite area")
@@ -195,12 +230,28 @@ def integrate_2d(f: Callable, bounds, eps: float,
         leaves=tasks - int(splits),
         rounds=int(iters),
         max_depth=int(maxd),
-        integrand_evals=tasks * EVALS_PER_TASK_2D[Rule(rule)],
+        integrand_evals=tasks * EVALS_PER_TASK_2D[Rule(d.rule)],
         wall_time_s=wall,
         n_chips=1,
         tasks_per_chip=[tasks],
     )
-    return CubatureResult(area=area, metrics=metrics, exact=exact)
+    return CubatureResult(area=area, metrics=metrics, exact=d.exact)
+
+
+def integrate_2d(f: Callable, bounds, eps: float,
+                 rule: Rule = Rule.SIMPSON,
+                 chunk: int = 1 << 12,
+                 capacity: int = 1 << 20,
+                 max_iters: int = 1 << 20,
+                 exact: Optional[float] = None,
+                 _state_override: Optional[RectBag] = None
+                 ) -> CubatureResult:
+    """Adaptively integrate ``f(x, y)`` over the rectangle
+    ``bounds = (ax, bx, ay, by)`` with per-cell tolerance ``eps``."""
+    return collect_2d(dispatch_2d(
+        f, bounds, eps, rule=rule, chunk=chunk, capacity=capacity,
+        max_iters=max_iters, exact=exact,
+        _state_override=_state_override))
 
 
 def _shard_rect_round(s: RectBag, f: Callable, eps: float, rule: Rule,

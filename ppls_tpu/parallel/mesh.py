@@ -83,10 +83,78 @@ def device_store(n_dev: int, store: int, fill, block,
     return base.at[:, : block.shape[1]].set(block)
 
 
+def phase_reshard(axis: str, cols: Sequence[jnp.ndarray],
+                  n_valid: jnp.ndarray, fills: Sequence,
+                  window: int, rebalance_floor,
+                  sort_key: Optional[jnp.ndarray] = None
+                  ) -> Tuple[tuple, jnp.ndarray, jnp.ndarray]:
+    """Phase-granular cross-chip rebalance: ONE collective boundary per
+    walk phase instead of one per breed round.
+
+    The in-kernel-refill multi-chip walker (``sharded_walker.py``) runs
+    each chip's whole walk phase out of a private root bank with ZERO
+    collectives; this is the single boundary it pays afterwards. A
+    GLOBAL bank-occupancy psum of the per-chip remainder counts decides
+    between three replicated outcomes:
+
+    * ``glob == 0``  — terminate (nothing moves; the caller's cycle
+      loop exits on the same psum);
+    * ``0 < glob < rebalance_floor`` — too little global work for
+      balance to matter: skip the collective deal, chips drain their
+      own tails locally (``mine`` is returned all-False and callers
+      keep their local columns);
+    * ``glob >= rebalance_floor`` — deal the TOP ``min(count, window)``
+      rows of every chip's dense prefix round-robin across the mesh
+      (:func:`strided_reshard` on the windows). The top of each local
+      bag holds the phase's freshly-expanded pending tips and untaken
+      dealt roots — the hot work; rows below the window stay local
+      (they are cold remainder, consumed last anyway).
+
+    The decision predicate is a psum — REPLICATED, so every chip takes
+    the same ``lax.cond`` branch and the collectives inside stay in
+    lockstep (the same discipline as every collective loop condition in
+    this package).
+
+    With ``sort_key`` (a full-width per-row column, e.g. task depth)
+    the rebalance deals a key-STRATIFIED sample to every chip instead
+    of a positional interleave — see :func:`strided_reshard`. Adaptive
+    work is heavy-tailed per row, so a positional deal can hand one
+    chip the whole deep cluster; the stratified deal is the walker's
+    work-model fairness applied at the mesh boundary.
+
+    Returns ``(win_cols, n_mine, did)``: the (window,)-shaped reshard
+    output columns to push at ``n_valid - min(n_valid, window)`` (only
+    meaningful when ``did`` is True — otherwise they echo the local
+    window unchanged), this chip's received-row count (= the local
+    window size when skipped), and the replicated rebalance flag.
+    """
+    n_take = jnp.minimum(n_valid, jnp.asarray(window, n_valid.dtype))
+    start = n_valid - n_take
+    local = tuple(lax.dynamic_slice(c, (start,), (window,))
+                  for c in cols)
+    key_win = (None if sort_key is None
+               else lax.dynamic_slice(sort_key, (start,), (window,)))
+    glob = lax.psum(n_take, axis)
+
+    def do_bal(ops):
+        out_cols, mine, _total = strided_reshard(
+            axis, ops, n_take, fills, window, sort_key=key_win)
+        return out_cols, jnp.sum(mine, dtype=jnp.int32)
+
+    def skip(ops):
+        return ops, n_take.astype(jnp.int32)
+
+    did = glob >= jnp.asarray(rebalance_floor, glob.dtype)
+    win_cols, n_mine = lax.cond(did, do_bal, skip, local)
+    return win_cols, n_mine, did
+
+
 def strided_reshard(axis: str, cols: Sequence[jnp.ndarray],
                     n_valid: jnp.ndarray, fills: Sequence,
-                    out_width: int) -> Tuple[tuple, jnp.ndarray,
-                                             jnp.ndarray]:
+                    out_width: int,
+                    sort_key: Optional[jnp.ndarray] = None
+                    ) -> Tuple[tuple, jnp.ndarray,
+                               jnp.ndarray]:
     """Deal every chip's dense prefix round-robin across the mesh.
 
     The demand-driven farmer dispatch (``aquadPartA.c:156-165``) at batch
@@ -103,6 +171,16 @@ def strided_reshard(axis: str, cols: Sequence[jnp.ndarray],
     (callers derive overflow from it — a REPLICATED predicate, safe to
     gate a collective while_loop; a per-chip flag would let chips exit
     on different rounds and desynchronize the collectives).
+
+    With ``sort_key`` (a per-row column aligned with ``cols``) the
+    dense global prefix is additionally ordered by that key before the
+    strided deal, so chip d's rows d, d + n_dev, ... form a STRATIFIED
+    sample of the key distribution — the phase reshard passes a
+    work-proxy key (task depth) here so every chip receives a
+    comparable shallow/deep work mix instead of whatever contiguous
+    block order the gather produced. Without it, block order is
+    preserved (the historical behavior every per-round engine relies
+    on for determinism-compatible results).
     """
     n_dev = lax.psum(1, axis)   # lax.axis_size is newer-jax only
     my = lax.axis_index(axis)
@@ -125,8 +203,13 @@ def strided_reshard(axis: str, cols: Sequence[jnp.ndarray],
     valid = (pos[None, :] < counts[:, None]).reshape(-1)
     key = jnp.logical_not(valid).astype(jnp.int32)
     gathered = [lax.all_gather(c, axis).reshape(-1) for c in cols]
-    sorted_cols = lax.sort((key, *gathered), dimension=0,
-                           is_stable=True, num_keys=1)[1:]
+    if sort_key is not None:
+        wkey = lax.all_gather(sort_key, axis).reshape(-1)
+        sorted_cols = lax.sort((key, wkey, *gathered), dimension=0,
+                               is_stable=True, num_keys=2)[2:]
+    else:
+        sorted_cols = lax.sort((key, *gathered), dimension=0,
+                               is_stable=True, num_keys=1)[1:]
 
     # Chip d takes dense rows d, d + n_dev, d + 2*n_dev, ...: a column
     # of the (width, n_dev) reshape — one dynamic_slice at (0, my), no

@@ -14,27 +14,56 @@ tunnel, fixed by device-side seeding), so "no collectives" bought the
 pmap path nothing it could trade for its inability to balance skew or
 checkpoint. The walk phase is chip-local either way:
 
-* BREED is collective: sharded-bag rounds (local chunk pop/eval +
-  cross-chip child re-shard every round, ``sharded_bag.py``) until the
-  GLOBAL root count reaches the mesh-wide target or passes its peak —
-  so the bred root queue lands balanced to within one row per chip
-  regardless of where the work started;
+* BREED: in legacy mode (``refill_slots`` = 0) it is collective —
+  sharded-bag rounds (local chunk pop/eval + cross-chip child re-shard
+  every round, ``sharded_bag.py``) until the GLOBAL root count reaches
+  the mesh-wide target or passes its peak, so the bred queue lands
+  balanced to within one row per chip, at a cost of ~6 collectives per
+  round and ~5-15 rounds per cycle. In REFILL mode (R > 0, the
+  flagship configuration since round 7) the breed is CHIP-LOCAL (the
+  single-chip f64 BFS, zero collectives; chips' round counts diverge
+  freely like the drain) — the balance those per-round collectives
+  bought now comes from the one phase reshard below;
 * WALK is local: each chip runs the occupancy-aware segment engine
   (``walker._run_walk``) on its own balanced root share — zero
-  collectives in the hot phase;
-* EXPAND is local (suspended subtrees -> bag tasks); the NEXT cycle's
-  collective breed rounds re-deal them across the mesh, so a chip that
-  finishes early is re-fed from the survivors of busy chips — the
-  demand-driven cycle;
+  collectives in the hot phase. In refill mode the chip-local phase is
+  the IN-KERNEL-REFILL engine instead
+  (``walker._run_walk_kernel_refill``): each chip deals its
+  work-sorted local queue into a per-lane VMEM root bank ONCE and the
+  Pallas kernel refills its own lanes — zero boundary sorts, zero
+  per-segment XLA routing, and the phase ends only on bank-dry or
+  step-cap;
+* EXPAND is local (suspended subtrees -> bag tasks; under kernel
+  refill, plus the untaken dealt roots);
+* REBALANCE (refill mode only): the expanded remainder goes through
+  ONE phase-granular collective boundary (``mesh.phase_reshard``) — a
+  global bank-occupancy psum decides rebalance vs. terminate, and the
+  rebalance deals each chip's whole phase output (the top
+  ``reshard_window`` rows) round-robin across the mesh, so the next
+  cycle's local breeds start from balanced shares. The legacy
+  per-cycle chain of breed-round collectives collapses to this one
+  boundary per walk phase — collectives now happen only when a phase
+  ends, i.e. on bank-dry or step-cap. In legacy mode the NEXT cycle's
+  collective breed rounds re-deal the remainder instead — the
+  round-6-and-earlier demand-driven cycle;
 * DRAIN is local behind a per-chip gate (a small local tail finishes in
   f64 faster than another collective cycle);
 * termination is a psum of local counts (``aquadPartA.c:166``
   collectivized), like every sharded engine here.
 
+Collective-boundary accounting: the ``crounds`` counter (surfaced as
+``WalkerResult.collective_rounds``) increments once per collective
+breed round and once per taken phase reshard — replicated by
+construction, so it reads the same on every chip. The refill mode's
+acceptance number is ``collective_rounds / cycles`` strictly below the
+legacy engine's on the same workload (tests + the multichip dry run
+assert it).
+
 Everything runs as ONE jitted ``shard_map`` program per leg: the outer
-cycle loop's condition is replicated (psum), the collective breed
-rounds run in lockstep, and the chip-local walk/expand/drain loops
-diverge freely between collectives.
+cycle loop's condition is replicated (psum), every collective — breed
+rounds, the phase reshard, the refill mode's breed-dispatch cond —
+runs in lockstep behind replicated psum predicates, and the chip-local
+breed/walk/expand/drain loops diverge freely between collectives.
 
 With ``checkpoint_path`` set (VERDICT r3 #7) the run executes in legs
 of ``checkpoint_every`` cycles; at each leg boundary the host gathers
@@ -61,20 +90,24 @@ from ppls_tpu.models.integrands import (DS_FAMILIES, FAMILIES,
                                         check_ds_domain)
 from ppls_tpu.parallel.bag_engine import (
     DEPTH_BITS,
+    DEPTH_MASK,
     BagState,
     _run_bag,
 )
 from ppls_tpu.parallel.mesh import (FRONTIER_AXIS, device_store,
-                                    make_mesh, shard_map_compat)
+                                    make_mesh, phase_reshard,
+                                    shard_map_compat)
 from ppls_tpu.parallel.sharded_bag import _ShardBag, _shard_bag_round
 from ppls_tpu.parallel.walker import (
     MAX_REL_DEPTH,
     S_CAP,
     SEG_STAT_FIELDS,
     WalkerResult,
+    _breed as _walker_breed,
     _expand_pending,
     _order_roots_by_work,
     _run_walk,
+    _run_walk_kernel_refill,
     _WalkCarry,
 )
 from ppls_tpu.utils.metrics import RunMetrics
@@ -99,6 +132,10 @@ class _DDCarry(NamedTuple):
     segs: jnp.ndarray       # i64 walker segments
     wsteps: jnp.ndarray     # i64 walker kernel iterations
     srows: jnp.ndarray      # i64 live rows err-scored by the root sort
+    crounds: jnp.ndarray    # i64 collective rounds: breed rounds +
+    #                         taken phase reshards (replicated by
+    #                         construction — every chip counts the same
+    #                         lockstep collectives)
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32 (replicated by construction)
     overflow: jnp.ndarray   # bool (replicated via psum)
@@ -128,12 +165,18 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                         max_cycles: int, fill_l: float, fill_th: float,
                         rule: Rule = Rule.TRAPEZOID,
                         sort_roots: bool = True,
-                        sort_skip_ratio: float = 8.0):
+                        sort_skip_ratio: float = 8.0,
+                        refill_slots: int = 0,
+                        reshard_window: int = 0):
     """Jitted demand-driven walker leg, memoized per configuration.
 
     Runs up to ``max_cycles`` cycles (a checkpoint leg passes a smaller
     count); state arrays are globally shaped with the leading axis
     sharded over the mesh, per-chip scalars travel as (n_dev,) arrays.
+    With ``refill_slots`` > 0 the per-chip walk phase is the in-kernel
+    refill engine and the cycle pays ONE phase-granular collective
+    rebalance instead of a per-cycle collective breed chain (module
+    docstring).
     """
     f_theta = FAMILIES[family]
     f_ds = DS_FAMILIES[family]
@@ -141,6 +184,15 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
     n_dev = mesh.devices.size
     target_global = n_dev * target_local
     min_active = max(1, int(lanes * min_active_frac))
+    # phase-reshard geometry (refill mode): the window (from
+    # _dd_sizing, = the store slack) covers a chip's whole single-phase
+    # output so a work-clustered chip cannot keep a surplus below the
+    # window; the rebalance floor is the single-chip walk engagement
+    # floor — a global remainder below it drains locally, and one
+    # below n_dev cannot even give every chip a row
+    if not reshard_window:
+        reshard_window = 2 * breed_chunk
+    rebalance_floor = max(n_dev, min_active)
 
     def breed_collective(c: _DDCarry) -> _DDCarry:
         """Collective BFS rounds; every chip executes the same number of
@@ -179,7 +231,12 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             bag_meta=out.bag_meta, count=out.count, acc=out.acc,
             tasks=out.tasks, splits=out.splits,
             btasks=c.btasks + d_tasks,
-            rounds=c.rounds + out.iters, maxd=out.max_depth,
+            rounds=c.rounds + out.iters,
+            # each breed round is one lockstep collective boundary
+            # (all_gather re-shard + psums); out.iters is replicated,
+            # so crounds stays replicated
+            crounds=c.crounds + out.iters,
+            maxd=out.max_depth,
             overflow=out.overflow)
 
     def cycle_cond(c: _DDCarry):
@@ -187,8 +244,49 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
         ok = jnp.logical_and(glob > 0, c.cycles < max_cycles)
         return jnp.logical_and(ok, jnp.logical_not(c.overflow))
 
+    def breed_local(c: _DDCarry) -> _DDCarry:
+        """Chip-LOCAL breed (refill mode): the same f64 BFS refinement
+        as the collective breed, but run per chip with ZERO collectives
+        — chips' round counts diverge freely, like the drain. The
+        cross-chip balance the collective rounds used to provide comes
+        from the ONE phase-granular reshard at the previous cycle's
+        end, so the per-cycle psum/all_gather chain (~6 collectives per
+        breed round, ~5-15 rounds per cycle) collapses to nothing
+        here. Only the overflow predicate is psum'd: the cycle loop's
+        condition reads it and must stay replicated."""
+        bred = _walker_breed(_local_bag(c, m), f_theta=f_theta,
+                             eps=eps, chunk=breed_chunk,
+                             capacity=capacity, target=target_local,
+                             rule=rule)
+        any_ovf = lax.psum(bred.overflow.astype(jnp.int32), axis) > 0
+        return c._replace(
+            bag_l=bred.bag_l, bag_r=bred.bag_r, bag_th=bred.bag_th,
+            bag_meta=bred.bag_meta, count=bred.count,
+            acc=c.acc + bred.acc,
+            tasks=c.tasks + bred.tasks,
+            splits=c.splits + bred.splits,
+            btasks=c.btasks + bred.tasks,
+            rounds=c.rounds + bred.iters,
+            maxd=jnp.maximum(c.maxd, bred.max_depth),
+            overflow=jnp.logical_or(c.overflow, any_ovf))
+
+    # refill mode's breed dispatch: the collective breed runs ONLY on
+    # bank-dry — a global queue below the mesh-wide walk-engagement
+    # floor (cold start, or a dried-out tail whose few surviving tips
+    # must be refined AND re-spread before any bank can fill). The fat
+    # middle of the run breeds chip-locally with zero collectives; the
+    # phase reshard keeps the shares balanced.
+    bank_dry_floor = n_dev * min_active
+
     def cycle_body(c: _DDCarry):
-        bred = breed_collective(c)
+        if refill_slots:
+            glob0 = lax.psum(c.count, axis)
+            # REPLICATED predicate: every chip takes the same branch,
+            # so the collective branch's loop stays in lockstep
+            dry = glob0 < jnp.asarray(bank_dry_floor, glob0.dtype)
+            bred = lax.cond(dry, breed_collective, breed_local, c)
+        else:
+            bred = breed_collective(c)
         local = _local_bag(bred, m)
         if sort_roots:
             # chip-LOCAL work-ordering of the balanced root share (the
@@ -208,8 +306,8 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
         # local walk on this chip's balanced root share (no collectives:
         # per-chip segment counts diverge freely)
-        walk = _run_walk(
-            local, f_ds=f_ds, eps=eps, m=m,
+        wkw = dict(
+            f_ds=f_ds, eps=eps, m=m,
             seg_iters=seg_iters, max_segments=max_segments,
             min_active_frac=min_active_frac, exit_frac=exit_frac,
             suspend_frac=suspend_frac, interpret=interpret, lanes=lanes,
@@ -217,7 +315,58 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             seg_stats0=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)),
                                  jnp.int32),
             rule=rule)
-        bag2 = _expand_pending(walk, capacity, m)
+        if refill_slots:
+            # in-kernel refill: the chip deals its work-sorted queue
+            # top into the per-lane VMEM bank once and the kernel
+            # refills its own lanes — zero boundary sorts, zero
+            # per-segment XLA routing (walker.make_walk_kernel)
+            walk, kx = _run_walk_kernel_refill(
+                local, refill_slots=refill_slots, **wkw)
+            roots_taken = kx.taken.astype(jnp.int64)
+        else:
+            walk = _run_walk(local, **wkw)
+            kx = None
+            roots_taken = walk.cursor.astype(jnp.int64)
+        bag2 = _expand_pending(walk, capacity, m, kx)
+
+        if refill_slots:
+            # ONE phase-granular collective boundary: a global
+            # bank-occupancy psum decides rebalance vs. terminate, and
+            # the rebalance deals every chip's hot queue top round-
+            # robin across the mesh (mesh.phase_reshard) — the refill
+            # mode's replacement for per-cycle breed-round collectives
+            (tl, tr, tth, tm), n_mine, did = phase_reshard(
+                axis,
+                (bag2.bag_l, bag2.bag_r, bag2.bag_th, bag2.bag_meta),
+                bag2.count, (fill_l, fill_l, fill_th, 0),
+                reshard_window, rebalance_floor,
+                # depth-stratified deal: adaptive rows carry heavy-
+                # tailed subtree work, and depth is its cheap monotone
+                # proxy — each chip receives a comparable shallow/deep
+                # mix instead of a positional block that can hand one
+                # chip the whole deep cluster
+                sort_key=bag2.bag_meta & DEPTH_MASK)
+            n_take = jnp.minimum(bag2.count,
+                                 jnp.int32(reshard_window))
+            start = bag2.count - n_take
+            new_count = start + n_mine
+            # replicated overflow predicate, like every collective loop
+            # guard in this package
+            local_ovf = new_count > jnp.asarray(capacity, jnp.int32)
+            bal_ovf = lax.psum(local_ovf.astype(jnp.int32), axis) > 0
+            bag2 = bag2._replace(
+                bag_l=lax.dynamic_update_slice(bag2.bag_l, tl, (start,)),
+                bag_r=lax.dynamic_update_slice(bag2.bag_r, tr, (start,)),
+                bag_th=lax.dynamic_update_slice(bag2.bag_th, tth,
+                                                (start,)),
+                bag_meta=lax.dynamic_update_slice(bag2.bag_meta, tm,
+                                                  (start,)),
+                count=jnp.minimum(new_count,
+                                  jnp.asarray(capacity, jnp.int32)),
+                overflow=jnp.logical_or(bag2.overflow, bal_ovf))
+            d_crounds = did.astype(jnp.int64)
+        else:
+            d_crounds = jnp.zeros((), jnp.int64)
 
         # local drain of a small tail (per-chip gate; no collectives in
         # either branch, so chips may disagree freely)
@@ -244,11 +393,12 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             btasks=bred.btasks + bag3.tasks,
             wtasks=c.wtasks + wt,
             wsplits=c.wsplits + ws,
-            roots=c.roots + walk.cursor.astype(jnp.int64),
+            roots=c.roots + roots_taken,
             rounds=bred.rounds + bag3.iters,
             segs=c.segs + walk.segs.astype(jnp.int64),
             wsteps=c.wsteps + walk.steps.astype(jnp.int64),
             srows=c.srows + srows_d,
+            crounds=bred.crounds + d_crounds,
             maxd=jnp.maximum(jnp.maximum(bred.maxd, bag3.max_depth),
                              jnp.max(walk.lanes.maxd)),
             cycles=c.cycles + 1,
@@ -257,13 +407,13 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
     def shard_body(bag_l, bag_r, bag_th, bag_meta, count, acc, tasks,
                    splits, btasks, wtasks, wsplits, roots, rounds, segs,
-                   wsteps, srows, maxd, cycles, overflow):
+                   wsteps, srows, crounds, maxd, cycles, overflow):
         c = _DDCarry(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
                      bag_meta=bag_meta, count=count[0], acc=acc[0],
                      tasks=tasks[0], splits=splits[0], btasks=btasks[0],
                      wtasks=wtasks[0], wsplits=wsplits[0], roots=roots[0],
                      rounds=rounds[0], segs=segs[0], wsteps=wsteps[0],
-                     srows=srows[0],
+                     srows=srows[0], crounds=crounds[0],
                      maxd=maxd[0], cycles=cycles[0], overflow=overflow[0])
         out = lax.while_loop(cycle_cond, cycle_body, c)
         return (out.bag_l, out.bag_r, out.bag_th, out.bag_meta,
@@ -271,10 +421,11 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                 out.splits[None], out.btasks[None], out.wtasks[None],
                 out.wsplits[None], out.roots[None], out.rounds[None],
                 out.segs[None], out.wsteps[None], out.srows[None],
+                out.crounds[None],
                 out.maxd[None], out.cycles[None], out.overflow[None])
 
     sh = P(axis)
-    n_state = 19
+    n_state = 20
     # check_vma=False: the Pallas segment kernel's out_shape carries no
     # varying-manual-axes annotation, so the static VMA checker cannot
     # type it (every carried value here is per-chip varying anyway; the
@@ -296,9 +447,18 @@ def _dd_sizing(lanes: int, capacity: int, chunk: int,
     target_local = min(roots_per_lane * lanes, capacity // 2)
     breed_chunk = max(1 << int(max(target_local, 1) - 1).bit_length(),
                       chunk)
+    # slack covers bag_step's push windows, _expand_pending's static
+    # pending grid — which under kernel refill carries up to
+    # roots_per_lane untaken dealt-root rows per lane (refill_slots <=
+    # roots_per_lane is enforced) — AND the refill mode's phase-reshard
+    # window: the reshard must be able to move a chip's whole
+    # single-phase output (bred target + expanded pending grid), or a
+    # work-clustered chip keeps its surplus below the window and the
+    # mesh unbalances for many cycles. The window equals the slack so
+    # the top-window slice/push never clamps even at count == capacity.
     slack = max(2 * breed_chunk,
-                -(-(MAX_REL_DEPTH + 1) * lanes // 2) * 2)
-    return target_local, breed_chunk, capacity + slack
+                (MAX_REL_DEPTH + 1 + roots_per_lane) * lanes)
+    return target_local, breed_chunk, capacity + slack, slack
 
 
 def _seed_state(bounds: np.ndarray, theta: np.ndarray, n_dev: int,
@@ -329,6 +489,15 @@ def integrate_family_walker_dd(
         rule: Rule = Rule.TRAPEZOID,
         sort_roots: bool = True,
         sort_skip_ratio: float = 8.0,
+        refill_slots: int = 0,      # R > 0: per-chip IN-KERNEL refill —
+        #                             deal R work-sorted roots per lane
+        #                             into a private VMEM bank, let the
+        #                             kernel refill its own lanes, and
+        #                             pay ONE phase-granular collective
+        #                             rebalance per walk phase instead
+        #                             of per-cycle breed-round chains
+        #                             (module docstring). Requires
+        #                             refill_slots <= roots_per_lane.
         interpret: Optional[bool] = None,
         mesh: Optional[Mesh] = None,
         n_devices: Optional[int] = None,
@@ -347,6 +516,14 @@ def integrate_family_walker_dd(
         interpret = jax.default_backend() != "tpu"
     if lanes % 128:
         raise ValueError(f"lanes must be a multiple of 128, got {lanes}")
+    if refill_slots < 0 or refill_slots > roots_per_lane:
+        # _dd_sizing's expand-pending slack covers at most
+        # roots_per_lane untaken dealt roots per lane; a larger deal
+        # would let the pending-grid push window clamp and corrupt
+        # live bag entries (same contract as the single-chip walker).
+        raise ValueError(
+            f"refill_slots must be in [0, roots_per_lane={roots_per_lane}]"
+            f", got {refill_slots}")
     if mesh is None:
         mesh = make_mesh(n_devices)
     n_dev = mesh.devices.size
@@ -358,7 +535,7 @@ def integrate_family_walker_dd(
         bounds = np.tile(bounds.reshape(1, 2), (m, 1))
     check_ds_domain(DS_FAMILIES[family], bounds, theta)
 
-    target_local, breed_chunk, store = _dd_sizing(
+    target_local, breed_chunk, store, reshard_window = _dd_sizing(
         lanes, capacity, chunk, roots_per_lane)
     fill_l = float(0.5 * (bounds[0, 0] + bounds[0, 1]))
     fill_th = float(theta[0])
@@ -370,7 +547,7 @@ def integrate_family_walker_dd(
         int(target_local), bool(interpret),
         int(checkpoint_every if checkpoint_path else max_cycles),
         fill_l, fill_th, Rule(rule), bool(sort_roots),
-        float(sort_skip_ratio))
+        float(sort_skip_ratio), int(refill_slots), int(reshard_window))
 
     if _state_override is not None:
         bag_l, bag_r, bag_th, bag_meta, count0 = _state_override
@@ -382,7 +559,7 @@ def integrate_family_walker_dd(
     # legs, so totals are simply the latest values and a resumed run
     # reports exact cumulative metrics.
     CTR64 = ("tasks", "splits", "btasks", "wtasks", "wsplits", "roots",
-             "rounds", "segs", "wsteps", "srows")
+             "rounds", "segs", "wsteps", "srows", "crounds")
     per_chip = {k: np.zeros(n_dev, dtype=np.int64) for k in CTR64}
     per_chip["maxd"] = np.zeros(n_dev, dtype=np.int32)
     acc0 = np.zeros((n_dev, m), dtype=np.float64)
@@ -414,19 +591,19 @@ def integrate_family_walker_dd(
     while True:
         out = run(*state, *counters)
         (bl, br, bth, bmeta, count, acc, tasks_c, splits_c, bt_c, wt_c,
-         ws_c, roots_c, rounds_c, segs_c, wsteps_c, srows_c, maxd_c,
-         cycles_c, ovf_c) = out
+         ws_c, roots_c, rounds_c, segs_c, wsteps_c, srows_c, crounds_c,
+         maxd_c, cycles_c, ovf_c) = out
         (count_h, tasks_h, splits_h, bt_h, wt_h, ws_h, roots_h, rounds_h,
-         segs_h, wsteps_h, srows_h, maxd_h, cycles_h,
+         segs_h, wsteps_h, srows_h, crounds_h, maxd_h, cycles_h,
          ovf_h) = jax.device_get(
              (count, tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
-              rounds_c, segs_c, wsteps_c, srows_c, maxd_c, cycles_c,
-              ovf_c))
+              rounds_c, segs_c, wsteps_c, srows_c, crounds_c, maxd_c,
+              cycles_c, ovf_c))
         left = int(np.sum(count_h))
         overflow = bool(np.any(ovf_h))
         for k, v in zip(CTR64, (tasks_h, splits_h, bt_h, wt_h, ws_h,
                                 roots_h, rounds_h, segs_h, wsteps_h,
-                                srows_h)):
+                                srows_h, crounds_h)):
             per_chip[k] = np.asarray(v, dtype=np.int64)
         per_chip["maxd"] = np.asarray(maxd_h, dtype=np.int32)
         cycles_done += int(np.max(cycles_h))
@@ -439,7 +616,8 @@ def integrate_family_walker_dd(
         # cycle instead of replaying the previous leg.
         from ppls_tpu.runtime.checkpoint import save_family_checkpoint
         identity = _dd_ckpt_identity(family, float(eps), m, theta, bounds,
-                                     n_dev, Rule(rule))
+                                     n_dev, Rule(rule),
+                                     int(refill_slots))
         counts = np.asarray(count_h, dtype=np.int32)
         b = min(1 << int(max(int(counts.max()), 1)).bit_length(), store)
         bl2 = np.asarray(jax.device_get(bl.reshape(n_dev, store)[:, :b]))
@@ -465,13 +643,18 @@ def integrate_family_walker_dd(
             break
         state = (bl, br, bth, bmeta, count, acc)
         counters = (tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
-                    rounds_c, segs_c, wsteps_c, srows_c, maxd_c,
+                    rounds_c, segs_c, wsteps_c, srows_c, crounds_c,
+                    maxd_c,
                     jnp.zeros(n_dev, dtype=jnp.int32), ovf_c)
     acc_h = np.asarray(jax.device_get(acc))
     wall = time.perf_counter() - t0
 
     tot = {k: int(np.sum(per_chip[k])) for k in CTR64}
     tot["rounds"] = int(np.max(per_chip["rounds"]))
+    # crounds is REPLICATED (every chip counts the same lockstep
+    # collective boundaries) — the mesh total is the per-chip value,
+    # not the per-chip sum
+    tot["crounds"] = int(np.max(per_chip["crounds"]))
     tot["max_depth"] = int(np.max(per_chip["maxd"]))
     tot["cycles"] = cycles_done
 
@@ -523,16 +706,28 @@ def integrate_family_walker_dd(
         # mesh-aggregate kernel iterations (per-chip lanes each): the
         # numerator of the multi-chip headroom split
         kernel_steps=tot["wsteps"],
+        refill_slots=int(refill_slots),
+        # lockstep collective boundaries this run paid (breed rounds +
+        # taken phase reshards) — the refill mode's acceptance number
+        # is collective_rounds / cycles strictly below legacy's
+        collective_rounds=tot["crounds"],
     )
 
 
 def _dd_ckpt_identity(family: str, eps: float, m: int, theta: np.ndarray,
                       bounds: np.ndarray, n_dev: int,
-                      rule: Rule = Rule.TRAPEZOID) -> dict:
+                      rule: Rule = Rule.TRAPEZOID,
+                      refill_slots: int = 0) -> dict:
     from ppls_tpu.runtime.checkpoint import _family_identity, engine_name
     ident = _family_identity(engine_name("walker-dd", rule), family, eps,
                              m, theta, bounds)
     ident["n_dev"] = n_dev       # per-chip state: mesh size is identity
+    if refill_slots:
+        # the refill mode's per-cycle computation differs from legacy's
+        # (bank deal vs boundary refill), so a refill snapshot resumed
+        # in legacy mode would not replay bit-identically — the mode is
+        # identity. Legacy keeps the bare dict for snapshot back-compat.
+        ident["refill_slots"] = int(refill_slots)
     return ident
 
 
@@ -554,7 +749,8 @@ def resume_family_walker_dd(
     n_dev = mesh.devices.size
     identity = _dd_ckpt_identity(family, float(eps), m, theta_np,
                                  bounds_np, n_dev,
-                                 Rule(kwargs.get("rule", Rule.TRAPEZOID)))
+                                 Rule(kwargs.get("rule", Rule.TRAPEZOID)),
+                                 int(kwargs.get("refill_slots", 0)))
     bag_cols, _count, acc, totals = load_family_checkpoint(path, identity)
 
     # rebuild full-width per-chip stores around the saved live prefixes
@@ -562,7 +758,7 @@ def resume_family_walker_dd(
     capacity = int(kwargs.get("capacity", 1 << 20))
     chunk = int(kwargs.get("chunk", 1 << 12))
     rpl = int(kwargs.get("roots_per_lane", 12))
-    _target_local, _breed_chunk, store = _dd_sizing(
+    _target_local, _breed_chunk, store, _rw = _dd_sizing(
         lanes, capacity, chunk, rpl)
     fill_l = float(0.5 * (bounds_np[0, 0] + bounds_np[0, 1]))
     fill_th = float(theta_np[0])

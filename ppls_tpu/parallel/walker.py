@@ -1124,53 +1124,33 @@ def _fresh_lanes(lanes: int) -> WalkState:
     )
 
 
-def _run_walk_kernel_refill(
-        bag: BagState, *, f_ds: Callable, eps: float, m: int,
-        seg_iters: int, max_segments: int, min_active_frac: float,
-        exit_frac: float, suspend_frac: float, interpret: bool,
-        lanes: int, gsegs0, seg_stats0, rule: Rule = Rule.TRAPEZOID,
-        refill_slots: int = 8):
-    """One walk phase with IN-KERNEL refill (traced inline inside
-    :func:`_run_cycles`; the XLA-boundary twin is :func:`_run_walk`).
+def deal_root_bank(bag: BagState, *, refill_slots: int, lanes: int,
+                   min_active):
+    """Build the per-lane VMEM root bank from a work-sorted root queue:
+    the SHARED bank builder of every in-kernel-refill walk phase (the
+    single-chip :func:`_run_walk_kernel_refill` and the demand-driven
+    multi-chip engine's per-chip phase both call this — one deal scheme,
+    one engagement gate, one padding convention).
 
-    The phase deals the top ``min(count, R*lanes)`` work-sorted roots
-    round-robin into a per-lane private root bank ONCE, then launches
-    the refill kernel until the bank is dry and occupancy drops to the
-    suspension floor (or the step budget runs out). Between launches
-    (step-cap boundaries only) NOTHING is sorted, summed, or routed —
-    the per-launch XLA work is a stats row and a result-bank
-    accumulation. Per-family credit happens once, at phase end: one
-    exact segment-sum over (result bank + every lane's in-flight
-    accumulator). Compare the legacy path: per ~100-step segment, two
-    routing sorts + one segment-sum + slice/where routing — measured
-    as ~half of flagship wall time in round 5 (VERDICT r5 Missing #3).
+    Deals the top ``min(count, R*lanes)`` roots round-robin — root p to
+    lane (p % lanes), slot (p // lanes), biggest-first off the sorted
+    queue top, so each lane's private slot sequence is a stratified
+    (comparable-work) sample. Queues below the ``min_active``
+    engagement floor deal NOTHING (navail = 0): spinning the kernel up
+    for a sub-engagement queue is worse than leaving it for the f64
+    drain, and the gate must live here so both engines agree.
 
-    Returns ``(carry, extras)``: a :class:`_WalkCarry` (cursor set to
-    the dealt-window width so the untouched queue remainder stays a
-    reusable prefix) plus :class:`_KernelRefillExtras` for
-    :func:`_expand_pending` to re-push untaken dealt roots.
+    Returns ``(bank, nslots, navail, dealt)``: the 7-tuple of
+    (R, rows, 128) bank arrays, the per-lane validity counts, the dealt
+    root count, and the flat (R*lanes,) dealt columns ``(dl, dr, dth,
+    dmeta)`` the phase-end credit and expand passes need.
     """
     R = int(refill_slots)
-    run_segment = make_walk_kernel(f_ds, eps, seg_iters,
-                                   interpret=interpret, rule=rule,
-                                   refill_slots=R)
     rows = lanes // 128
     cap_roots = R * lanes
-    min_active = jnp.int32(int(lanes * min_active_frac))
-    suspend_thresh = jnp.int32(int(lanes * suspend_frac))
-    floor = jnp.maximum(min_active, suspend_thresh)
-    # refill cadence: top lanes up once ~batch of them have parked —
-    # the in-kernel analog of exit_frac's boundary cadence
-    batch = jnp.int32(max(lanes - int(lanes * exit_frac), 1))
-    step_budget = jnp.int32(max_segments * seg_iters)
-
     top = bag.count
-    # engagement gate (mirrors _run_walk's cond): a queue below the
-    # engagement floor is not worth spinning the kernel up for — leave
-    # it in place for the f64 drain
     navail = jnp.where(top >= min_active,
                        jnp.minimum(top, cap_roots), 0)
-    start = jnp.maximum(top - navail, 0)
 
     def deal(col):
         # w[p] = col[top - 1 - p] for p < navail (top-of-queue,
@@ -1204,11 +1184,60 @@ def _run_walk_kernel_refill(
     th_h, th_l = to_ds3(dth)
     bank = (a_h, a_l, w_h, w_l, th_h, th_l,
             dmeta.reshape(R, rows, 128))
-    # round-robin deal: root p goes to lane (p % lanes), slot
-    # (p // lanes) — lane l holds ceil((navail - l) / lanes) roots
+    # round-robin deal: lane l holds ceil((navail - l) / lanes) roots
     lane_ids = jnp.arange(lanes, dtype=jnp.int32)
     nslots = jnp.clip((navail - lane_ids + lanes - 1) // lanes,
                       0, R).astype(jnp.int32).reshape(rows, 128)
+    return bank, nslots, navail, (dl, dr, dth, dmeta)
+
+
+def _run_walk_kernel_refill(
+        bag: BagState, *, f_ds: Callable, eps: float, m: int,
+        seg_iters: int, max_segments: int, min_active_frac: float,
+        exit_frac: float, suspend_frac: float, interpret: bool,
+        lanes: int, gsegs0, seg_stats0, rule: Rule = Rule.TRAPEZOID,
+        refill_slots: int = 8):
+    """One walk phase with IN-KERNEL refill (traced inline inside
+    :func:`_run_cycles` and, per chip, inside the demand-driven
+    multi-chip engine's cycle body — ``sharded_walker.py``; the
+    XLA-boundary twin is :func:`_run_walk`).
+
+    The phase deals the top ``min(count, R*lanes)`` work-sorted roots
+    round-robin into a per-lane private root bank ONCE, then launches
+    the refill kernel until the bank is dry and occupancy drops to the
+    suspension floor (or the step budget runs out). Between launches
+    (step-cap boundaries only) NOTHING is sorted, summed, or routed —
+    the per-launch XLA work is a stats row and a result-bank
+    accumulation. Per-family credit happens once, at phase end: one
+    exact segment-sum over (result bank + every lane's in-flight
+    accumulator). Compare the legacy path: per ~100-step segment, two
+    routing sorts + one segment-sum + slice/where routing — measured
+    as ~half of flagship wall time in round 5 (VERDICT r5 Missing #3).
+
+    Returns ``(carry, extras)``: a :class:`_WalkCarry` (cursor set to
+    the dealt-window width so the untouched queue remainder stays a
+    reusable prefix) plus :class:`_KernelRefillExtras` for
+    :func:`_expand_pending` to re-push untaken dealt roots.
+    """
+    R = int(refill_slots)
+    run_segment = make_walk_kernel(f_ds, eps, seg_iters,
+                                   interpret=interpret, rule=rule,
+                                   refill_slots=R)
+    rows = lanes // 128
+    cap_roots = R * lanes
+    min_active = jnp.int32(int(lanes * min_active_frac))
+    suspend_thresh = jnp.int32(int(lanes * suspend_frac))
+    floor = jnp.maximum(min_active, suspend_thresh)
+    # refill cadence: top lanes up once ~batch of them have parked —
+    # the in-kernel analog of exit_frac's boundary cadence
+    batch = jnp.int32(max(lanes - int(lanes * exit_frac), 1))
+    step_budget = jnp.int32(max_segments * seg_iters)
+
+    top = bag.count
+    # shared bank builder (engagement gate included: a queue below the
+    # min_active floor deals nothing and is left for the f64 drain)
+    bank, nslots, navail, (dl, dr, dth, dmeta) = deal_root_bank(
+        bag, refill_slots=R, lanes=lanes, min_active=min_active)
 
     lane0 = _fresh_lanes(lanes)
     slot0 = jnp.zeros((rows, 128), jnp.int32)
@@ -1656,6 +1685,21 @@ class WalkerResult:
     #                              legacy XLA-boundary refill); decides
     #                              how occupancy_summary may read the
     #                              seg-stats rows
+    collective_rounds: int = 0   # multi-chip engines only: lockstep
+    #                              collective boundaries paid across the
+    #                              run (breed rounds + taken phase
+    #                              reshards); 0 on single-chip runs.
+    #                              collective_rounds / cycles is the
+    #                              per-phase collective count the dd
+    #                              refill mode is judged by
+
+    @property
+    def collective_rounds_per_cycle(self) -> float:
+        """Mean lockstep collective boundaries per engine cycle — the
+        multi-chip refill mode's acceptance number (strictly below the
+        legacy engine's on the same workload)."""
+        return (self.collective_rounds / self.cycles
+                if self.cycles else 0.0)
 
     def occupancy_summary(self) -> Optional[dict]:
         """Compact per-run occupancy breakdown from the stats rings

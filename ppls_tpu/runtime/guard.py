@@ -1,0 +1,170 @@
+"""Hang/transient-failure guards for device-touching sections.
+
+Promoted from ``bench.py`` (VERDICT r5 #4): the bench grew a watchdog
+deadline + bounded transient-infra retry after round 3 lost a whole
+round to one tunnel drop, and round 4's verdict noted a wedged device
+blocks ``jax.device_get`` forever — the same failure shape as the
+reference farmer's blocking recv (``aquadPartA.c:145``), which has no
+recovery at all. Those guards are framework-level concerns, not bench
+trivia: the CLI's ``--watchdog`` flag and any long-running engine
+driver need exactly the same protection, so they live here and
+``bench.py`` re-exports them.
+
+Policy (unchanged from the bench):
+
+* ``with_deadline(fn, seconds)`` runs ``fn`` in a worker thread and
+  raises :class:`HangTimeout` on expiry. The hung thread cannot be
+  killed — it is left daemonized; a truly wedged device times out the
+  retry's fresh attempt too, so the caller reports a failure instead of
+  hanging forever.
+* ``with_retry(fn, attempts_log)`` retries ONLY transient
+  infrastructure errors (:func:`is_transient` — tunnel/connection/
+  INTERNAL strings, never this framework's own numerical guard
+  messages) up to ``MAX_ATTEMPTS`` times under the deadline.
+  ``FloatingPointError`` (the engines' NaN guard) always propagates.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+# Substrings that mark an exception as transient INFRASTRUCTURE (the
+# tunneled-device failure modes observed across rounds), never produced
+# by this framework's own numerical guards (those say "non-finite",
+# "did not converge", "overflowed", "mismatch").
+TRANSIENT_MARKERS = (
+    "remote_compile", "response body", "read body", "connection",
+    "Connection", "socket", "tunnel", "INTERNAL:", "UNAVAILABLE",
+    "DEADLINE_EXCEEDED", "ABORTED", "heartbeat", "Broken pipe",
+    "watchdog deadline",
+)
+MAX_ATTEMPTS = 3
+
+
+class HangTimeout(RuntimeError):
+    """A device section exceeded its watchdog deadline (hung device)."""
+
+
+def is_transient(msg: str) -> bool:
+    """True when an exception message matches a known transient
+    infrastructure failure (retry) rather than a numerical one (fail)."""
+    return any(marker in msg for marker in TRANSIENT_MARKERS)
+
+
+def default_watchdog_seconds() -> float:
+    """Deadline per device-section attempt. Generous: a cold compile of
+    the full cycle program takes ~2 min on this rig; a hang blocks
+    forever. Overridable for tests via PPLS_BENCH_WATCHDOG_S."""
+    return float(os.environ.get("PPLS_BENCH_WATCHDOG_S", "900"))
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def with_deadline(fn, seconds: float, what: str = "device section"):
+    """Run ``fn()`` in a worker thread with a deadline.
+
+    On expiry raises :class:`HangTimeout` (classified transient by
+    :func:`is_transient` via its message). The hung thread cannot be
+    killed — it is left daemonized; if the device is truly wedged the
+    retry's fresh attempt times out too and the caller records a failure
+    instead of eating the whole run (VERDICT r4 #5; the reference's
+    analogous hang is the farmer's blocking recv, aquadPartA.c:145,
+    which has no recovery at all).
+    """
+    box = {}
+
+    def worker():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in caller
+            box["error"] = e
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        raise HangTimeout(
+            f"{what}: watchdog deadline {seconds:.0f}s exceeded "
+            f"(hung device run?)")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def with_retry(fn, attempts_log, what="device section",
+               deadline: float = None, log=_log):
+    """Run ``fn`` under the watchdog deadline with up to MAX_ATTEMPTS
+    tries, retrying ONLY transient infra errors (including watchdog
+    expiry). FloatingPointError (the engines' NaN guard) and any
+    non-transient exception propagate immediately. Each retried error is
+    appended to ``attempts_log`` for the caller's record."""
+    if deadline is None:
+        deadline = default_watchdog_seconds()
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        if attempt == 1 and os.environ.pop("PPLS_BENCH_INJECT_TRANSIENT",
+                                           None):
+            # test hook, consumed on first use so it injects exactly one
+            # failure per process: prove a first-attempt tunnel drop
+            # still yields a valid record (VERDICT r3 #1 criterion)
+            attempts_log.append("injected: INTERNAL: simulated tunnel drop")
+            log(f"[guard] {what}: injected transient error "
+                f"(attempt 1/{MAX_ATTEMPTS}); retrying")
+            continue
+        target = fn
+        if attempt == 1 and os.environ.pop("PPLS_BENCH_INJECT_HANG", None):
+            # test hook: a first-attempt hang must be caught by the
+            # watchdog and retried, not wedge the round (VERDICT r4 #5)
+            def target():
+                time.sleep(deadline + 30)
+        try:
+            return with_deadline(target, deadline, what)
+        except FloatingPointError:
+            raise                      # numerical NaN guard: never retry
+        except Exception as e:         # noqa: BLE001 — classified below
+            msg = f"{type(e).__name__}: {e}"
+            if is_transient(msg) and attempt < MAX_ATTEMPTS:
+                attempts_log.append(msg[:300])
+                log(f"[guard] {what}: transient infra error "
+                    f"(attempt {attempt}/{MAX_ATTEMPTS}): "
+                    f"{msg[:120]} ... retrying in 10s")
+                time.sleep(10)
+                continue
+            raise
+    raise RuntimeError(f"{what}: all {MAX_ATTEMPTS} attempts consumed "
+                       f"by injected test hooks")
+
+
+def run_with_watchdog(run_fn, seconds: float, what: str = "engine run",
+                      resume_fn=None, log=_log):
+    """CLI-level watchdog: run an engine under a deadline; on expiry,
+    fall back to ``resume_fn`` (typically a checkpoint resume) once.
+
+    The shape ``timeout + checkpoint => resume``: a checkpointed engine
+    leaves its last leg snapshot on disk, so when the live run wedges,
+    one fresh attempt that RESUMES from the snapshot recovers all work
+    up to the last leg boundary instead of replaying from scratch. With
+    no ``resume_fn`` the timeout simply propagates.
+
+    DEADLINE SIZING CONTRACT: a timed-out attempt cannot be killed —
+    its daemonized thread keeps running (with_deadline). If ``seconds``
+    is shorter than a LEGITIMATE run (e.g. a cold compile), the stale
+    attempt and the resume race on the same device queue and, for a
+    checkpointed run, on the same snapshot path — the stale attempt
+    can overwrite the resume's newer snapshot with an older one. Set
+    the deadline well above the worst-case healthy run time (this is a
+    hang detector, not a scheduler); the bench's 900 s default
+    (PPLS_BENCH_WATCHDOG_S) was sized to cover a cold compile on the
+    slowest observed rig.
+    """
+    try:
+        return with_deadline(run_fn, seconds, what)
+    except HangTimeout as e:
+        if resume_fn is None:
+            raise
+        log(f"[guard] {what}: {e}; resuming from checkpoint")
+        return with_deadline(resume_fn, seconds, f"{what} (resume)")

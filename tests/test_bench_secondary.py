@@ -1,0 +1,80 @@
+"""Secondary-bench foundations (VERDICT r5 #2 + #8).
+
+The 2D and QMC secondaries historically recorded vs_baseline = 0.0 —
+no denominator existed. Round 7 gives the 2D bench a C rectangle-bag
+twin (backends/csrc/aquad_seq.c 2d mode) and the QMC bench a host/
+numpy lattice baseline. These tests pin the parts that must be TRUE
+for those denominators to be honest: the C 2D engine makes the exact
+same f64 split decisions as the jax engine (cells conserve, areas
+agree to summation noise), the ring integrand's closed form is right,
+and the numpy lattice baseline computes the device estimator exactly.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from ppls_tpu.config import Rule
+from ppls_tpu.models.integrands import get_integrand_2d
+from ppls_tpu.parallel.cubature import integrate_2d
+
+needs_cc = pytest.mark.skipif(
+    not any(shutil.which(c) for c in ("cc", "gcc", "clang")),
+    reason="no C compiler for the seq backend")
+
+BOUNDS = (0.0, 1.0, 0.0, 1.0)
+
+
+@needs_cc
+@pytest.mark.parametrize("name,eps", [("gauss2d_peak", 1e-8),
+                                      ("gauss2d_ring", 1e-8)])
+def test_c_2d_twin_matches_jax_engine(name, eps):
+    from ppls_tpu.backends.mpi_backend import run_seq_2d
+
+    entry = get_integrand_2d(name)
+    r = integrate_2d(entry.fn, BOUNDS, eps, rule=Rule.TRAPEZOID,
+                     chunk=1 << 11, capacity=1 << 20)
+    c = run_seq_2d(name, *BOUNDS, eps)
+    # same f64 9-point test on both sides: identical split decisions
+    assert r.metrics.tasks == c["tasks"], (r.metrics.tasks, c["tasks"])
+    assert r.metrics.splits == c["splits"]
+    # areas differ only by summation order (C is Neumaier-compensated)
+    assert abs(r.area - c["area"]) < 1e-12
+    assert c["evals"] == 9 * c["tasks"]
+
+
+def test_gauss2d_ring_exact_formula():
+    # the closed form must match what the adaptive engine converges to
+    entry = get_integrand_2d("gauss2d_ring")
+    exact = entry.exact(*BOUNDS)
+    r = integrate_2d(entry.fn, BOUNDS, 1e-9, rule=Rule.SIMPSON,
+                     chunk=1 << 11, capacity=1 << 20, exact=exact)
+    assert r.global_error < 1e-7, (r.area, exact)
+    # the form is domain-locked: the truncation bound only holds with
+    # the ridge >= 4 sigma inside the box
+    with pytest.raises(ValueError, match="standard"):
+        entry.exact(0.0, 2.0, 0.0, 2.0)
+
+
+def test_qmc_numpy_baseline_matches_device_estimator():
+    """The denominator must compute the SAME estimator: identical
+    lattice, identical shifts, identical mean — so the ratio measures
+    hardware + implementation, not a different algorithm."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from bench import _qmc_numpy_baseline
+    from ppls_tpu.models.genz import GENZ, genz_params
+    from ppls_tpu.parallel.qmc import integrate_qmc
+
+    n, shifts = 1 << 16, 4
+    a, u = genz_params("oscillatory", 8, seed=0)
+    fam = GENZ["oscillatory"]
+    r = integrate_qmc(fam.fn, a, u, n_points=n, n_shifts=shifts,
+                      fn_name="oscillatory")
+    rng = np.random.default_rng(17)        # integrate_qmc default seed
+    shift_arr = rng.random((shifts, 8))
+    cpu = _qmc_numpy_baseline(n, shift_arr, a, u)
+    assert cpu["points"] == n * shifts
+    assert abs(cpu["value"] - r.value) < 1e-11, (cpu["value"], r.value)

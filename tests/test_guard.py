@@ -1,0 +1,107 @@
+"""runtime.guard promotion (VERDICT r5 #4) + the CLI --watchdog flag.
+
+The hang/transient guards moved from bench.py into
+ppls_tpu.runtime.guard so the CLI can wrap engine runs in the same
+protection the bench already had; bench re-exports them (its own
+test_bench_retry.py suite keeps covering that surface). Here: the
+guard module's own API, the run_with_watchdog timeout=>resume shape,
+and the CLI-level hang-injection acceptance (VERDICT r5 #4: a wedged
+first attempt must recover from the checkpoint, not hang the process).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ppls_tpu.runtime import guard
+
+
+def test_bench_reexports_are_the_guard_objects():
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench
+    assert bench.HangTimeout is guard.HangTimeout
+    assert bench.is_transient is guard.is_transient
+    assert bench.with_deadline is guard.with_deadline
+    assert bench.MAX_ATTEMPTS == guard.MAX_ATTEMPTS
+
+
+def test_run_with_watchdog_passthrough():
+    assert guard.run_with_watchdog(lambda: 41, 5.0) == 41
+
+
+def test_run_with_watchdog_resumes_after_hang():
+    import threading
+    calls = []
+
+    def wedged():
+        calls.append("run")
+        threading.Event().wait(5)
+
+    def resume():
+        calls.append("resume")
+        return "recovered"
+
+    out = guard.run_with_watchdog(wedged, 0.2, resume_fn=resume,
+                                  log=lambda m: None)
+    assert out == "recovered"
+    assert calls == ["run", "resume"]
+
+
+def test_run_with_watchdog_no_resume_raises():
+    import threading
+    with pytest.raises(guard.HangTimeout, match="watchdog deadline"):
+        guard.run_with_watchdog(lambda: threading.Event().wait(5), 0.2,
+                                log=lambda m: None)
+
+
+def test_cli_watchdog_hang_injection_resumes_from_checkpoint(
+        tmp_path, capsys, monkeypatch):
+    """The CLI acceptance (VERDICT r5 #4): a checkpointed family run
+    whose first attempt hangs must — under --watchdog — time out,
+    resume from the leg snapshot, and print the same result as an
+    uninterrupted run."""
+    from ppls_tpu.models.integrands import get_family
+    from ppls_tpu.parallel.bag_engine import integrate_family
+    from ppls_tpu import __main__ as cli
+
+    theta = np.linspace(1.0, 2.0, 4, endpoint=False)
+    bounds = (1e-2, 1.0)
+    eps = 1e-6
+    base = integrate_family(get_family("sin_recip_scaled"), theta,
+                            bounds, eps, chunk=1 << 8,
+                            capacity=1 << 14)
+
+    # leave a mid-run leg snapshot behind (the state a wedged device
+    # would have left), so the watchdog's retry takes the RESUME arm
+    path = str(tmp_path / "cli.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family(get_family("sin_recip_scaled"), theta, bounds,
+                         eps, chunk=1 << 8, capacity=1 << 14,
+                         checkpoint_path=path, checkpoint_every=2,
+                         _crash_after_legs=1)
+    assert os.path.exists(path)
+
+    monkeypatch.setenv("PPLS_CLI_INJECT_HANG", "1")
+    rc = cli.main([
+        "family", "--family", "sin_recip_scaled", "--engine", "bag",
+        "--m", "4", "--theta0", "1.0", "--theta1", "2.0",
+        "-a", "1e-2", "-b", "1.0", "--eps", "1e-6",
+        "--chunk", str(1 << 8), "--capacity", str(1 << 14),
+        # generous deadline: the resume attempt shares it, and under a
+        # fully loaded test run its (cached) compile + checkpoint load
+        # measured >0.5s — a tight value makes the RECOVERY arm time
+        # out and flakes the test
+        "--checkpoint", path, "--watchdog", "10", "--json"])
+    assert rc == 0
+    # the injection hook was consumed by the first (hung) attempt
+    assert "PPLS_CLI_INJECT_HANG" not in os.environ
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["engine"] == "bag"
+    np.testing.assert_allclose(out["areas_head"], base.areas[:4],
+                               rtol=0, atol=1e-12)
+    # a finished run clears its snapshot
+    assert not os.path.exists(path)

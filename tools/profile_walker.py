@@ -140,6 +140,17 @@ def kernel_ceiling_slope(lanes: int = 1 << 14, seg_iters: int = 256,
     }
 
 
+def dd_kernel_ceiling_slope(lanes: int = 1 << 12, **kw):
+    """Per-chip kernel ceiling at the DEMAND-DRIVEN engine's operating
+    point (dd default lanes=2^12 per chip vs the single-chip
+    flagship's 2^14): the dd leg's kernel_wall_frac/kernel_ceiling_frac
+    must rate against the ceiling of the lane count it actually runs,
+    or the headroom split silently mixes operating points (bench.py's
+    ``bench_dd`` calls this). Same two-point-slope method; same
+    "quote the slope, never the single dispatch" rule."""
+    return kernel_ceiling_slope(lanes=lanes, **kw)
+
+
 if __name__ == "__main__":
     r = kernel_ceiling()
     print(f"kernel: {r['lane_steps_per_sec']/1e9:.2f} G lane-steps/s, "
@@ -150,3 +161,7 @@ if __name__ == "__main__":
     print(f"kernel SLOPE ceiling: {s['lane_steps_per_sec']/1e9:.2f} G "
           f"lane-steps/s at lanes={s['lanes']} "
           f"(outer {s['outer_lo']} vs {s['outer_hi']}; quote this one)")
+    d = dd_kernel_ceiling_slope()
+    print(f"dd per-chip SLOPE ceiling: {d['lane_steps_per_sec']/1e9:.2f}"
+          f" G lane-steps/s at lanes={d['lanes']} (the dd leg's "
+          f"headroom denominator)")
