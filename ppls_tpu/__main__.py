@@ -137,6 +137,69 @@ def build_parser() -> argparse.ArgumentParser:
                           "from it if it exists")
     t2d.add_argument("--json", action="store_true", dest="as_json")
 
+    srv = sub.add_parser(
+        "serve",
+        help="continuous-batching streaming integration service "
+             "(phase-boundary admission/retirement of concurrent "
+             "requests; runtime/stream.py)")
+    srv.add_argument("--family", default="sin_recip_scaled",
+                     help="registered family name f(x, theta); "
+                          "eps/rule are per-engine (static compile "
+                          "args), theta/bounds are per-request")
+    srv.add_argument("--eps", type=float, default=1e-8)
+    srv.add_argument("--rule", choices=["trapezoid", "simpson"],
+                     default="trapezoid")
+    srv.add_argument("--engine", choices=["walker", "walker-dd"],
+                     default="walker",
+                     help="walker: single-chip streaming flagship; "
+                          "walker-dd: demand-driven multi-chip stream "
+                          "(admission rides the phase reshard)")
+    srv.add_argument("--slots", type=int, default=64,
+                     help="concurrently resident request cap (family "
+                          "slot pool; the pending queue is unbounded)")
+    srv.add_argument("--chunk", type=int, default=1 << 13)
+    srv.add_argument("--capacity", type=int, default=1 << 20)
+    srv.add_argument("--lanes", type=int, default=None,
+                     help="walker lanes (default: engine default)")
+    srv.add_argument("--refill-slots", type=int, default=8)
+    srv.add_argument("--n-devices", type=int, default=None)
+    srv.add_argument("--requests", default=None, metavar="FILE",
+                     help="JSONL request stream: one "
+                          '{"theta": T, "bounds": [A, B], '
+                          '"arrival_phase": P?} per line; "-" = stdin. '
+                          "Default: synthetic load (--synthetic)")
+    srv.add_argument("--synthetic", type=int, default=16, metavar="K",
+                     help="generated request count when --requests is "
+                          "not given")
+    srv.add_argument("--arrival-rate", type=float, default=2.0,
+                     help="synthetic load: mean requests per phase "
+                          "(open-loop Poisson arrivals, deterministic "
+                          "via --seed)")
+    srv.add_argument("--seed", type=int, default=0)
+    srv.add_argument("--theta0", type=float, default=1.0)
+    srv.add_argument("--theta1", type=float, default=2.0)
+    srv.add_argument("-a", type=float, default=1e-3)
+    srv.add_argument("-b", type=float, default=1.0)
+    srv.add_argument("--checkpoint", default=None,
+                     help="stream snapshot path (queue + walker state, "
+                          "written every --checkpoint-every phases); "
+                          "resumes from it if it exists")
+    srv.add_argument("--checkpoint-every", type=int, default=8)
+    srv.add_argument("--watchdog", type=float, default=None,
+                     metavar="SECONDS",
+                     help="hang watchdog around the serve loop "
+                          "(runtime.guard): on expiry the loop is "
+                          "retried once, resuming from --checkpoint "
+                          "when a snapshot exists. CAVEAT: a timed-out "
+                          "attempt cannot be killed (guard.py's "
+                          "deadline contract), so after an expiry the "
+                          "JSONL stream may carry duplicate rids — "
+                          "the stale attempt's lines plus the "
+                          "resume's replay since the last snapshot; "
+                          "consumers must dedupe by rid. Size the "
+                          "deadline well above a healthy phase")
+    srv.add_argument("--json", action="store_true", dest="as_json")
+
     qmc = sub.add_parser(
         "qmc", help="8D Genz suite via shifted-lattice QMC "
                     "(BASELINE config #5)")
@@ -285,6 +348,117 @@ def _main_family(args) -> int:
     return 0
 
 
+def _main_serve(args) -> int:
+    """Streaming service loop: submit requests on their arrival
+    schedule, emit one JSON line per retirement, end with a summary
+    line (``"summary": true``)."""
+    import os
+    import time
+
+    import numpy as np
+
+    from ppls_tpu.config import Rule
+
+    # ---- materialize the request list + open-loop arrival schedule ----
+    if args.requests:
+        fh = sys.stdin if args.requests == "-" else open(args.requests)
+        try:
+            reqs, arrivals = [], []
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                reqs.append((float(d["theta"]),
+                             (float(d["bounds"][0]),
+                              float(d["bounds"][1]))))
+                arrivals.append(int(d.get("arrival_phase", 0)))
+        finally:
+            if fh is not sys.stdin:
+                fh.close()
+    else:
+        # deterministic Poisson-ish open-loop load: exponential
+        # interarrivals at --arrival-rate requests/phase, seeded
+        rng = np.random.default_rng(args.seed)
+        k = int(args.synthetic)
+        thetas = np.linspace(args.theta0, args.theta1, k,
+                             endpoint=False)
+        gaps = rng.exponential(1.0 / max(args.arrival_rate, 1e-9), k)
+        arrivals = np.floor(np.cumsum(gaps) - gaps[0]).astype(int)
+        reqs = [(float(t), (args.a, args.b)) for t in thetas]
+        arrivals = [int(p) for p in arrivals]
+
+    # the serve loop admits in list order gated on arrival_phase — an
+    # out-of-order JSONL entry would head-of-line block everything
+    # behind it, so sort (stably) by arrival phase first; rids then
+    # follow sorted order, deterministically, which is what the resume
+    # path's next_rid prefix-skip relies on
+    order = sorted(range(len(reqs)), key=lambda i: arrivals[i])
+    reqs = [reqs[i] for i in order]
+    arrivals = [arrivals[i] for i in order]
+
+    kw = dict(rule=Rule(args.rule), slots=args.slots, chunk=args.chunk,
+              capacity=args.capacity, refill_slots=args.refill_slots,
+              engine=args.engine, n_devices=args.n_devices,
+              checkpoint_every=args.checkpoint_every)
+    if args.lanes:
+        kw["lanes"] = args.lanes
+
+    def make_engine():
+        from ppls_tpu.runtime.stream import StreamEngine
+        if args.checkpoint and os.path.exists(args.checkpoint):
+            return StreamEngine.resume(args.checkpoint, args.family,
+                                       args.eps, **kw)
+        return StreamEngine(args.family, args.eps,
+                            checkpoint_path=args.checkpoint, **kw)
+
+    def serve_loop():
+        t0 = time.perf_counter()
+        eng = make_engine()
+        # rids are assigned in submission order, so a resumed engine
+        # skips the prefix it already submitted before the crash
+        k = eng.next_rid
+        while k < len(reqs) or not eng.idle:
+            while k < len(reqs) and arrivals[k] <= eng.phase:
+                eng.submit(*reqs[k])
+                k += 1
+            for c in eng.step():
+                print(json.dumps({
+                    "rid": c.rid, "theta": c.theta,
+                    "bounds": list(c.bounds), "area": c.area,
+                    "admit_phase": c.admit_phase,
+                    "retire_phase": c.retire_phase,
+                    "phases_in_flight": c.phases_in_flight,
+                    "latency_phases": c.latency_phases,
+                    "latency_s": round(c.latency_s, 4)}), flush=True)
+        return eng, time.perf_counter() - t0
+
+    if args.watchdog:
+        from ppls_tpu.runtime.guard import run_with_watchdog
+        eng, wall = run_with_watchdog(
+            serve_loop, args.watchdog, what="serve loop",
+            resume_fn=serve_loop if args.checkpoint else None)
+    else:
+        eng, wall = serve_loop()
+
+    if args.checkpoint:
+        eng.clear_snapshot()
+    res = eng.result(wall_s=wall)
+    summary = {
+        "summary": True,
+        "engine": args.engine, "family": args.family, "eps": args.eps,
+        "rule": args.rule, "slots": args.slots,
+        "completed": len(res.completed), "phases": res.phases,
+        "wall_s": round(wall, 3),
+        "requests_per_sec": round(res.requests_per_sec, 3),
+        "latency": res.latency_percentiles(),
+        "occupancy": res.occupancy_summary(eng.lanes),
+        "totals": res.totals,
+    }
+    print(json.dumps(summary))
+    return 0
+
+
 def _main_2d(args) -> int:
     from ppls_tpu.config import Rule
     from ppls_tpu.models.integrands import get_integrand_2d
@@ -377,6 +551,8 @@ def main(argv=None) -> int:
 def _dispatch(args) -> int:
     if getattr(args, "mode", None) == "family":
         return _main_family(args)
+    if getattr(args, "mode", None) == "serve":
+        return _main_serve(args)
     if getattr(args, "mode", None) == "2d":
         return _main_2d(args)
     if getattr(args, "mode", None) == "qmc":

@@ -15,8 +15,18 @@
  *
  * Usage: mpirun -n <P> aquad_mpi <integrand_id> <a> <b> <eps>   (P >= 2)
  * Output (rank 0): one JSON line with area, counters, timing.
+ *
+ * Built with -DAQ_MPI_STUB the same source links against the
+ * single-process in-memory MPI subset in mpi_stub.h (ranks are
+ * threads, messages are mutex/condvar mailboxes; run count via
+ * $AQ_STUB_NP) — the farmer/worker protocol then executes on hosts
+ * with no MPI toolchain at all.
  */
+#ifdef AQ_MPI_STUB
+#include "mpi_stub.h"
+#else
 #include <mpi.h>
+#endif
 
 #include "aquad_common.h"
 

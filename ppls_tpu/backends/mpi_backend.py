@@ -93,6 +93,45 @@ def build_mpi(force: bool = False) -> Optional[str]:
     return out
 
 
+def build_mpi_stub(force: bool = False) -> Optional[str]:
+    """Build the farmer/worker binary against the single-process MPI
+    stub (``csrc/mpi_stub.h``: ranks as threads, in-process mailboxes)
+    — plain cc + pthreads, no MPI toolchain. Returns the binary path,
+    or None without a C compiler."""
+    cc = _cc()
+    if cc is None:
+        return None
+    out = os.path.join(_BUILD, "aquad_mpi_stub")
+    src = os.path.join(_CSRC, "aquad_mpi.c")
+    stub = os.path.join(_CSRC, "mpi_stub.h")
+    newest = max(_src_mtime(src), os.path.getmtime(stub))
+    if os.path.exists(out) and not force and \
+            os.path.getmtime(out) >= newest:
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    _compile([cc, "-O2", "-DAQ_MPI_STUB", "-o", out, src, "-lm",
+              "-lpthread"])
+    return out
+
+
+def run_mpi_stub(config: QuadConfig, n_workers: int = 4
+                 ) -> IntegrationResult:
+    """Run the farmer/worker protocol in ONE process over the MPI stub
+    (1 farmer + ``n_workers`` worker threads). Same binary source, same
+    protocol, same golden numbers as :func:`run_mpi` — executable on
+    this toolchain-less host."""
+    fid = _check_config(config)
+    binary = build_mpi_stub()
+    if binary is None:
+        raise RuntimeError("no C compiler available for the MPI stub")
+    env = dict(os.environ, AQ_STUB_NP=str(n_workers + 1))
+    proc = subprocess.run(
+        [binary, str(fid), repr(config.a), repr(config.b),
+         repr(config.eps)],
+        capture_output=True, text=True, check=True, env=env)
+    return _parse_result(proc.stdout, config, n_chips=n_workers)
+
+
 def _check_config(config: QuadConfig) -> int:
     if Rule(config.rule) != Rule.TRAPEZOID:
         raise ValueError("the C backends implement the reference's "

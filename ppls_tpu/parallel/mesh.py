@@ -115,6 +115,14 @@ def phase_reshard(axis: str, cols: Sequence[jnp.ndarray],
     lockstep (the same discipline as every collective loop condition in
     this package).
 
+    The STREAMING dd engine folds request admission into this same
+    decision: admitted seed rows are pushed onto each chip's local
+    queue as the phase opens (``sharded_walker.build_dd_walker_run``'s
+    ``admit_window`` path), so the ``glob`` psum here counts offered
+    load — the boundary terminates only when remainder AND admissions
+    are both exhausted, and freshly admitted families ride the same
+    stratified deal as the phase output.
+
     With ``sort_key`` (a full-width per-row column, e.g. task depth)
     the rebalance deals a key-STRATIFIED sample to every chip instead
     of a positional interleave — see :func:`strided_reshard`. Adaptive

@@ -108,7 +108,8 @@ def build_sharded_run(mesh: Mesh, integrand: str, eps: float, rule: Rule,
     f = get_integrand(integrand).fn
     axis = FRONTIER_AXIS
 
-    def shard_body(l, r, active, acc_s, acc_c, tasks, splits, rounds, overflow):
+    def shard_body(l, r, active, acc_s, acc_c, tasks, splits, rounds,
+                   overflow, stop_rounds):
         # Inside shard_map: args are local shards with leading dim cap;
         # scalar state travels as (n_dev,) per-chip arrays (local shape
         # (1,)) so every carry component is device-varying — keeps the
@@ -117,15 +118,21 @@ def build_sharded_run(mesh: Mesh, integrand: str, eps: float, rule: Rule,
                            acc_s=acc_s[0], acc_c=acc_c[0],
                            tasks=tasks[0], splits=splits[0],
                            rounds=rounds[0], overflow=overflow[0])
+        # DYNAMIC leg bound (wavefront recovery — the same shape as the
+        # sharded bag's stop_iters): no recompile per checkpoint leg.
+        # `rounds` advances in lockstep on every chip (the round is
+        # collective), so the condition is replicated by construction.
+        stop = stop_rounds[0]
 
         def cond(s: ShardState):
             # Global termination: psum of per-chip pending counts — the
             # collective analog of aquadPartA.c:166.
             pending = lax.psum(jnp.sum(s.active.astype(jnp.int32)), axis)
-            return jnp.logical_and(
+            live = jnp.logical_and(
                 jnp.logical_and(pending > 0, jnp.logical_not(s.overflow)),
                 s.rounds < max_rounds,
             )
+            return jnp.logical_and(live, s.rounds < stop)
 
         def body(s: ShardState):
             return _shard_round(s, f, eps, rule, cap_per_chip, axis, fill)
@@ -140,7 +147,7 @@ def build_sharded_run(mesh: Mesh, integrand: str, eps: float, rule: Rule,
     per_chip = P(axis)  # per-chip scalars stored as (n_dev,) arrays
     fn = jax.jit(shard_map_compat(
         shard_body, mesh=mesh,
-        in_specs=(sharded,) * 3 + (per_chip,) * 6,
+        in_specs=(sharded,) * 3 + (per_chip,) * 7,
         out_specs=(sharded,) * 3 + (per_chip,) * 6,
     ))
     return fn
@@ -157,9 +164,37 @@ class ShardedResult:
         return None if self.exact is None else abs(self.area - self.exact)
 
 
+def _wavefront_identity(config: QuadConfig, n_dev: int) -> dict:
+    from ppls_tpu.runtime.checkpoint import _config_identity
+    ident = dict(_config_identity(config))
+    ident["engine"] = "sharded-wavefront"
+    ident["n_dev"] = n_dev       # per-chip state: mesh size is identity
+    return ident
+
+
 def sharded_integrate(config: QuadConfig = QuadConfig(),
-                      mesh: Optional[Mesh] = None) -> ShardedResult:
-    """Integrate across the mesh; see module docstring for the design."""
+                      mesh: Optional[Mesh] = None,
+                      checkpoint_path: Optional[str] = None,
+                      checkpoint_every: int = 8,
+                      _state_override=None,
+                      _crash_after_legs: Optional[int] = None
+                      ) -> ShardedResult:
+    """Integrate across the mesh; see module docstring for the design.
+
+    With ``checkpoint_path`` set the run executes in legs of
+    ``checkpoint_every`` collective rounds (the wavefront's natural
+    boundary) and snapshots the FULL per-chip frontier columns — l, r,
+    active — plus Kahan partials and counters atomically per leg,
+    reusing the sharded-bag snapshot container
+    (``runtime.checkpoint.save_family_checkpoint``). Full columns, not
+    compacted prefixes: the wavefront's child compaction is
+    position-sensitive (``compact_children``'s cumsum scatter), so
+    preserving row positions is what makes a resumed run replay the
+    identical round sequence bit-for-bit. At the default capacities
+    (2^16 rows) a snapshot is ~1.5 MB per column set — the wavefront
+    is the small-frontier engine; the bag engines snapshot live
+    prefixes instead. Resume with :func:`resume_sharded`.
+    """
     import time
 
     if mesh is None:
@@ -181,19 +216,52 @@ def sharded_integrate(config: QuadConfig = QuadConfig(),
     i0_chip = jnp.zeros(n_dev, dtype=jnp.int64)
     rounds0 = jnp.zeros(n_dev, dtype=jnp.int64)
     overflow0 = jnp.zeros(n_dev, dtype=bool)
+    state = (l, r, active, zeros_chip, zeros_chip, i0_chip, i0_chip,
+             rounds0, overflow0)
+    if _state_override is not None:
+        state = _state_override
 
     t0 = time.perf_counter()
-    out = run(l, r, active, zeros_chip, zeros_chip, i0_chip, i0_chip,
-              rounds0, overflow0)
-    # Single device->host pull of ONLY the small fields (remote-tunneled
-    # backends charge ~100ms per sync and ~8MB/s bulk; the (glob,) l/r
-    # arrays stay on device).
-    (out_l, out_r, out_active_dev, acc_s, acc_c, tasks_chip, splits_chip,
-     rounds_chip, overflow_chip) = out
-    any_active, acc_s, acc_c, tasks_chip, splits_chip, rounds_chip, \
-        overflow_chip = jax.device_get(
-            (jnp.any(out_active_dev), acc_s, acc_c, tasks_chip,
-             splits_chip, rounds_chip, overflow_chip))
+    legs = 0
+    while True:
+        rounds_now = int(np.asarray(jax.device_get(state[7]))[0])
+        leg_end = (rounds_now + int(checkpoint_every)
+                   if checkpoint_path else int(config.max_rounds))
+        out = run(*state, jnp.full(n_dev, leg_end, dtype=jnp.int64))
+        # Single device->host pull of ONLY the small fields (remote-
+        # tunneled backends charge ~100ms per sync and ~8MB/s bulk; the
+        # (glob,) l/r arrays stay on device between legs).
+        (out_l, out_r, out_active_dev, acc_s_d, acc_c_d, tasks_d,
+         splits_d, rounds_d, overflow_d) = out
+        any_active, acc_s, acc_c, tasks_chip, splits_chip, rounds_chip, \
+            overflow_chip = jax.device_get(
+                (jnp.any(out_active_dev), acc_s_d, acc_c_d, tasks_d,
+                 splits_d, rounds_d, overflow_d))
+        rounds_now = int(np.asarray(rounds_chip)[0])
+        finished = (not bool(any_active) or bool(np.any(overflow_chip))
+                    or rounds_now >= int(config.max_rounds))
+        if checkpoint_path is None or finished:
+            break
+        # leg boundary: snapshot the full per-chip frontier (position-
+        # preserving — see docstring) + Kahan partials + counters
+        from ppls_tpu.runtime.checkpoint import save_family_checkpoint
+        l_h, r_h, act_h = jax.device_get((out_l, out_r, out_active_dev))
+        save_family_checkpoint(
+            checkpoint_path,
+            identity=_wavefront_identity(config, n_dev),
+            bag_cols={"l": np.asarray(l_h).reshape(n_dev, cap),
+                      "r": np.asarray(r_h).reshape(n_dev, cap),
+                      "active": np.asarray(act_h).reshape(n_dev, cap)},
+            count=int(np.asarray(act_h).sum()),
+            acc=np.stack([np.asarray(acc_s), np.asarray(acc_c)]),
+            totals={"pc_tasks": np.asarray(tasks_chip).tolist(),
+                    "pc_splits": np.asarray(splits_chip).tolist(),
+                    "rounds": rounds_now})
+        legs += 1
+        if _crash_after_legs is not None and legs >= _crash_after_legs:
+            raise RuntimeError(
+                f"simulated crash after {legs} legs (test hook)")
+        state = out
     wall = time.perf_counter() - t0
     rounds = int(np.asarray(rounds_chip)[0])
     overflow = bool(np.asarray(overflow_chip)[0])
@@ -204,6 +272,10 @@ def sharded_integrate(config: QuadConfig = QuadConfig(),
             f"config.capacity")
     if rounds >= config.max_rounds and bool(any_active):
         raise RuntimeError(f"max_rounds={config.max_rounds} exceeded")
+    # A finished run must not leave its last mid-run snapshot behind
+    # (same contract as the bag/walker engines).
+    from ppls_tpu.parallel.bag_engine import _clear_snapshot
+    _clear_snapshot(checkpoint_path)
 
     # Deterministic cross-chip reduction on host: fixed chip order.
     acc_s_np = np.asarray(acc_s, dtype=np.float64)
@@ -231,3 +303,43 @@ def sharded_integrate(config: QuadConfig = QuadConfig(),
     )
     return ShardedResult(area=area, metrics=metrics,
                          exact=entry.exact(config.a, config.b))
+
+
+def resume_sharded(path: str, config: QuadConfig,
+                   mesh: Optional[Mesh] = None,
+                   checkpoint_every: int = 8) -> ShardedResult:
+    """Continue an interrupted checkpointed :func:`sharded_integrate`
+    run from its last leg snapshot (identity-checked, mesh size
+    included). Bit-identical to the uninterrupted run: the snapshot
+    preserves full per-chip frontier columns (row positions included)
+    and the counters re-enter the device state unchanged, so the
+    continued run replays the identical collective round sequence."""
+    from ppls_tpu.runtime.checkpoint import load_family_checkpoint
+
+    if mesh is None:
+        mesh = make_mesh(config.n_devices)
+    n_dev = mesh.devices.size
+    cols, _count, acc_pair, totals = load_family_checkpoint(
+        path, _wavefront_identity(config, n_dev))
+    cap = max(config.capacity // n_dev, 8)
+    if cols["l"].shape != (n_dev, cap):
+        raise ValueError(
+            f"resume sizing mismatch: snapshot frontier shape "
+            f"{cols['l'].shape} does not match (n_dev, cap) = "
+            f"({n_dev}, {cap}) from this call's capacity; resume with "
+            f"the original run's capacity")
+    dtype = jnp.dtype(config.dtype)
+    state = (
+        jnp.asarray(cols["l"].reshape(-1), dtype=dtype),
+        jnp.asarray(cols["r"].reshape(-1), dtype=dtype),
+        jnp.asarray(cols["active"].reshape(-1), dtype=bool),
+        jnp.asarray(acc_pair[0], dtype=dtype),
+        jnp.asarray(acc_pair[1], dtype=dtype),
+        jnp.asarray(totals["pc_tasks"], dtype=jnp.int64),
+        jnp.asarray(totals["pc_splits"], dtype=jnp.int64),
+        jnp.full(n_dev, int(totals["rounds"]), dtype=jnp.int64),
+        jnp.zeros(n_dev, dtype=bool))
+    return sharded_integrate(config, mesh=mesh,
+                             checkpoint_path=path,
+                             checkpoint_every=checkpoint_every,
+                             _state_override=state)

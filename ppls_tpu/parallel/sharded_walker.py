@@ -167,7 +167,8 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                         sort_roots: bool = True,
                         sort_skip_ratio: float = 8.0,
                         refill_slots: int = 0,
-                        reshard_window: int = 0):
+                        reshard_window: int = 0,
+                        admit_window: int = 0):
     """Jitted demand-driven walker leg, memoized per configuration.
 
     Runs up to ``max_cycles`` cycles (a checkpoint leg passes a smaller
@@ -177,7 +178,32 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
     refill engine and the cycle pays ONE phase-granular collective
     rebalance instead of a per-cycle collective breed chain (module
     docstring).
+
+    With ``admit_window`` = AW > 0 the program is the STREAMING phase
+    body (``runtime/stream.py``, engine="walker-dd"): it takes six
+    extra operands — per-chip admitted-seed blocks (4 columns, (AW,)
+    local dense prefixes with benign fill pads), per-chip admit counts,
+    and an (m,) recycled-slot clear mask — and folds admission into
+    the phase boundary: recycled slots' per-chip partial accumulators
+    are cleared, the admitted seeds enter each chip's local queue top
+    as the phase opens (the host deals requests round-robin over
+    chips), and the cycle's single collective boundary —
+    ``mesh.phase_reshard``'s occupancy psum — then sees the admitted
+    load in its rebalance / drain-locally / terminate decision and
+    deals it depth-stratified with the rest of the phase output. The
+    program additionally returns per-chip family live counts (the
+    retirement done-mask: a family with zero live rows mesh-wide is
+    complete). Streaming requires ``max_cycles == 1`` (one cycle per
+    admission boundary) and ``refill_slots`` > 0.
     """
+    if admit_window:
+        if max_cycles != 1:
+            raise ValueError("admit_window requires max_cycles == 1 "
+                             "(one cycle per admission boundary)")
+        if not refill_slots:
+            raise ValueError("admit_window requires refill_slots > 0 "
+                             "(admission rides the refill mode's "
+                             "phase-granular reshard)")
     f_theta = FAMILIES[family]
     f_ds = DS_FAMILIES[family]
     axis = FRONTIER_AXIS
@@ -405,9 +431,37 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             overflow=jnp.logical_or(bred.overflow, any_ovf),
         )
 
+    def _admit_local(c: _DDCarry, adm_l, adm_r, adm_th, adm_meta,
+                     n_adm, clear) -> _DDCarry:
+        """Streaming admission at the phase open: clear the recycled
+        slots' per-chip partials, push this chip's admitted-seed dense
+        prefix onto the local queue top (the store slack covers the
+        window — _dd_sizing), and fold the capacity predicate into the
+        replicated overflow flag like every collective guard here."""
+        acc2 = jnp.where(clear, 0.0, c.acc)
+        bl = lax.dynamic_update_slice(c.bag_l, adm_l, (c.count,))
+        br = lax.dynamic_update_slice(c.bag_r, adm_r, (c.count,))
+        bth = lax.dynamic_update_slice(c.bag_th, adm_th, (c.count,))
+        bm = lax.dynamic_update_slice(c.bag_meta, adm_meta, (c.count,))
+        cnt = c.count + n_adm
+        local_ovf = cnt > jnp.asarray(capacity, jnp.int32)
+        any_ovf = lax.psum(local_ovf.astype(jnp.int32), axis) > 0
+        return c._replace(bag_l=bl, bag_r=br, bag_th=bth, bag_meta=bm,
+                          count=cnt,
+                          overflow=jnp.logical_or(c.overflow, any_ovf))
+
+    def _fam_live_local(c: _DDCarry) -> jnp.ndarray:
+        """(m,) local live-row counts per family — the streaming
+        retirement mask is the mesh-wide sum hitting zero. Shares the
+        single-chip stream's primitive so the done-mask convention
+        cannot diverge between the engines."""
+        from ppls_tpu.parallel.walker import family_live_counts_cols
+        return family_live_counts_cols(c.bag_meta, c.count, m)
+
     def shard_body(bag_l, bag_r, bag_th, bag_meta, count, acc, tasks,
                    splits, btasks, wtasks, wsplits, roots, rounds, segs,
-                   wsteps, srows, crounds, maxd, cycles, overflow):
+                   wsteps, srows, crounds, maxd, cycles, overflow,
+                   *admit_args):
         c = _DDCarry(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
                      bag_meta=bag_meta, count=count[0], acc=acc[0],
                      tasks=tasks[0], splits=splits[0], btasks=btasks[0],
@@ -415,17 +469,26 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                      rounds=rounds[0], segs=segs[0], wsteps=wsteps[0],
                      srows=srows[0], crounds=crounds[0],
                      maxd=maxd[0], cycles=cycles[0], overflow=overflow[0])
+        if admit_window:
+            adm_l, adm_r, adm_th, adm_meta, adm_n, adm_clear = admit_args
+            c = _admit_local(c, adm_l, adm_r, adm_th, adm_meta,
+                             adm_n[0], adm_clear[0])
         out = lax.while_loop(cycle_cond, cycle_body, c)
-        return (out.bag_l, out.bag_r, out.bag_th, out.bag_meta,
-                out.count[None], out.acc[None], out.tasks[None],
-                out.splits[None], out.btasks[None], out.wtasks[None],
-                out.wsplits[None], out.roots[None], out.rounds[None],
-                out.segs[None], out.wsteps[None], out.srows[None],
-                out.crounds[None],
-                out.maxd[None], out.cycles[None], out.overflow[None])
+        res = (out.bag_l, out.bag_r, out.bag_th, out.bag_meta,
+               out.count[None], out.acc[None], out.tasks[None],
+               out.splits[None], out.btasks[None], out.wtasks[None],
+               out.wsplits[None], out.roots[None], out.rounds[None],
+               out.segs[None], out.wsteps[None], out.srows[None],
+               out.crounds[None],
+               out.maxd[None], out.cycles[None], out.overflow[None])
+        if admit_window:
+            res = res + (_fam_live_local(out)[None],)
+        return res
 
     sh = P(axis)
     n_state = 20
+    n_in = n_state + (6 if admit_window else 0)
+    n_out = n_state + (1 if admit_window else 0)
     # check_vma=False: the Pallas segment kernel's out_shape carries no
     # varying-manual-axes annotation, so the static VMA checker cannot
     # type it (every carried value here is per-chip varying anyway; the
@@ -433,7 +496,7 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
     # same without the checker).
     return jax.jit(shard_map_compat(
         shard_body, mesh=mesh, check_vma=False,
-        in_specs=(sh,) * n_state, out_specs=(sh,) * n_state))
+        in_specs=(sh,) * n_in, out_specs=(sh,) * n_out))
 
 
 def _dd_sizing(lanes: int, capacity: int, chunk: int,

@@ -1431,6 +1431,86 @@ def _expand_pending(c: _WalkCarry, capacity: int, m: int,
     )
 
 
+class _CycleOut(NamedTuple):
+    """One cycle's intermediate states, shared by :func:`_run_cycles`'
+    loop body and the streaming engine's per-phase program
+    (:func:`run_stream_cycle`)."""
+
+    bred: BagState          # post-breed/sort queue (acc = breed credit)
+    walk: _WalkCarry        # post-walk carry (acc = walker credit)
+    bag3: BagState          # post-expand/drain bag (acc = drain credit)
+    bag2_count: jnp.ndarray  # i32 — remainder count before the drain gate
+    roots_taken: jnp.ndarray  # i64 — roots consumed by the walker
+    srows: jnp.ndarray      # i64 — live rows err-scored by the root sort
+
+
+def _cycle_once(bag: BagState, *, f_theta: Callable, f_ds: Callable,
+                eps: float, m: int, seg_iters: int, max_segments: int,
+                min_active_frac: float, exit_frac: float,
+                suspend_frac: float, interpret: bool, lanes: int,
+                capacity: int, breed_chunk: int, target: int,
+                rule: Rule, sort_roots: bool, refill_slots: int,
+                sort_skip_ratio: float, gsegs0, seg_stats0) -> _CycleOut:
+    """ONE engine cycle — breed (graduated f64 BFS) -> work-sort ->
+    walk (Pallas, in-kernel refill when ``refill_slots`` > 0) ->
+    expand -> gated drain — factored out of :func:`_run_cycles` so the
+    streaming engine (``runtime/stream.py``) can drive the identical
+    per-phase computation one cycle at a time with admission/retirement
+    at the host boundary between calls."""
+    # Graduated breed: a BFS round costs O(chunk) emulated-f64
+    # integrand evals and an O(chunk log chunk) sort REGARDLESS of
+    # the live frontier (masked lanes still evaluate), so grow the
+    # frontier through rising chunk widths — each phase's waste is
+    # bounded ~2x instead of the 2^19-wide rounds evaluating 97%
+    # dead lanes while the frontier was 16k.
+    bred = bag
+    for pc in (1 << 14, 1 << 16, 1 << 18):
+        if pc < breed_chunk:
+            bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=pc,
+                          capacity=capacity,
+                          target=min(pc // 2, target), rule=rule)
+    bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=breed_chunk,
+                  capacity=capacity, target=target, rule=rule)
+    if sort_roots:
+        bred, srows_d = _order_roots_by_work(
+            bred, f_theta=f_theta, eps=eps, rule=rule,
+            window=2 * breed_chunk, skip_ratio=sort_skip_ratio)
+        srows_d = srows_d.astype(jnp.int64)
+    else:
+        srows_d = jnp.zeros((), jnp.int64)
+    wkw = dict(f_ds=f_ds, eps=eps, m=m, seg_iters=seg_iters,
+               max_segments=max_segments,
+               min_active_frac=min_active_frac,
+               exit_frac=exit_frac, suspend_frac=suspend_frac,
+               interpret=interpret, lanes=lanes,
+               gsegs0=gsegs0, seg_stats0=seg_stats0, rule=rule)
+    if refill_slots:
+        walk, kx = _run_walk_kernel_refill(
+            bred, refill_slots=refill_slots, **wkw)
+        roots_taken = kx.taken.astype(jnp.int64)
+    else:
+        walk = _run_walk(bred, **wkw)
+        kx = None
+        roots_taken = walk.cursor.astype(jnp.int64)
+    bag2 = _expand_pending(walk, capacity, m, kx)
+
+    # Drain in f64 ONLY below the walker's own engagement threshold
+    # (walk's cond would refuse to run there, so the cycle loop could
+    # not make progress); see _run_cycles' drain note for the
+    # stop_count=target rationale.
+    def drain(b: BagState):
+        return _run_bag(b, f_theta=f_theta, eps=eps,
+                        rule=rule, chunk=breed_chunk,
+                        capacity=capacity, max_iters=1 << 20,
+                        stop_count=target)
+
+    min_active = max(1, int(lanes * min_active_frac))
+    bag3 = lax.cond(bag2.count < min_active, drain, lambda b: b, bag2)
+    return _CycleOut(bred=bred, walk=walk, bag3=bag3,
+                     bag2_count=bag2.count, roots_taken=roots_taken,
+                     srows=srows_d)
+
+
 class _CycleCarry(NamedTuple):
     bag: BagState
     acc: jnp.ndarray        # (m,) f64 accumulated areas (all phases)
@@ -1491,70 +1571,26 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             jnp.logical_not(c.overflow))
 
     def body(c: _CycleCarry):
-        # Graduated breed: a BFS round costs O(chunk) emulated-f64
-        # integrand evals and an O(chunk log chunk) sort REGARDLESS of
-        # the live frontier (masked lanes still evaluate), so grow the
-        # frontier through rising chunk widths — each phase's waste is
-        # bounded ~2x instead of the 2^19-wide rounds evaluating 97%
-        # dead lanes while the frontier was 16k (measured 97 ms/cycle
-        # breeding before; ~2.5x less after).
-        bred = c.bag
-        for pc in (1 << 14, 1 << 16, 1 << 18):
-            if pc < breed_chunk:
-                bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=pc,
-                              capacity=capacity,
-                              target=min(pc // 2, target), rule=rule)
-        bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=breed_chunk,
-                      capacity=capacity, target=target, rule=rule)
-        if sort_roots:
-            bred, srows_d = _order_roots_by_work(
-                bred, f_theta=f_theta, eps=eps, rule=rule,
-                window=2 * breed_chunk, skip_ratio=sort_skip_ratio)
-            srows_d = srows_d.astype(jnp.int64)
-        else:
-            srows_d = jnp.zeros((), jnp.int64)
-        wkw = dict(f_ds=f_ds, eps=eps, m=m, seg_iters=seg_iters,
-                   max_segments=max_segments,
-                   min_active_frac=min_active_frac,
-                   exit_frac=exit_frac, suspend_frac=suspend_frac,
-                   interpret=interpret, lanes=lanes,
-                   gsegs0=c.segs.astype(jnp.int32),
-                   seg_stats0=c.seg_stats, rule=rule)
-        if refill_slots:
-            walk, kx = _run_walk_kernel_refill(
-                bred, refill_slots=refill_slots, **wkw)
-            roots_taken = kx.taken.astype(jnp.int64)
-        else:
-            walk = _run_walk(bred, **wkw)
-            kx = None
-            roots_taken = walk.cursor.astype(jnp.int64)
-        bag2 = _expand_pending(walk, capacity, m, kx)
-
-        # Drain in f64 ONLY below the walker's own engagement threshold
-        # (walk's cond would refuse to run there, so the cycle loop could
-        # not make progress). Anything larger goes back around: re-bred
-        # into fresh roots and re-walked. A `count < lanes` gate here
-        # measured fraction 0.31 on the flagship workload — a small
-        # *count* of suspended deep-tail nodes carries most of the
-        # remaining *work* (115 M of 166 M tasks drained in f64).
-        #
-        # stop_count=target (VERDICT r4 #9): a "small remainder" can be
-        # the tip of a huge subtree — e.g. a family mix whose BFS
-        # frontier collapses below min_active mid-breed (_breed's
-        # peak-stop fires on the dip) while one deep member has barely
-        # started. Draining to EMPTY would then run that member's whole
-        # tree in f64 (a silent bag run). Stopping the drain once the
-        # frontier regrows past the root target hands it back to the
-        # next cycle's breed -> walk at full occupancy; genuinely tiny
-        # tails still drain to empty exactly as before.
-        def drain(b: BagState):
-            return _run_bag(b, f_theta=f_theta, eps=eps,
-                            rule=rule, chunk=breed_chunk,
-                            capacity=capacity, max_iters=1 << 20,
-                            stop_count=target)
-
-        min_active = max(1, int(lanes * min_active_frac))
-        bag3 = lax.cond(bag2.count < min_active, drain, lambda b: b, bag2)
+        # One cycle (breed -> sort -> walk -> expand -> drain) via the
+        # shared single-cycle helper — the identical per-phase program
+        # the streaming engine drives one call at a time. The drain's
+        # stop_count=target rationale (VERDICT r4 #9): a "small
+        # remainder" can be the tip of a huge subtree; draining to
+        # EMPTY would run that member's whole tree in f64 (a silent bag
+        # run), so the drain stops once the frontier regrows past the
+        # root target and hands it back to the next cycle's
+        # breed -> walk at full occupancy.
+        o = _cycle_once(
+            c.bag, f_theta=f_theta, f_ds=f_ds, eps=eps, m=m,
+            seg_iters=seg_iters, max_segments=max_segments,
+            min_active_frac=min_active_frac, exit_frac=exit_frac,
+            suspend_frac=suspend_frac, interpret=interpret, lanes=lanes,
+            capacity=capacity, breed_chunk=breed_chunk, target=target,
+            rule=rule, sort_roots=sort_roots, refill_slots=refill_slots,
+            sort_skip_ratio=sort_skip_ratio,
+            gsegs0=c.segs.astype(jnp.int32), seg_stats0=c.seg_stats)
+        bred, walk, bag3 = o.bred, o.walk, o.bag3
+        roots_taken, srows_d = o.roots_taken, o.srows
 
         wt = jnp.sum(walk.lanes.tasks.astype(jnp.int64))
         ws = jnp.sum(walk.lanes.splits.astype(jnp.int64))
@@ -1564,7 +1600,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             bred.count.astype(jnp.int64), bred.iters,
             roots_taken, wt,
             walk.steps.astype(jnp.int64), walk.segs.astype(jnp.int64),
-            bag2.count.astype(jnp.int64), bag3.tasks, srows_d])
+            o.bag2_count.astype(jnp.int64), bag3.tasks, srows_d])
         cyc_stats = lax.dynamic_update_slice(
             c.cyc_stats, cyc_row[None, :],
             (jnp.minimum(c.cycles, C_CAP - 1), jnp.int32(0)))
@@ -1612,6 +1648,167 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         cyc_stats=jnp.zeros((C_CAP, len(CYCLE_STAT_FIELDS)), jnp.int64),
     )
     return lax.while_loop(cond, body, init)
+
+
+# ---------------------------------------------------------------------------
+# Streaming hooks (runtime/stream.py): the continuous-batching engine
+# drives the SAME per-cycle computation as _run_cycles, one phase per
+# call, with request admission/retirement at the host boundary between
+# calls. Per-phase row layout of the device-counted stream stats:
+STREAM_STAT_FIELDS = ("tasks", "btasks", "wtasks", "wsplits", "roots",
+                      "rounds", "segs", "wsteps", "srows", "maxd",
+                      "live_tasks", "live_families")
+
+
+def family_live_counts_cols(bag_meta: jnp.ndarray, count, m: int
+                            ) -> jnp.ndarray:
+    """(m,) int32 — live rows per family over raw (meta, count) bag
+    columns. THE retirement-mask primitive, shared by the single-chip
+    stream cycle (via :func:`family_live_counts`) and the dd stream's
+    per-chip shard body (``sharded_walker``) so the done-mask
+    convention (position mask, id clip, exact unit-weight segment sum)
+    can never diverge between the engines."""
+    n = bag_meta.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    live = pos < count
+    ids = jnp.clip(jnp.where(live, bag_meta >> DEPTH_BITS, 0), 0, m - 1)
+    return segment_sum_auto(ids, live.astype(jnp.float64), m,
+                            n).astype(jnp.int32)
+
+
+def family_live_counts(bag: BagState, m: int) -> jnp.ndarray:
+    """(m,) int32 — live bag rows per family: the streaming engine's
+    per-family DONE MASK is ``family_live_counts(bag, m) == 0`` (a
+    retired family has no pending tasks anywhere: lane state was folded
+    back into the bag by expand-pending at the cycle edge, so the bag
+    prefix is the complete pending set). Device-counted: one exact
+    segment-sum of unit weights over the store."""
+    return family_live_counts_cols(bag.bag_meta, bag.count, m)
+
+
+class StreamCycleOut(NamedTuple):
+    """One streaming phase's outputs (all device arrays)."""
+
+    bag: BagState            # next phase's input (counters zeroed)
+    acc: jnp.ndarray         # (m,) f64 running per-family areas
+    acc_c: jnp.ndarray       # (m,) f64 Neumaier compensation of acc
+    fam_live: jnp.ndarray    # (m,) i32 live rows per family (0 = done)
+    fam_last: jnp.ndarray    # (m,) i32 last phase credited (-1 = never)
+    stats: jnp.ndarray       # (len(STREAM_STAT_FIELDS),) i64 phase row
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("f_theta", "f_ds", "eps", "m", "seg_iters",
+                     "max_segments", "min_active_frac", "exit_frac",
+                     "suspend_frac", "interpret", "lanes", "capacity",
+                     "breed_chunk", "target", "rule", "sort_roots",
+                     "refill_slots", "sort_skip_ratio", "f64_rounds"))
+def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
+                     f_theta: Callable, f_ds: Callable, eps: float,
+                     m: int, seg_iters: int, max_segments: int,
+                     min_active_frac: float, exit_frac: float,
+                     suspend_frac: float, interpret: bool, lanes: int,
+                     capacity: int, breed_chunk: int, target: int,
+                     rule: Rule = Rule.TRAPEZOID,
+                     sort_roots: bool = True, refill_slots: int = 0,
+                     sort_skip_ratio: float = 8.0,
+                     f64_rounds: int = 0) -> StreamCycleOut:
+    """ONE phase of the streaming walker: the identical
+    breed -> sort -> walk -> expand -> drain cycle of :func:`_run_cycles`
+    (via the shared :func:`_cycle_once`), plus the streaming surface —
+    per-family live counts (the done mask), a monotonic last-credited
+    phase counter, and a device-counted per-phase stats row
+    (``STREAM_STAT_FIELDS``).
+
+    The per-family accumulator is Neumaier-compensated across phases:
+    each phase's credit is a sum of EXACT segment sums, but the
+    partition of a family's leaves into phases depends on the admission
+    schedule — plain f64 ``+=`` would make the final area depend on
+    when co-resident requests arrived. The compensated pair keeps the
+    running sum exact to ~2^-106, so the batch-vs-streamed determinism
+    contract (tests/test_stream.py) holds at the f64 bit level.
+
+    ``phase`` is the driver's monotonically increasing phase index
+    (traced, so one compiled program serves the whole stream).
+
+    With ``f64_rounds`` = K > 0 the phase body is instead K chunked-
+    LIFO f64 bag rounds (no Pallas at all): the pure-f64 streaming
+    mode. Every split decision and leaf value is then pointwise f64 —
+    independent of which co-resident requests shared the chunk — so
+    per-request areas do not depend on the admission schedule beyond
+    summation grouping, which the compensated accumulator absorbs
+    (and absorbs EXACTLY on workloads whose leaf values are dyadic;
+    tests/test_stream.py pins the bit-identity contract there). It is
+    also the no-Pallas fallback for hosts where the kernel cannot run.
+    """
+    if f64_rounds:
+        bag3 = _run_bag(bag, jnp.asarray(f64_rounds, jnp.int64),
+                        f_theta=f_theta, eps=eps, rule=rule,
+                        chunk=breed_chunk, capacity=capacity,
+                        max_iters=1 << 20)
+        credit = bag3.acc
+        z64 = jnp.zeros((), jnp.int64)
+        wt, ws, roots_taken, srows = z64, z64, z64, z64
+        segs, wsteps = z64, z64
+        bag_tasks = bag3.tasks
+        rounds = bag3.iters
+        maxd = bag3.max_depth
+        overflow = bag3.overflow
+    else:
+        o = _cycle_once(
+            bag, f_theta=f_theta, f_ds=f_ds, eps=eps, m=m,
+            seg_iters=seg_iters, max_segments=max_segments,
+            min_active_frac=min_active_frac, exit_frac=exit_frac,
+            suspend_frac=suspend_frac, interpret=interpret,
+            lanes=lanes, capacity=capacity, breed_chunk=breed_chunk,
+            target=target, rule=rule, sort_roots=sort_roots,
+            refill_slots=refill_slots,
+            sort_skip_ratio=sort_skip_ratio,
+            gsegs0=jnp.int32(0),
+            seg_stats0=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)),
+                                 jnp.int32))
+        bred, walk, bag3 = o.bred, o.walk, o.bag3
+        # this phase's exact per-family credit, folded into the running
+        # compensated accumulator (never reassociated across phases)
+        credit = bred.acc + walk.acc + bag3.acc
+        wt = jnp.sum(walk.lanes.tasks.astype(jnp.int64))
+        ws = jnp.sum(walk.lanes.splits.astype(jnp.int64))
+        roots_taken, srows = o.roots_taken, o.srows
+        segs = walk.segs.astype(jnp.int64)
+        wsteps = walk.steps.astype(jnp.int64)
+        bag_tasks = bred.tasks + bag3.tasks
+        rounds = bred.iters + bag3.iters
+        maxd = jnp.maximum(jnp.maximum(bred.max_depth, bag3.max_depth),
+                           jnp.max(walk.lanes.maxd))
+        overflow = jnp.logical_or(bred.overflow, bag3.overflow)
+    t = acc + credit
+    big = jnp.abs(acc) >= jnp.abs(credit)
+    err = jnp.where(big, (acc - t) + credit, (credit - t) + acc)
+    acc2, acc_c2 = t, acc_c + err
+
+    fam_live = family_live_counts(bag3, m)
+    phase = jnp.asarray(phase, jnp.int32)
+    fam_last2 = jnp.where(credit != 0.0, phase, fam_last)
+
+    stats = jnp.stack([
+        bag_tasks + wt, bag_tasks, wt, ws, roots_taken,
+        rounds, segs, wsteps, srows,
+        maxd.astype(jnp.int64),
+        bag3.count.astype(jnp.int64),
+        jnp.sum((fam_live > 0).astype(jnp.int64)),
+    ])
+    next_bag = bag3._replace(
+        acc=jnp.zeros_like(bag3.acc),
+        tasks=jnp.zeros((), jnp.int64),
+        splits=jnp.zeros((), jnp.int64),
+        iters=jnp.zeros((), jnp.int64),
+        max_depth=jnp.zeros((), jnp.int32),
+        overflow=overflow,
+    )
+    return StreamCycleOut(bag=next_bag, acc=acc2, acc_c=acc_c2,
+                          fam_live=fam_live, fam_last=fam_last2,
+                          stats=stats)
 
 
 def walker_sizing(lanes: int, roots_per_lane: int, capacity: int,
@@ -1692,6 +1889,9 @@ class WalkerResult:
     #                              collective_rounds / cycles is the
     #                              per-phase collective count the dd
     #                              refill mode is judged by
+    # (The streaming engine's per-family done-mask / phase-counter
+    # surface lives on runtime.stream.StreamResult, fed by this
+    # module's run_stream_cycle / family_live_counts hooks.)
 
     @property
     def collective_rounds_per_cycle(self) -> float:

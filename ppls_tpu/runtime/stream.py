@@ -1,0 +1,880 @@
+"""Continuous-batching streaming walker: phase-boundary admission and
+retirement of concurrent integration requests.
+
+The reference farmer never idles a worker while the bag is non-empty
+(``aquadPartA.c:156-165``) — but the batch engines still run one
+request set to completion: every ``integrate_family_walker(_dd)`` call
+pays full seed/compile/drain cost, and lanes idle through the drain
+tail while new work waits at the host. This module is the
+iteration-level scheduler that removes the *between-runs* cliff — the
+same shape as continuous batching in LLM inference serving (Orca-style
+iteration-level scheduling): requests are ADMITTED into free family
+slots at natural phase boundaries instead of waiting for the whole
+batch to finish, and finished requests RETIRE individually with their
+exact segment-summed area.
+
+Architecture (one phase = one engine cycle of the walker):
+
+* a host-side REQUEST QUEUE holds pending requests (one request = one
+  integral: integrand parameter theta + bounds; eps/rule are engine
+  configuration because they are static arguments of the compiled
+  cycle program);
+* a fixed pool of ``slots`` FAMILY SLOTS indexes the per-family
+  accumulator; a free-list recycles slot ids as requests retire. The
+  per-task theta is a bag column, so a slot is purely an accumulator
+  index — admission is one contiguous seed-row push onto the bag top
+  plus an accumulator/counter clear for the recycled ids (the
+  "family-slot recycle in the phase-end credit path" hook);
+* each phase runs ONE cycle of the walker device program
+  (``walker.run_stream_cycle`` — the identical
+  breed -> sort -> walk -> expand -> drain body as
+  ``integrate_family_walker``, in-kernel refill included), which also
+  returns the per-family DONE MASK (``walker.family_live_counts`` ==
+  0), a monotonic last-credited phase counter, and a device-counted
+  per-phase stats row;
+* retirement: a slot whose live count hits zero has its whole pending
+  set completed (lane state folds back into the bag at every cycle
+  edge), so its running area — Neumaier-compensated across phases so
+  the result does not depend on how the admission schedule partitioned
+  its leaves into phases — is final and exact; the result is emitted
+  and the slot returns to the free list;
+* the engine never idles below the walk-engagement floor while offered
+  load remains: admission happens BEFORE the cycle, so newly admitted
+  seeds breed and deal into the vacated root-queue slack in the same
+  phase.
+
+Checkpointing: ``snapshot()`` atomically writes queue + walker state
+(live bag prefix, compensated accumulator pair, slot table, pending
+queue, per-request latency bookkeeping) through the standard
+``runtime.checkpoint`` container; ``StreamEngine.resume`` restores it
+and the continued stream replays the identical per-phase computation
+(same bit-identity contract as the batch walkers' leg resume).
+
+The multi-chip variant (``engine="walker-dd"``) drives the
+demand-driven sharded walker one cycle per phase; admission is folded
+into ``mesh.phase_reshard``'s occupancy decision (rebalance / admit /
+terminate) so admitted seeds join the same depth-stratified cross-chip
+deal the phase boundary already pays (``sharded_walker.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ppls_tpu.config import Rule
+from ppls_tpu.parallel.bag_engine import DEPTH_BITS, BagState
+from ppls_tpu.parallel.walker import (
+    DEFAULT_LANES,
+    STREAM_STAT_FIELDS,
+    run_stream_cycle,
+    walker_sizing,
+)
+
+
+@dataclasses.dataclass
+class StreamRequest:
+    """One pending integration request (one 1D integral)."""
+
+    rid: int
+    theta: float
+    bounds: Tuple[float, float]
+    submit_phase: int
+    submit_t: float
+
+
+@dataclasses.dataclass
+class CompletedRequest:
+    """A retired request: exact area + latency accounting.
+
+    ``phases_in_flight`` counts device phases from admission through
+    retirement inclusive; ``latency_phases`` additionally includes
+    queue wait (submit -> retire). ``last_credited_phase`` is the
+    device-counted monotonic counter from the cycle program (-1 for a
+    zero-area integral that never credited).
+    """
+
+    rid: int
+    theta: float
+    bounds: Tuple[float, float]
+    area: float
+    submit_phase: int
+    admit_phase: int
+    retire_phase: int
+    latency_s: float
+    first_seeded_phase: int
+    last_credited_phase: int
+
+    @property
+    def phases_in_flight(self) -> int:
+        return self.retire_phase - self.admit_phase + 1
+
+    @property
+    def latency_phases(self) -> int:
+        return self.retire_phase - self.submit_phase + 1
+
+
+@dataclasses.dataclass
+class StreamResult:
+    """Aggregate result of a finished stream (``StreamEngine.run``)."""
+
+    completed: List[CompletedRequest]
+    phases: int
+    wall_s: float
+    totals: dict                 # summed STREAM_STAT_FIELDS rows
+    phase_stats: np.ndarray      # (phases, len(STREAM_STAT_FIELDS)) i64
+    # per-slot streaming surface (device-counted; the walker hooks):
+    fam_done: Optional[np.ndarray] = None         # (slots,) bool
+    fam_first_phase: Optional[np.ndarray] = None  # (slots,) i32, -1=never
+    fam_last_phase: Optional[np.ndarray] = None   # (slots,) i32, -1=never
+
+    @property
+    def areas(self) -> np.ndarray:
+        """Areas in request-id order (the deterministic comparison
+        surface for the batch-vs-streamed tests)."""
+        done = sorted(self.completed, key=lambda c: c.rid)
+        return np.array([c.area for c in done])
+
+    @property
+    def requests_per_sec(self) -> float:
+        return len(self.completed) / self.wall_s if self.wall_s else 0.0
+
+    def latency_percentiles(self) -> dict:
+        """p50/p99 request latency in phases and seconds (the bench's
+        latency definition: submit -> retire, queue wait included)."""
+        if not self.completed:
+            return {}
+        ph = np.array([c.latency_phases for c in self.completed])
+        se = np.array([c.latency_s for c in self.completed])
+        return {
+            "p50_phases": float(np.percentile(ph, 50)),
+            "p99_phases": float(np.percentile(ph, 99)),
+            "p50_s": float(np.percentile(se, 50)),
+            "p99_s": float(np.percentile(se, 99)),
+        }
+
+    def occupancy_summary(self, lanes: int) -> dict:
+        """Steady-state occupancy from the device-counted phase rows."""
+        t = self.totals
+        wsteps = int(t.get("wsteps", 0))
+        out = {
+            "lane_efficiency": (int(t["wtasks"]) / (wsteps * lanes)
+                                if wsteps else 0.0),
+            "walker_fraction": (int(t["wtasks"]) / int(t["tasks"])
+                                if t.get("tasks") else 0.0),
+        }
+        ps = self.phase_stats
+        if ps is not None and len(ps):
+            j = STREAM_STAT_FIELDS.index("live_families")
+            k = STREAM_STAT_FIELDS.index("live_tasks")
+            out["mean_live_families"] = float(ps[:, j].mean())
+            out["mean_live_tasks"] = float(ps[:, k].mean())
+        return out
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def _admit_program(bag: BagState, acc, acc_c, fam_last,
+                   seeds_l, seeds_r, seeds_th, seeds_meta, n_new,
+                   clear, *, capacity: int):
+    """Push ``n_new`` seed rows (dense prefix of the fixed-width seed
+    arrays; pad rows carry benign in-domain fill) onto the bag top and
+    clear the recycled slots' accumulator/counter state. One compiled
+    program per admit-window width."""
+    start = bag.count
+    bag_l = lax.dynamic_update_slice(bag.bag_l, seeds_l, (start,))
+    bag_r = lax.dynamic_update_slice(bag.bag_r, seeds_r, (start,))
+    bag_th = lax.dynamic_update_slice(bag.bag_th, seeds_th, (start,))
+    bag_meta = lax.dynamic_update_slice(bag.bag_meta, seeds_meta,
+                                        (start,))
+    count = start + n_new
+    overflow = jnp.logical_or(
+        bag.overflow, count > jnp.asarray(capacity, jnp.int32))
+    return (bag._replace(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
+                         bag_meta=bag_meta, count=count,
+                         overflow=overflow),
+            jnp.where(clear, 0.0, acc),
+            jnp.where(clear, 0.0, acc_c),
+            jnp.where(clear, jnp.int32(-1), fam_last))
+
+
+def _stream_identity(engine: str, family: str, eps: float, rule: Rule,
+                     slots: int, lanes: int, chunk: int, capacity: int,
+                     roots_per_lane: int, refill_slots: int,
+                     n_dev: int = 1) -> dict:
+    from ppls_tpu.runtime.checkpoint import engine_name
+    return {"engine": engine_name(engine, rule), "fname": family,
+            "eps": float(eps), "m": int(slots), "lanes": int(lanes),
+            "chunk": int(chunk), "capacity": int(capacity),
+            "roots_per_lane": int(roots_per_lane),
+            "refill_slots": int(refill_slots), "n_dev": int(n_dev)}
+
+
+class StreamEngine:
+    """Long-lived streaming integration service over the walker.
+
+    ``family`` is the integrand registry name (both the f64 integrand
+    and its ds twin resolve from it). ``eps``/``rule`` are per-engine,
+    not per-request: they are static arguments of the compiled cycle
+    program, so a mixed-eps workload runs one engine per (eps, rule)
+    group. ``slots`` bounds the number of CONCURRENTLY RESIDENT
+    requests (the family-slot pool); the pending queue is unbounded.
+
+    Typical driving loop::
+
+        eng = StreamEngine("sin_recip_scaled", eps=1e-8, slots=32, ...)
+        eng.submit(theta=1.25, bounds=(1e-3, 1.0))
+        ...
+        done = eng.step()        # one phase: admit -> cycle -> retire
+        result = eng.drain()     # run phases until everything retires
+
+    or the one-shot ``run(requests, arrival_phase=...)`` used by the
+    bench and the ``serve`` CLI's synthetic mode.
+    """
+
+    def __init__(self, family: str, eps: float,
+                 rule: Rule = Rule.TRAPEZOID,
+                 slots: int = 64,
+                 chunk: int = 1 << 13,
+                 capacity: int = 1 << 20,
+                 lanes: int = DEFAULT_LANES,
+                 roots_per_lane: int = 12,
+                 refill_slots: int = 8,
+                 seg_iters: int = 2048,
+                 max_segments: int = 1 << 18,
+                 min_active_frac: float = 0.1,
+                 exit_frac: float = 0.80,
+                 suspend_frac: float = 0.5,
+                 sort_roots: bool = True,
+                 sort_skip_ratio: float = 8.0,
+                 f64_rounds: int = 0,
+                 admit_window: Optional[int] = None,
+                 interpret: Optional[bool] = None,
+                 engine: str = "walker",
+                 mesh=None, n_devices: Optional[int] = None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 8):
+        from ppls_tpu.models.integrands import get_family, get_family_ds
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        if lanes % 128:
+            raise ValueError(
+                f"lanes must be a multiple of 128, got {lanes}")
+        if refill_slots < 0 or refill_slots > roots_per_lane:
+            raise ValueError(
+                f"refill_slots must be in [0, roots_per_lane="
+                f"{roots_per_lane}], got {refill_slots}")
+        if engine not in ("walker", "walker-dd"):
+            raise ValueError(f"unknown stream engine {engine!r}")
+        self.family = family
+        self.f_theta = get_family(family)
+        self.f_ds = get_family_ds(family)
+        self.eps = float(eps)
+        self.rule = Rule(rule)
+        self.slots = int(slots)
+        self.engine = engine
+        self.lanes = int(lanes)
+        self.interpret = bool(interpret)
+        target, breed_chunk, slack_chunk = walker_sizing(
+            lanes, roots_per_lane, capacity, chunk)
+        self._store = capacity + 2 * slack_chunk
+        self._capacity = int(capacity)
+        self._chunk = int(chunk)
+        self._roots_per_lane = int(roots_per_lane)
+        self._refill_slots = int(refill_slots)
+        self._cycle_kw = dict(
+            f_theta=self.f_theta, f_ds=self.f_ds, eps=self.eps,
+            m=self.slots, seg_iters=int(seg_iters),
+            max_segments=int(max_segments),
+            min_active_frac=float(min_active_frac),
+            exit_frac=float(exit_frac),
+            suspend_frac=float(suspend_frac),
+            interpret=self.interpret, lanes=self.lanes,
+            capacity=int(capacity), breed_chunk=int(breed_chunk),
+            target=int(target), rule=self.rule,
+            sort_roots=bool(sort_roots),
+            refill_slots=int(refill_slots),
+            sort_skip_ratio=float(sort_skip_ratio),
+            f64_rounds=int(f64_rounds))
+        # admit window: fixed seed-array width (one compiled admit
+        # program); capped by the store slack so the push never clamps
+        aw = slots if admit_window is None else int(admit_window)
+        self._admit_window = max(1, min(aw, 2 * slack_chunk))
+
+        # host bookkeeping
+        self._pending: List[StreamRequest] = []
+        self._free = list(range(self.slots))
+        self._slot_req = {}          # slot -> StreamRequest + admit info
+        self._records = {}           # rid -> dict(admit_phase, ...)
+        self.completed: List[CompletedRequest] = []
+        self._next_rid = 0
+        self.phase = 0
+        self._count = 0              # live bag rows after the last phase
+        self._phase_rows: List[np.ndarray] = []
+        self._fam_first = np.full(self.slots, -1, dtype=np.int32)
+        self._last_fam_live = np.zeros(self.slots, dtype=np.int32)
+        self._last_fam_last = np.full(self.slots, -1, dtype=np.int32)
+
+        # device state (built lazily on the first admission so the
+        # dead-slot fill can be an in-domain point of a real request)
+        self._dev = None
+        self._fill = None            # (fill_x, fill_th)
+
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = max(int(checkpoint_every), 1)
+        if engine == "walker-dd":
+            from ppls_tpu.parallel.mesh import make_mesh
+            if refill_slots <= 0:
+                raise ValueError(
+                    "walker-dd streaming requires refill_slots > 0 "
+                    "(admission rides the refill mode's phase reshard)")
+            if checkpoint_path:
+                # fail at construction, not mid-serve at the first
+                # snapshot boundary after real work has accumulated
+                raise NotImplementedError(
+                    "stream snapshots cover the single-chip engine; "
+                    "run the dd stream without a checkpoint path (its "
+                    "per-chip state snapshot is future work)")
+            self._mesh = mesh if mesh is not None else make_mesh(
+                n_devices)
+            self._dd = None          # built lazily with the fill point
+        else:
+            self._mesh = None
+
+    # ------------------------------------------------------------------
+    # identity / snapshot
+    # ------------------------------------------------------------------
+
+    def _identity(self) -> dict:
+        n_dev = self._mesh.devices.size if self._mesh is not None else 1
+        return _stream_identity(
+            f"{self.engine}-stream", self.family, self.eps, self.rule,
+            self.slots, self.lanes, self._chunk, self._capacity,
+            self._roots_per_lane, self._refill_slots, n_dev)
+
+    # ------------------------------------------------------------------
+    # request intake
+    # ------------------------------------------------------------------
+
+    def submit(self, theta: float, bounds) -> int:
+        """Queue one integration request; returns its request id."""
+        from ppls_tpu.models.integrands import check_ds_domain
+        bounds = (float(bounds[0]), float(bounds[1]))
+        check_ds_domain(self.f_ds, np.array([bounds]),
+                        np.array([float(theta)]))
+        rid = self._next_rid
+        self._next_rid += 1
+        self._pending.append(StreamRequest(
+            rid=rid, theta=float(theta), bounds=bounds,
+            submit_phase=self.phase, submit_t=time.perf_counter()))
+        return rid
+
+    @property
+    def next_rid(self) -> int:
+        """Request ids are assigned in submission order — a resumed
+        driver replaying a deterministic request sequence skips the
+        first ``next_rid`` entries (already submitted pre-crash)."""
+        return self._next_rid
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    @property
+    def resident(self) -> int:
+        return len(self._slot_req)
+
+    @property
+    def idle(self) -> bool:
+        """Nothing queued, resident, or live on device."""
+        return not self._pending and not self._slot_req \
+            and self._count == 0
+
+    # ------------------------------------------------------------------
+    # device state
+    # ------------------------------------------------------------------
+
+    def _ensure_state(self, first: StreamRequest):
+        if self._dev is not None:
+            return
+        fill_x = 0.5 * (first.bounds[0] + first.bounds[1])
+        self._fill = (float(fill_x), float(first.theta))
+        self._build_store()
+
+    def _build_dd_store(self):
+        """Lazy build of the multi-chip streaming program + stores.
+
+        The compiled phase program is ``build_dd_walker_run`` with
+        ``max_cycles=1`` and ``admit_window`` > 0: one demand-driven
+        cycle per call, with admission folded into the phase boundary
+        (seeds enter each chip's local queue as the phase opens and
+        ride ``mesh.phase_reshard``'s occupancy decision + stratified
+        deal at its end) and per-chip family live counts returned for
+        retirement.
+        """
+        from ppls_tpu.parallel.sharded_walker import (_dd_sizing,
+                                                      build_dd_walker_run)
+        mesh = self._mesh
+        n_dev = mesh.devices.size
+        ck = self._cycle_kw
+        target_local, breed_chunk, store, reshard_window = _dd_sizing(
+            self.lanes, self._capacity, self._chunk,
+            self._roots_per_lane)
+        slack = store - self._capacity
+        aw = max(1, min(-(-self._admit_window // n_dev), slack))
+        self._dd_aw = aw
+        self._admit_window = min(self._admit_window, aw * n_dev)
+        fill_x, fill_th = self._fill
+        self._dd_run = build_dd_walker_run(
+            mesh, self.family, self.eps, int(breed_chunk),
+            self._capacity, self.slots, self.lanes,
+            ck["seg_iters"], ck["max_segments"],
+            ck["min_active_frac"], ck["exit_frac"], ck["suspend_frac"],
+            int(target_local), self.interpret, 1, fill_x, fill_th,
+            self.rule, ck["sort_roots"], ck["sort_skip_ratio"],
+            self._refill_slots, int(reshard_window), admit_window=aw)
+        self._dd_store = store
+        self._dd_n_dev = n_dev
+        z64 = jnp.zeros(n_dev, jnp.int64)
+        self._dd_state = (
+            jnp.full((n_dev * store,), fill_x, jnp.float64),
+            jnp.full((n_dev * store,), fill_x, jnp.float64),
+            jnp.full((n_dev * store,), fill_th, jnp.float64),
+            jnp.zeros((n_dev * store,), jnp.int32),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros((n_dev, self.slots), jnp.float64))
+        self._dd_counters = tuple(z64 for _ in range(11)) + (
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros(n_dev, dtype=bool))
+        self._dd_prev = np.zeros(11, dtype=np.int64)
+        self._dd_prev_acc = np.zeros(self.slots)
+        self._dd_fam_last = np.full(self.slots, -1, np.int32)
+        self._dd_rr = 0
+        self._dd_admit = None
+        self._dev = True        # marks state as built
+
+    def _build_store(self):
+        fill_x, fill_th = self._fill
+        if self.engine == "walker-dd":
+            self._build_dd_store()
+            return
+        store = self._store
+        bag = BagState(
+            bag_l=jnp.full(store, fill_x, jnp.float64),
+            bag_r=jnp.full(store, fill_x, jnp.float64),
+            bag_th=jnp.full(store, fill_th, jnp.float64),
+            bag_meta=jnp.zeros(store, jnp.int32),
+            count=jnp.asarray(0, jnp.int32),
+            acc=jnp.zeros(self.slots, jnp.float64),
+            tasks=jnp.zeros((), jnp.int64),
+            splits=jnp.zeros((), jnp.int64),
+            iters=jnp.zeros((), jnp.int64),
+            max_depth=jnp.zeros((), jnp.int32),
+            overflow=jnp.zeros((), bool))
+        self._dev = dict(
+            bag=bag,
+            acc=jnp.zeros(self.slots, jnp.float64),
+            acc_c=jnp.zeros(self.slots, jnp.float64),
+            fam_last=jnp.full(self.slots, -1, jnp.int32))
+
+    # ------------------------------------------------------------------
+    # the phase loop
+    # ------------------------------------------------------------------
+
+    def _admissible(self) -> int:
+        """How many pending requests fit this phase: free slots, the
+        admit window, and bag-capacity headroom for the seed rows."""
+        cap = self._capacity
+        if self.engine == "walker-dd" and self._mesh is not None:
+            cap *= self._mesh.devices.size      # per-chip capacity
+        room = cap - self._count
+        return max(0, min(len(self._pending), len(self._free),
+                          self._admit_window, room))
+
+    def _admit(self) -> List[StreamRequest]:
+        n_new = self._admissible()
+        if self._dev is None:
+            if not n_new:
+                return []
+            self._ensure_state(self._pending[0])
+        if not n_new and not self._clear_pending():
+            return []
+        A = self._admit_window
+        fill_x, fill_th = self._fill
+        sl = np.full(A, fill_x)
+        sr = np.full(A, fill_x)
+        sth = np.full(A, fill_th)
+        sm = np.zeros(A, dtype=np.int32)
+        clear = np.zeros(self.slots, dtype=bool)
+        admitted = []
+        for i in range(n_new):
+            req = self._pending.pop(0)
+            slot = self._free.pop(0)
+            sl[i], sr[i] = req.bounds
+            sth[i] = req.theta
+            sm[i] = np.int32(slot << DEPTH_BITS)
+            clear[slot] = True       # recycle: zero the slot's acc pair
+            self._slot_req[slot] = req
+            self._records[req.rid] = dict(
+                slot=slot, admit_phase=self.phase)
+            self._fam_first[slot] = self.phase
+            admitted.append(req)
+        self._apply_admit(sl, sr, sth, sm, n_new, clear)
+        self._count += n_new
+        return admitted
+
+    def _clear_pending(self) -> bool:
+        """Whether an admit call is needed even with zero admissions
+        (no deferred clears in this design — clears ride admissions)."""
+        return False
+
+    def _apply_admit(self, sl, sr, sth, sm, n_new, clear):
+        if self.engine == "walker-dd":
+            # stage per-chip dense-prefix blocks for the next phase
+            # call: the host deals requests round-robin over chips and
+            # the device program pushes each chip's block as the phase
+            # opens (build_dd_walker_run's admit_window path)
+            n_dev, aw = self._dd_n_dev, self._dd_aw
+            fill_x, fill_th = self._fill
+            bl = np.full((n_dev, aw), fill_x)
+            br = np.full((n_dev, aw), fill_x)
+            bth = np.full((n_dev, aw), fill_th)
+            bm = np.zeros((n_dev, aw), dtype=np.int32)
+            cnt = np.zeros(n_dev, dtype=np.int32)
+            for i in range(n_new):
+                chip = self._dd_rr % n_dev
+                self._dd_rr += 1
+                k = cnt[chip]
+                bl[chip, k], br[chip, k] = sl[i], sr[i]
+                bth[chip, k] = sth[i]
+                bm[chip, k] = sm[i]
+                cnt[chip] = k + 1
+            self._dd_admit = (bl.reshape(-1), br.reshape(-1),
+                              bth.reshape(-1), bm.reshape(-1), cnt,
+                              np.tile(clear, (n_dev, 1)))
+            return
+        d = self._dev
+        bag, acc, acc_c, fam_last = _admit_program(
+            d["bag"], d["acc"], d["acc_c"], d["fam_last"],
+            jnp.asarray(sl), jnp.asarray(sr), jnp.asarray(sth),
+            jnp.asarray(sm), jnp.asarray(n_new, jnp.int32),
+            jnp.asarray(clear), capacity=self._capacity)
+        self._dev = dict(bag=bag, acc=acc, acc_c=acc_c,
+                         fam_last=fam_last)
+
+    def _cycle_and_pull(self):
+        """One device phase; returns (fam_live, acc, acc_c, fam_last,
+        count, overflow, stats_row) as host values."""
+        if self.engine == "walker-dd":
+            return self._dd_cycle_and_pull()
+        d = self._dev
+        out = run_stream_cycle(
+            d["bag"], d["acc"], d["acc_c"], d["fam_last"],
+            jnp.asarray(self.phase, jnp.int32), **self._cycle_kw)
+        self._dev = dict(bag=out.bag, acc=out.acc, acc_c=out.acc_c,
+                         fam_last=out.fam_last)
+        fam_live, acc, acc_c, fam_last, count, overflow, stats = \
+            jax.device_get((out.fam_live, out.acc, out.acc_c,
+                            out.fam_last, out.bag.count,
+                            out.bag.overflow, out.stats))
+        return (np.asarray(fam_live), np.asarray(acc),
+                np.asarray(acc_c), np.asarray(fam_last), int(count),
+                bool(overflow), np.asarray(stats))
+
+    def _dd_cycle_and_pull(self):
+        n_dev, aw = self._dd_n_dev, self._dd_aw
+        if self._dd_admit is None:
+            # no admissions this phase: empty blocks, no clears
+            fill_x, fill_th = self._fill
+            self._dd_admit = (
+                np.full(n_dev * aw, fill_x), np.full(n_dev * aw, fill_x),
+                np.full(n_dev * aw, fill_th),
+                np.zeros(n_dev * aw, np.int32),
+                np.zeros(n_dev, np.int32),
+                np.zeros((n_dev, self.slots), dtype=bool))
+        adm = tuple(jnp.asarray(a) for a in self._dd_admit)
+        self._dd_admit = None
+        out = self._dd_run(*self._dd_state, *self._dd_counters, *adm)
+        state = out[:4] + (out[4], out[5])
+        counters = out[6:20]
+        fam_live_c = out[20]
+        (count_c, acc_c2, ctr_h, maxd_c, ovf_c, fam_live) = \
+            jax.device_get((out[4], out[5], out[6:17], out[17],
+                            out[19], fam_live_c))
+        self._dd_state = state
+        # cycles counter resets each phase call (max_cycles=1): pass
+        # zeros back in, like the leg loop does between legs
+        self._dd_counters = counters[:11] + (
+            out[17], jnp.zeros(n_dev, jnp.int32), out[19])
+        totals = np.array([int(np.sum(np.asarray(c))) for c in ctr_h],
+                          dtype=np.int64)
+        delta = totals - self._dd_prev
+        self._dd_prev = totals
+        acc = np.sum(np.asarray(acc_c2), axis=0)      # fixed chip order
+        credited = acc != self._dd_prev_acc
+        self._dd_fam_last = np.where(credited, self.phase,
+                                     self._dd_fam_last).astype(np.int32)
+        self._dd_prev_acc = acc
+        fam_live_tot = np.sum(np.asarray(fam_live), axis=0)
+        count = int(np.sum(np.asarray(count_c)))
+        # CTR64 order: tasks, splits, btasks, wtasks, wsplits, roots,
+        # rounds, segs, wsteps, srows, crounds -> STREAM_STAT_FIELDS
+        stats = np.array([
+            delta[0], delta[2], delta[3], delta[4], delta[5],
+            delta[6], delta[7], delta[8], delta[9],
+            int(np.max(np.asarray(maxd_c))),
+            count, int(np.sum(fam_live_tot > 0))], dtype=np.int64)
+        return (fam_live_tot, acc, np.zeros_like(acc),
+                self._dd_fam_last, count, bool(np.any(np.asarray(ovf_c))),
+                stats)
+
+    def step(self) -> List[CompletedRequest]:
+        """One phase: admit -> cycle -> retire. Returns the requests
+        retired this phase (empty when idle)."""
+        self._admit()
+        if self._count == 0 and not self._slot_req:
+            # nothing live on device (and nothing was admissible): an
+            # idle phase costs no device work, but the phase counter
+            # still advances so open-loop arrival schedules with gaps
+            # make progress
+            self.phase += 1
+            return []
+        (fam_live, acc, acc_c, fam_last, count, overflow,
+         stats) = self._cycle_and_pull()
+        self._last_fam_live = fam_live
+        self._last_fam_last = np.asarray(fam_last, dtype=np.int32)
+        if overflow:
+            raise RuntimeError(
+                "stream walker bag overflowed; raise capacity or lower "
+                "the offered load / admit window")
+        self._count = count
+        self._phase_rows.append(stats.astype(np.int64))
+        retired = []
+        now = time.perf_counter()
+        for slot in sorted(self._slot_req):
+            if fam_live[slot] != 0:
+                continue
+            req = self._slot_req.pop(slot)
+            rec = self._records.pop(req.rid)
+            area = float(acc[slot] + acc_c[slot])
+            if not np.isfinite(area):
+                raise FloatingPointError(
+                    f"stream request {req.rid} produced a non-finite "
+                    f"area — refusing to report garbage")
+            retired.append(CompletedRequest(
+                rid=req.rid, theta=req.theta, bounds=req.bounds,
+                area=area,
+                submit_phase=req.submit_phase,
+                admit_phase=rec["admit_phase"],
+                retire_phase=self.phase,
+                latency_s=now - req.submit_t,
+                first_seeded_phase=int(self._fam_first[slot]),
+                last_credited_phase=int(fam_last[slot])))
+            self._free.append(slot)
+        self._free.sort()
+        self.completed.extend(retired)
+        self.phase += 1
+        if self.checkpoint_path and \
+                self.phase % self.checkpoint_every == 0:
+            self.snapshot()
+        return retired
+
+    def drain(self, max_phases: int = 1 << 14,
+              _crash_after_phases: Optional[int] = None
+              ) -> List[CompletedRequest]:
+        """Run phases until the engine is idle; returns everything
+        retired during the drain."""
+        done: List[CompletedRequest] = []
+        phases = 0
+        while not self.idle:
+            done.extend(self.step())
+            phases += 1
+            if _crash_after_phases is not None \
+                    and phases >= _crash_after_phases:
+                raise RuntimeError(
+                    f"simulated crash after {phases} phases (test hook)")
+            if phases >= max_phases:
+                raise RuntimeError(
+                    f"stream did not drain in {max_phases} phases "
+                    f"({self._count} tasks, {self.resident} resident, "
+                    f"{self.pending} pending)")
+        return done
+
+    def run(self, requests: Sequence[Tuple[float, Tuple[float, float]]],
+            arrival_phase: Optional[Sequence[int]] = None,
+            _crash_after_phases: Optional[int] = None) -> StreamResult:
+        """Convenience driver: submit ``requests`` (theta, bounds)
+        pairs — all up front, or on the open-loop ``arrival_phase``
+        schedule (one target phase per request, non-decreasing) — and
+        run phases until everything retires."""
+        t0 = time.perf_counter()
+        sched = ([0] * len(requests) if arrival_phase is None
+                 else [int(p) for p in arrival_phase])
+        if len(sched) != len(requests):
+            raise ValueError("arrival_phase length != requests length")
+        order = sorted(range(len(requests)), key=lambda i: sched[i])
+        queue = [(sched[i], requests[i]) for i in order]
+        phases0 = self.phase
+        k = 0
+        phases = 0
+        while k < len(queue) or not self.idle:
+            while k < len(queue) and \
+                    queue[k][0] <= self.phase - phases0:
+                th, b = queue[k][1]
+                self.submit(th, b)
+                k += 1
+            self.step()
+            phases += 1
+            if _crash_after_phases is not None \
+                    and phases >= _crash_after_phases:
+                raise RuntimeError(
+                    f"simulated crash after {phases} phases (test hook)")
+            if phases > (1 << 14):
+                raise RuntimeError("stream did not converge")
+        return self.result(wall_s=time.perf_counter() - t0)
+
+    def result(self, wall_s: float = 0.0) -> StreamResult:
+        rows = (np.stack(self._phase_rows) if self._phase_rows
+                else np.zeros((0, len(STREAM_STAT_FIELDS)), np.int64))
+        totals = {k: int(rows[:, i].sum()) if len(rows) else 0
+                  for i, k in enumerate(STREAM_STAT_FIELDS)}
+        totals["maxd"] = int(rows[:, STREAM_STAT_FIELDS.index(
+            "maxd")].max()) if len(rows) else 0
+        return StreamResult(completed=list(self.completed),
+                            phases=self.phase, wall_s=wall_s,
+                            totals=totals, phase_stats=rows,
+                            fam_done=np.asarray(self._last_fam_live)
+                            == 0,
+                            fam_first_phase=self._fam_first.copy(),
+                            fam_last_phase=self._last_fam_last.copy())
+
+    # ------------------------------------------------------------------
+    # snapshot / resume
+    # ------------------------------------------------------------------
+
+    def snapshot(self):
+        """Atomically write queue + walker state to checkpoint_path."""
+        if not self.checkpoint_path:
+            raise ValueError("no checkpoint_path configured")
+        if self.engine == "walker-dd":
+            raise NotImplementedError(
+                "stream snapshots cover the single-chip engine; run "
+                "the dd stream without --checkpoint (its per-chip "
+                "state snapshot is future work)")
+        from ppls_tpu.runtime.checkpoint import save_family_checkpoint
+        if self._dev is None:
+            bag_cols = {}
+            acc_pair = np.zeros((2, self.slots))
+            fam_last = np.full(self.slots, -1, np.int64)
+            count = 0
+        else:
+            count, overflow = jax.device_get(
+                (self._dev["bag"].count, self._dev["bag"].overflow))
+            count = int(count)
+            b = max(count, 1)
+            bl, br, bth, bmeta, acc, acc_c, fam_last = jax.device_get(
+                (self._dev["bag"].bag_l[:b], self._dev["bag"].bag_r[:b],
+                 self._dev["bag"].bag_th[:b],
+                 self._dev["bag"].bag_meta[:b],
+                 self._dev["acc"], self._dev["acc_c"],
+                 self._dev["fam_last"]))
+            bag_cols = {"l": np.asarray(bl)[:count],
+                        "r": np.asarray(br)[:count],
+                        "th": np.asarray(bth)[:count],
+                        "meta": np.asarray(bmeta)[:count]}
+            acc_pair = np.stack([np.asarray(acc), np.asarray(acc_c)])
+        totals = {
+            "phase": self.phase,
+            "next_rid": self._next_rid,
+            "fill": self._fill,
+            "fam_first": self._fam_first.tolist(),
+            "fam_last": np.asarray(fam_last).tolist()
+            if self._dev is not None else [-1] * self.slots,
+            "phase_rows": [r.tolist() for r in self._phase_rows],
+            "pending": [dataclasses.asdict(r) for r in self._pending],
+            "resident": {
+                str(slot): dict(dataclasses.asdict(req),
+                                **self._records[req.rid])
+                for slot, req in self._slot_req.items()},
+            "completed": [dataclasses.asdict(c)
+                          for c in self.completed],
+        }
+        save_family_checkpoint(
+            self.checkpoint_path, identity=self._identity(),
+            bag_cols=bag_cols, count=count, acc=acc_pair,
+            totals=totals)
+
+    @classmethod
+    def resume(cls, checkpoint_path: str, family: str, eps: float,
+               **kwargs) -> "StreamEngine":
+        """Rebuild a StreamEngine from its last snapshot. The engine
+        configuration kwargs must match the snapshotted run (identity-
+        checked); the continued stream replays the identical per-phase
+        computation."""
+        from ppls_tpu.runtime.checkpoint import load_family_checkpoint
+        eng = cls(family, eps, checkpoint_path=checkpoint_path,
+                  **kwargs)
+        bag_cols, count, acc_pair, totals = load_family_checkpoint(
+            checkpoint_path, eng._identity())
+        eng.phase = int(totals["phase"])
+        eng._next_rid = int(totals["next_rid"])
+        eng._fam_first = np.asarray(totals["fam_first"],
+                                    dtype=np.int32)
+        eng._phase_rows = [np.asarray(r, dtype=np.int64)
+                           for r in totals["phase_rows"]]
+        eng._pending = [StreamRequest(
+            rid=d["rid"], theta=d["theta"],
+            bounds=tuple(d["bounds"]),
+            submit_phase=d["submit_phase"],
+            submit_t=time.perf_counter()) for d in totals["pending"]]
+        eng.completed = [CompletedRequest(
+            **{k: (tuple(v) if k == "bounds" else v)
+               for k, v in d.items()}) for d in totals["completed"]]
+        for slot_s, d in totals["resident"].items():
+            slot = int(slot_s)
+            req = StreamRequest(
+                rid=d["rid"], theta=d["theta"],
+                bounds=tuple(d["bounds"]),
+                submit_phase=d["submit_phase"],
+                submit_t=time.perf_counter())
+            eng._slot_req[slot] = req
+            eng._records[req.rid] = dict(slot=slot,
+                                         admit_phase=d["admit_phase"])
+            eng._free.remove(slot)
+        eng._count = int(count)
+        if totals["fill"] is not None:
+            eng._fill = tuple(totals["fill"])
+            eng._build_store()
+            eng._restore_device(bag_cols, count, acc_pair,
+                                np.asarray(totals["fam_last"],
+                                           dtype=np.int32))
+        return eng
+
+    def _restore_device(self, bag_cols, count, acc_pair, fam_last):
+        d = self._dev
+        bag = d["bag"]
+        if count:
+            bag = bag._replace(
+                bag_l=bag.bag_l.at[:count].set(bag_cols["l"]),
+                bag_r=bag.bag_r.at[:count].set(bag_cols["r"]),
+                bag_th=bag.bag_th.at[:count].set(bag_cols["th"]),
+                bag_meta=bag.bag_meta.at[:count].set(
+                    jnp.asarray(bag_cols["meta"], jnp.int32)))
+        bag = bag._replace(count=jnp.asarray(count, jnp.int32))
+        self._dev = dict(
+            bag=bag,
+            acc=jnp.asarray(acc_pair[0]),
+            acc_c=jnp.asarray(acc_pair[1]),
+            fam_last=jnp.asarray(fam_last, jnp.int32))
+
+    def clear_snapshot(self):
+        if self.checkpoint_path and os.path.exists(self.checkpoint_path):
+            os.unlink(self.checkpoint_path)

@@ -1,0 +1,156 @@
+"""Tiny schema check for the bench/multichip artifact records.
+
+The round artifacts (BENCH_r*.json, MULTICHIP_r*.json, and every JSON
+line bench.py prints) are consumed by the round driver and by humans
+diffing rounds — a malformed block silently DROPS from the trajectory
+(the driver skips unparseable/shapeless records), which reads as "no
+regression" when the truth is "no data". Every bench entry point
+validates its record through :func:`validate_record` before printing,
+and ``tools/check_artifacts.py`` (run by ``tools/ci.sh``) validates
+the committed artifact files, so a malformed block fails loudly at
+write time and at CI time instead of vanishing.
+
+The schema is deliberately minimal — the shared envelope every record
+carries, not the per-leg payloads:
+
+* ``metric``: non-empty str
+* ``value``: finite number (0.0 is the legitimate failure value)
+* ``unit``: non-empty str
+* ``vs_baseline``: finite number (error records may omit it)
+
+Secondary legs (``secondary`` dict) are validated recursively with the
+same envelope unless they are error records (``{"error": ...}``) or
+explicitly skipped (``{"skipped": ...}``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import List
+
+
+class ArtifactSchemaError(ValueError):
+    """A bench/multichip record violates the artifact envelope."""
+
+
+def _is_finite_number(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and math.isfinite(v)
+
+
+def validate_record(rec: dict, *, where: str = "record",
+                    require_vs_baseline: bool = True) -> dict:
+    """Validate one bench record envelope; returns ``rec`` unchanged so
+    call sites can wrap their final ``print(json.dumps(...))``.
+    Raises :class:`ArtifactSchemaError` with the offending field."""
+    if not isinstance(rec, dict):
+        raise ArtifactSchemaError(f"{where}: not a JSON object")
+    if "error" in rec and not isinstance(rec.get("error"), str):
+        raise ArtifactSchemaError(f"{where}: 'error' must be a string")
+    if not isinstance(rec.get("metric"), str) or not rec["metric"]:
+        raise ArtifactSchemaError(f"{where}: missing/empty 'metric'")
+    if not _is_finite_number(rec.get("value")):
+        raise ArtifactSchemaError(
+            f"{where}: 'value' must be a finite number, got "
+            f"{rec.get('value')!r}")
+    if not isinstance(rec.get("unit"), str) or not rec["unit"]:
+        raise ArtifactSchemaError(f"{where}: missing/empty 'unit'")
+    if require_vs_baseline and "error" not in rec \
+            and not _is_finite_number(rec.get("vs_baseline")):
+        raise ArtifactSchemaError(
+            f"{where}: 'vs_baseline' must be a finite number, got "
+            f"{rec.get('vs_baseline')!r}")
+    sec = rec.get("secondary")
+    if sec is not None:
+        if not isinstance(sec, dict):
+            raise ArtifactSchemaError(f"{where}: 'secondary' must be "
+                                      f"an object")
+        for name, sub in sec.items():
+            if not isinstance(sub, dict):
+                raise ArtifactSchemaError(
+                    f"{where}.secondary.{name}: not an object")
+            if "error" in sub or "skipped" in sub:
+                continue
+            # secondaries carry heterogeneous payloads (some are
+            # records, some comparison blocks): require the metric
+            # label, and check 'value' finiteness only when present —
+            # a NaN/None value is the silent-poison case
+            if not isinstance(sub.get("metric"), str) \
+                    or not sub["metric"]:
+                raise ArtifactSchemaError(
+                    f"{where}.secondary.{name}: missing/empty 'metric'")
+            if "value" in sub and not _is_finite_number(sub["value"]):
+                raise ArtifactSchemaError(
+                    f"{where}.secondary.{name}: 'value' must be a "
+                    f"finite number, got {sub.get('value')!r}")
+    return rec
+
+
+def validate_artifact_text(text: str, *, where: str = "artifact",
+                           require_records: bool = True) -> List[str]:
+    """Validate every bench record found in an artifact's text.
+
+    Two shapes are handled: the round driver's WRAPPER object (one
+    pretty-printed JSON object whose ``tail`` string holds the bench's
+    stdout/stderr tail — the records are JSON lines inside it), and a
+    raw line stream (bench stdout piped directly). Only lines parsing
+    as objects with a ``metric`` key are treated as bench records.
+    Returns a list of problem strings (empty = clean);
+    ``require_records`` flags an artifact with no records at all (the
+    silent-drop outcome) — disable it for artifacts that legitimately
+    carry none (e.g. the multichip dryrun log).
+    """
+    try:
+        wrapper = json.loads(text)
+    except json.JSONDecodeError:
+        wrapper = None
+    problems: List[str] = []
+    found = 0
+    if isinstance(wrapper, dict):
+        if "metric" in wrapper:
+            found += 1
+            try:
+                validate_record(wrapper, where=where)
+            except ArtifactSchemaError as e:
+                problems.append(str(e))
+        tail = wrapper.get("tail")
+        if isinstance(tail, str):
+            sub, sub_found = _scan_lines(tail, f"{where}:tail")
+            problems += sub
+            found += sub_found
+    else:
+        sub, sub_found = _scan_lines(text, where)
+        problems += sub
+        found += sub_found
+    if require_records and not found:
+        problems.append(f"{where}: no bench records found")
+    return problems
+
+
+def _scan_lines(text: str, where: str):
+    """Scan a raw log/stdout stream for bench-record JSON lines;
+    returns (problems, records_found)."""
+    problems: List[str] = []
+    found = 0
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            if '"metric"' in line:
+                # a truncated/garbled bench record is exactly the
+                # silent-drop failure mode this check exists for
+                problems.append(f"{where}:{i}: unparseable bench "
+                                f"record line")
+            continue
+        if not isinstance(obj, dict) or "metric" not in obj:
+            continue                 # some other JSON block (e.g. logs)
+        found += 1
+        try:
+            validate_record(obj, where=f"{where}:{i}")
+        except ArtifactSchemaError as e:
+            problems.append(str(e))
+    return problems, found

@@ -1,0 +1,86 @@
+"""Artifact schema check (ppls_tpu.utils.artifact_schema +
+tools/check_artifacts.py): malformed bench records must fail loudly at
+write time and at CI time instead of silently dropping from the
+round-over-round trajectory."""
+
+import json
+
+import pytest
+
+from ppls_tpu.utils.artifact_schema import (ArtifactSchemaError,
+                                            validate_artifact_text,
+                                            validate_record)
+
+GOOD = {"metric": "subintervals evaluated/sec/chip", "value": 1.5e9,
+        "unit": "subintervals/s/chip", "vs_baseline": 101.0}
+
+
+def test_validate_record_accepts_good():
+    assert validate_record(dict(GOOD)) == GOOD
+
+
+def test_validate_record_accepts_failure_value():
+    # 0.0 is the legitimate failure value; error records may omit the
+    # baseline ratio
+    validate_record({"metric": "m", "value": 0.0, "unit": "u",
+                     "vs_baseline": 0.0, "error": "boom"})
+    validate_record({"metric": "m", "value": 0.0, "unit": "u",
+                     "error": "boom"})
+
+
+@pytest.mark.parametrize("broken", [
+    {"value": 1.0, "unit": "u", "vs_baseline": 0.0},          # no metric
+    {"metric": "m", "unit": "u", "vs_baseline": 0.0},         # no value
+    {"metric": "m", "value": float("nan"), "unit": "u",
+     "vs_baseline": 0.0},                                     # NaN value
+    {"metric": "m", "value": "12", "unit": "u",
+     "vs_baseline": 0.0},                                     # str value
+    {"metric": "m", "value": 1.0, "vs_baseline": 0.0},        # no unit
+    {"metric": "m", "value": 1.0, "unit": "u"},               # no ratio
+])
+def test_validate_record_rejects_broken(broken):
+    with pytest.raises(ArtifactSchemaError):
+        validate_record(broken)
+
+
+def test_validate_record_secondary_poison():
+    rec = dict(GOOD, secondary={"2d": {"metric": "2d",
+                                       "value": float("nan")}})
+    with pytest.raises(ArtifactSchemaError, match="secondary.2d"):
+        validate_record(rec)
+    rec = dict(GOOD, secondary={"2d": {"error": "failed"},
+                                "qmc": {"skipped": "no tpu"}})
+    validate_record(rec)          # error/skipped secondaries pass
+
+
+def test_validate_artifact_wrapper_shape():
+    # the round driver's wrapper: records live as JSON lines inside
+    # the "tail" string
+    wrapper = {"n": 8, "rc": 0,
+               "tail": "some log line\n" + json.dumps(GOOD) + "\n"}
+    assert validate_artifact_text(json.dumps(wrapper)) == []
+    # a garbled record inside the tail is caught
+    bad = json.dumps(GOOD)[:-20] + "..."
+    wrapper["tail"] = bad + "\n"
+    problems = validate_artifact_text(json.dumps(wrapper))
+    assert problems and "unparseable" in problems[0]
+
+
+def test_validate_artifact_raw_stream():
+    text = "log\n" + json.dumps(GOOD) + "\n"
+    assert validate_artifact_text(text) == []
+    assert validate_artifact_text("nothing here\n") \
+        == ["artifact: no bench records found"]
+
+
+def test_committed_artifacts_validate():
+    # the repo's own round artifacts must pass the gate CI runs
+    import subprocess
+    import sys
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "check_artifacts.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -64,3 +64,39 @@ def test_sharded_overflow_raises(mesh8):
     cfg = REFERENCE_CONFIG.replace(capacity=128)  # 16/chip < peak 1642
     with pytest.raises(RuntimeError, match="overflow"):
         sharded_integrate(cfg, mesh=mesh8)
+
+
+def test_sharded_kill_and_resume_matches_uninterrupted(mesh8, tmp_path):
+    """Wavefront recovery (VERDICT Missing #4): the last engine with
+    no recovery path. Leg snapshots reuse the sharded-bag checkpoint
+    container with FULL per-chip frontier columns (position-preserving
+    — the child compaction is position-sensitive), so kill-and-resume
+    replays the identical collective round sequence bit-for-bit."""
+    import os
+
+    from ppls_tpu.parallel.sharded import resume_sharded
+
+    cfg = REFERENCE_CONFIG.replace(capacity=1 << 14)
+    base = sharded_integrate(cfg, mesh=mesh8)
+    path = str(tmp_path / "wavefront.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        sharded_integrate(cfg, mesh=mesh8, checkpoint_path=path,
+                          checkpoint_every=4, _crash_after_legs=2)
+    res = resume_sharded(path, cfg, mesh=mesh8, checkpoint_every=4)
+    assert res.area == base.area                       # bit-for-bit
+    assert res.metrics.tasks == base.metrics.tasks
+    assert res.metrics.rounds == base.metrics.rounds
+    assert res.metrics.tasks_per_chip == base.metrics.tasks_per_chip
+    assert not os.path.exists(path)   # finished run clears its snapshot
+
+
+def test_sharded_resume_rejects_mismatched_identity(mesh8, tmp_path):
+    from ppls_tpu.parallel.sharded import resume_sharded
+
+    cfg = REFERENCE_CONFIG.replace(capacity=1 << 14)
+    path = str(tmp_path / "wavefront.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        sharded_integrate(cfg, mesh=mesh8, checkpoint_path=path,
+                          checkpoint_every=4, _crash_after_legs=1)
+    with pytest.raises(ValueError, match="different run"):
+        resume_sharded(path, cfg.replace(eps=1e-4), mesh=mesh8)

@@ -1,0 +1,242 @@
+"""Continuous-batching streaming walker (runtime/stream.py).
+
+Acceptance surface of the streaming tentpole:
+
+* parity: streamed per-request areas match the batch walker / f64 bag
+  within the engine's documented ds contract;
+* DETERMINISM: the same request set admitted in one batch vs streamed
+  over N arrival phases yields BIT-IDENTICAL per-request areas — pinned
+  in the pure-f64 streaming mode (``f64_rounds``) on a dyadic-exact
+  workload, where every split decision and leaf value is pointwise f64
+  and every accumulation is exact, so the admission schedule provably
+  cannot move a bit. (With the ds walker engaged, which engine
+  evaluates a given leaf depends on co-residents — the documented
+  ~1e-9 contract applies and is asserted separately.)
+* kill-and-resume mid-stream restores queue + walker and completes
+  with identical results (replay identity, same contract as the batch
+  engines' leg resume);
+* the dd stream (virtual 8-mesh): admission folded into the phase
+  boundary, parity + retirement;
+* K small requests streamed beat K cold per-request walker calls on
+  the device-counted boundary proxies (the CPU-assertable form of the
+  >= 3x wall acceptance ratio).
+"""
+
+import numpy as np
+import pytest
+
+from ppls_tpu.models.integrands import (get_family, get_family_ds,
+                                        register_family,
+                                        register_family_ds)
+from ppls_tpu.ops import ds_kernel as dsk
+from ppls_tpu.parallel.walker import integrate_family_walker
+from ppls_tpu.runtime.stream import StreamEngine
+
+BOUNDS = (1e-2, 1.0)
+EPS = 1e-7
+# small interpret-friendly config (the walker test sizing)
+KW = dict(slots=8, chunk=1 << 10, capacity=1 << 16, lanes=256,
+          roots_per_lane=2, refill_slots=2, seg_iters=32,
+          min_active_frac=0.05)
+WKW = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
+           refill_slots=2, seg_iters=32, min_active_frac=0.05)
+
+THETA = 1.0 + np.arange(6) / 6.0
+REQS = [(float(t), BOUNDS) for t in THETA]
+
+
+# dyadic-exact quadratic family for the bit-identity contract: on
+# [0, 1] every node endpoint is a dyadic rational, th * x^2 with a
+# few-bit th keeps every leaf value exactly representable, and the
+# trapezoid test error is constant-curvature (uniform-depth trees).
+def _quad(x, th):
+    return th * x * x
+
+
+def _quad_ds(x, th):
+    return dsk.ds_mul(th, dsk.ds_mul(x, x))
+
+
+register_family("quad_stream_test", _quad)
+register_family_ds("quad_stream_test", _quad_ds)
+
+
+def test_stream_matches_batch_walker():
+    eng = StreamEngine("sin_recip_scaled", EPS, **KW)
+    res = eng.run(REQS)
+    b = integrate_family_walker(
+        get_family("sin_recip_scaled"), get_family_ds("sin_recip_scaled"),
+        THETA, BOUNDS, EPS, **WKW)
+    assert len(res.completed) == len(REQS)
+    assert np.max(np.abs(res.areas - b.areas)) < 3e-9
+    # task conservation: the streamed engine does the same work, it
+    # does not silently degrade or duplicate
+    drift = abs(res.totals["tasks"] - b.metrics.tasks) / b.metrics.tasks
+    assert drift < 0.02, (res.totals["tasks"], b.metrics.tasks)
+    # the walker (not the f64 drain) owns the hot share while streaming
+    occ = res.occupancy_summary(KW["lanes"])
+    assert occ["walker_fraction"] > 0.5, occ
+    # per-request latency accounting is populated and monotone
+    for c in res.completed:
+        assert c.retire_phase >= c.admit_phase >= c.submit_phase
+        assert c.phases_in_flight >= 1
+        assert c.last_credited_phase <= c.retire_phase
+
+
+def test_stream_arrival_schedule_parity():
+    # streamed over arrival phases: same areas within the ds contract
+    # (which engine evaluates a leaf is schedule-dependent — the
+    # bit-level contract is the f64-mode test below)
+    e1 = StreamEngine("sin_recip_scaled", EPS, **KW)
+    r1 = e1.run(REQS)
+    e2 = StreamEngine("sin_recip_scaled", EPS, **KW)
+    r2 = e2.run(REQS, arrival_phase=[0, 0, 1, 2, 3, 5])
+    assert np.max(np.abs(r1.areas - r2.areas)) < 3e-9
+    assert len(r2.completed) == len(REQS)
+    # later arrivals really were admitted later
+    admits = {c.rid: c.admit_phase for c in r2.completed}
+    assert admits[5] >= 5
+
+
+def test_stream_batch_vs_streamed_bit_identity_f64_mode():
+    """The determinism acceptance: one-batch admission vs N arrival
+    phases, bit-identical per-request areas. Pure-f64 phases
+    (f64_rounds) + dyadic workload: split decisions and leaf values
+    are pointwise f64 (schedule-independent) and every sum is exact,
+    so equality holds at the bit level BY CONSTRUCTION — this test
+    pins the construction."""
+    kw = dict(KW, f64_rounds=4)
+    theta = [1.0, 1.25, 1.5, 2.0, 0.75, 3.0]
+    reqs = [(t, (0.0, 1.0)) for t in theta]
+    r1 = StreamEngine("quad_stream_test", 1e-9, **kw).run(reqs)
+    r2 = StreamEngine("quad_stream_test", 1e-9, **kw).run(
+        reqs, arrival_phase=[0, 1, 2, 3, 5, 8])
+    assert len(r1.completed) == len(reqs)
+    assert len(r2.completed) == len(reqs)
+    assert np.array_equal(r1.areas, r2.areas)          # bit-for-bit
+    # identical work too: pointwise f64 decisions conserve the tree
+    assert r1.totals["tasks"] == r2.totals["tasks"]
+    # and the areas are right (exact integral th/3 up to eps-level)
+    assert np.max(np.abs(r1.areas - np.asarray(theta) / 3.0)) < 1e-6
+
+
+def test_stream_kill_and_resume_matches_uninterrupted(tmp_path):
+    arr = [0, 0, 1, 2, 3, 5]
+    base = StreamEngine("sin_recip_scaled", EPS, **KW).run(
+        REQS, arrival_phase=arr)
+    path = str(tmp_path / "stream.ckpt")
+    eng = StreamEngine("sin_recip_scaled", EPS, checkpoint_path=path,
+                       checkpoint_every=1, **KW)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(REQS, arrival_phase=arr, _crash_after_phases=3)
+    eng2 = StreamEngine.resume(path, "sin_recip_scaled", EPS,
+                               checkpoint_every=1, **KW)
+    assert eng2.phase == 3
+    # replay the rest of the arrival schedule: rids are submission-
+    # ordered, so the resumed driver skips the already-submitted prefix
+    k = eng2.next_rid
+    while not eng2.idle or k < len(REQS):
+        while k < len(REQS) and arr[k] <= eng2.phase:
+            eng2.submit(*REQS[k])
+            k += 1
+        eng2.step()
+    res = eng2.result()
+    assert np.array_equal(res.areas, base.areas)       # bit-for-bit
+    assert res.phases == base.phases
+    assert len(res.completed) == len(base.completed)
+
+
+def test_stream_resume_rejects_mismatched_identity(tmp_path):
+    path = str(tmp_path / "stream.ckpt")
+    eng = StreamEngine("sin_recip_scaled", EPS, checkpoint_path=path,
+                       checkpoint_every=1, **KW)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(REQS, _crash_after_phases=1)
+    with pytest.raises(ValueError, match="different run"):
+        StreamEngine.resume(path, "sin_recip_scaled", 1e-8, **KW)
+
+
+def test_stream_dd_parity_on_mesh():
+    """The dd engine streams too: admission folded into the phase
+    boundary (seeds enter each chip's queue as the phase opens and
+    ride phase_reshard's occupancy decision + stratified deal)."""
+    from ppls_tpu.parallel.bag_engine import integrate_family
+
+    kw = dict(KW, chunk=1 << 8, engine="walker-dd", n_devices=8)
+    eng = StreamEngine("sin_recip_scaled", 1e-9, **kw)
+    res = eng.run([(float(t), (1e-3, 1.0)) for t in THETA],
+                  arrival_phase=[0, 0, 1, 2, 3, 4])
+    b = integrate_family(get_family("sin_recip_scaled"), THETA,
+                         (1e-3, 1.0), 1e-9,
+                         chunk=1 << 10, capacity=1 << 17)
+    assert len(res.completed) == len(THETA)
+    assert np.max(np.abs(res.areas - b.areas)) < 1e-9
+    occ = res.occupancy_summary(KW["lanes"])
+    assert occ["walker_fraction"] > 0.3, occ
+
+
+def test_stream_dd_requires_refill():
+    with pytest.raises(ValueError, match="refill_slots"):
+        StreamEngine("sin_recip_scaled", EPS,
+                     **dict(KW, refill_slots=0, engine="walker-dd",
+                            n_devices=8))
+
+
+def test_stream_beats_cold_calls_device_proxies():
+    """The >= 3x acceptance for K small requests, in its CPU-
+    assertable device-counted form: K cold per-request walker calls
+    pay K full breed/walk/drain boundary cadences; the stream shares
+    them. (Wall ratios on this container time the interpreter — the
+    bench records both; the proxy is what a CPU round can assert.)"""
+    K = 8
+    theta = 1.0 + np.arange(K) / K
+    f = get_family("sin_recip_scaled")
+    fds = get_family_ds("sin_recip_scaled")
+    cold_boundaries = 0
+    cold_areas = np.empty(K)
+    for i, t in enumerate(theta):
+        r1 = integrate_family_walker(f, fds, [float(t)], BOUNDS, EPS,
+                                     **WKW)
+        cold_areas[i] = r1.areas[0]
+        # rounds includes breed+drain rounds AND walker segments — the
+        # per-run boundary cadence (walker._assemble_result)
+        cold_boundaries += r1.metrics.rounds
+    res = StreamEngine("sin_recip_scaled", EPS, **KW).run(
+        [(float(t), BOUNDS) for t in theta])
+    stream_boundaries = int(res.totals["rounds"] + res.totals["segs"])
+    assert np.max(np.abs(res.areas - cold_areas)) < 3e-9
+    assert stream_boundaries > 0
+    ratio = cold_boundaries / stream_boundaries
+    assert ratio >= 3.0, (cold_boundaries, stream_boundaries)
+
+
+def test_stream_request_validation():
+    eng = StreamEngine("sin_recip_scaled", EPS, **KW)
+    # out-of-ds-domain request refused at submit, not at retire
+    with pytest.raises(ValueError, match="Cody-Waite"):
+        eng.submit(2.0, (1e-7, 1.0))
+    assert eng.pending == 0
+
+
+def test_serve_cli_synthetic(capsys):
+    import json as _json
+
+    from ppls_tpu.__main__ import main
+    rc = main(["serve", "--slots", "8", "--chunk", "512",
+               "--capacity", "65536", "--lanes", "256",
+               "--refill-slots", "2", "--synthetic", "4",
+               "--arrival-rate", "2", "--eps", "1e-6",
+               "-a", "1e-2", "-b", "1.0"])
+    assert rc == 0
+    lines = [ln for ln in capsys.readouterr().out.strip().splitlines()
+             if ln.startswith("{")]
+    recs = [_json.loads(ln) for ln in lines]
+    summary = [r for r in recs if r.get("summary")]
+    results = [r for r in recs if not r.get("summary")]
+    assert len(summary) == 1 and len(results) == 4
+    assert summary[0]["completed"] == 4
+    assert summary[0]["requests_per_sec"] > 0
+    assert {"p50_phases", "p99_phases"} <= set(summary[0]["latency"])
+    for r in results:
+        assert np.isfinite(r["area"])
+        assert r["phases_in_flight"] >= 1

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Validate the round artifact JSONs (BENCH_r*.json, MULTICHIP_r*.json)
+against the bench record envelope (ppls_tpu.utils.artifact_schema), so
+malformed blocks fail CI loudly instead of silently dropping from the
+round-over-round trajectory.
+
+Usage:
+    python tools/check_artifacts.py [FILE ...]   # default: repo-root
+                                                 # BENCH_r*/MULTICHIP_r*
+    some-bench | python tools/check_artifacts.py -   # validate stdin
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ppls_tpu.utils.artifact_schema import validate_artifact_text  # noqa: E402
+
+
+def main(argv) -> int:
+    paths = argv[1:]
+    if not paths:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))
+                       + glob.glob(os.path.join(root,
+                                                "MULTICHIP_r*.json")))
+        if not paths:
+            print("check_artifacts: no artifact files found", flush=True)
+            return 0
+    problems = []
+    for p in paths:
+        if p == "-":
+            problems += validate_artifact_text(sys.stdin.read(),
+                                               where="<stdin>")
+            continue
+        base = os.path.basename(p)
+        with open(p) as fh:
+            # the MULTICHIP dryrun log legitimately carries no bench
+            # records (DD_OCCUPANCY blocks are not metric records)
+            problems += validate_artifact_text(
+                fh.read(), where=base,
+                require_records=base.startswith("BENCH"))
+    for msg in problems:
+        print(f"check_artifacts: {msg}", file=sys.stderr)
+    print(f"check_artifacts: {len(paths)} file(s), "
+          f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# One-command CI for this repo (toolchain-less CPU container):
+#
+#   1. tier-1 forced-CPU test suite (the ROADMAP gate, verbatim)
+#   2. `pip install -e .` smoke + `ppls-tpu --help` console script
+#   3. artifact schema check (BENCH_r*/MULTICHIP_r* round JSONs)
+#
+# Usage: bash tools/ci.sh            # from anywhere inside the repo
+#        PPLS_CI_SKIP_INSTALL=1 bash tools/ci.sh   # tests + schema only
+set -u -o pipefail
+
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$ROOT"
+FAILURES=0
+
+step() { echo; echo "=== ci: $* ==="; }
+
+# --- 1. tier-1 suite (keep in sync with ROADMAP.md "Tier-1 verify") ---
+step "tier-1 forced-CPU test suite"
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "ci: tier-1 suite FAILED (rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+fi
+
+# --- 2. packaging smoke: editable install + console script ---
+if [ "${PPLS_CI_SKIP_INSTALL:-0}" != "1" ]; then
+    step "pip install -e . smoke"
+    # --no-build-isolation: air-gapped containers cannot fetch the
+    # isolated build env's setuptools; the host install is fine
+    if pip install -e . --no-deps --no-build-isolation -q; then
+        if ppls-tpu --help > /dev/null 2>&1 \
+                && ppls-tpu serve --help > /dev/null 2>&1; then
+            echo "ci: ppls-tpu --help OK (serve subcommand included)"
+        else
+            echo "ci: ppls-tpu --help FAILED"
+            FAILURES=$((FAILURES + 1))
+        fi
+    else
+        echo "ci: pip install -e . FAILED"
+        FAILURES=$((FAILURES + 1))
+    fi
+else
+    echo "ci: install smoke skipped (PPLS_CI_SKIP_INSTALL=1)"
+fi
+
+# --- 3. artifact schema check: malformed blocks fail loudly ---
+step "artifact schema check"
+if python tools/check_artifacts.py; then
+    echo "ci: artifacts OK"
+else
+    echo "ci: artifact schema check FAILED"
+    FAILURES=$((FAILURES + 1))
+fi
+
+echo
+if [ "$FAILURES" -ne 0 ]; then
+    echo "ci: $FAILURES step(s) FAILED"
+    exit 1
+fi
+echo "ci: all steps green"
