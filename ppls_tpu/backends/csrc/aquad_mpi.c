@@ -36,7 +36,10 @@ enum { TAG_WORK = 10, TAG_STOP = 11, TAG_RESULT = 12 };
  * area in value, 1 split request (value unused). */
 
 static void farmer(int nprocs, int fid, double a, double b, double eps) {
-    int nworkers = nprocs - 1;
+    /* fid/eps are worker-side (the farmer only routes intervals); they
+     * stay in the signature so farmer/worker share the argv contract */
+    (void)fid;
+    (void)eps;
     aq_bag bag;
     bag_init(&bag);
     bag_push(&bag, a, b, 0);
@@ -114,7 +117,6 @@ static void farmer(int nprocs, int fid, double a, double b, double eps) {
     free(held);
     free(tasks_per_rank);
     free(idle_ring);
-    (void)nworkers;
 }
 
 static void worker(int fid, double eps) {

@@ -159,10 +159,10 @@ def seed_rect_state(bounds, chunk: int = 1 << 12,
     fx = 0.5 * (ax + bx)
     fy = 0.5 * (ay + by)
     return RectBag(
-        lx=jnp.full(store, fx).at[0].set(ax),
-        rx=jnp.full(store, fx).at[0].set(bx),
-        ly=jnp.full(store, fy).at[0].set(ay),
-        ry=jnp.full(store, fy).at[0].set(by),
+        lx=jnp.full(store, fx, dtype=jnp.float64).at[0].set(ax),
+        rx=jnp.full(store, fx, dtype=jnp.float64).at[0].set(bx),
+        ly=jnp.full(store, fy, dtype=jnp.float64).at[0].set(ay),
+        ry=jnp.full(store, fy, dtype=jnp.float64).at[0].set(by),
         meta=jnp.zeros(store, jnp.int32),
         count=jnp.asarray(1, jnp.int32),
         acc=jnp.zeros((), jnp.float64),

@@ -35,6 +35,21 @@ if not TPU_LANE:
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Strict-mode sanitizer lane (ISSUE 5): implicit rank promotion is how
+# silent wrong-shape broadcasts slip into the f64 accumulator paths —
+# the whole suite runs with it forbidden. Package code must broadcast
+# explicitly ([None], broadcast_to, reshape).
+jax.config.update("jax_numpy_rank_promotion", "raise")
+
+# Opt-in loud-NaN lane: PPLS_DEBUG_NANS=1 re-runs the suite with
+# jax_debug_nans, so ANY NaN produced inside a jitted program raises
+# FloatingPointError at the producing primitive instead of flowing into
+# an accumulator. Not the default because several tests create NaNs on
+# purpose — those carry ``@pytest.mark.nan_injection``, and the autouse
+# fixture below turns the flag off for exactly their duration.
+if os.environ.get("PPLS_DEBUG_NANS", "") == "1":
+    jax.config.update("jax_debug_nans", True)
+
 # Persistent XLA compile cache: the TPU lane's full-cycle programs take
 # minutes each on the remote-compile path; cached replays take seconds
 # (utils/compile_cache.py). Safe for the CPU lane too (HLO-hash keyed).
@@ -84,6 +99,60 @@ def pytest_sessionfinish(session, exitstatus):
             json.dump(data, fh, indent=1)
     except OSError:
         pass  # a read-only checkout must not fail the lane
+
+
+@pytest.fixture(autouse=True)
+def _nan_injection_flag(request):
+    """Deliberate-NaN tests (``@pytest.mark.nan_injection``) must run
+    with jax_debug_nans OFF even in the PPLS_DEBUG_NANS=1 lane: they
+    pin NaN *propagation* contracts (NaN-err root ordering, the
+    retire-path FloatingPointError), which debug-nans would preempt at
+    the producing primitive. The previous flag value is restored so
+    the lane stays on for every other test."""
+    if "nan_injection" not in request.keywords:
+        yield
+        return
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+@pytest.fixture
+def compile_once_guard():
+    """Retracing guard (ISSUE 5): assert the given jitted entries
+    compile EXACTLY ONCE inside the guarded block.
+
+    Usage::
+
+        with compile_once_guard(run_stream_cycle):
+            eng.run(reqs, arrival_phase=[0, 1, 2])   # 3+ phases
+
+    ``_cache_size()`` counts distinct (shapes, statics, weak-types)
+    signatures in the pjit cache — a count > 1 means a static-arg or
+    weak-type drifted between calls and the "one compiled program
+    serves the whole stream/run" contract silently became
+    one-compile-per-phase (the recompile-storm shape GL05 guards
+    statically; this fixture guards it dynamically).
+    """
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard(*jitted_fns):
+        for fn in jitted_fns:
+            fn._clear_cache()
+        yield
+        for fn in jitted_fns:
+            n = fn._cache_size()
+            assert n == 1, (
+                f"{getattr(fn, '__name__', fn)!r} compiled {n} times "
+                f"inside the guarded block (expected exactly once): a "
+                f"static argument or weak-type is varying across "
+                f"calls — recompile storm")
+
+    return guard
 
 
 def pytest_collection_modifyitems(config, items):

@@ -58,11 +58,13 @@ def test_bag_deterministic():
     assert a1 == a2
 
 
+@pytest.mark.nan_injection
 def test_nan_areas_raise_not_report():
     # An engine returning NaN must raise, not hand garbage to callers —
     # the round-2 bench recorded a "perfect" gate over all-NaN areas
     # because nothing between the accumulator and the JSON line checked
-    # finiteness (VERDICT r2 Weak #1/#2).
+    # finiteness (VERDICT r2 Weak #1/#2). nan_injection: pins the
+    # ACCUMULATOR-path raise, which debug-nans would preempt.
     import jax.numpy as jnp
 
     with pytest.raises(FloatingPointError, match="non-finite"):

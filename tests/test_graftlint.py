@@ -1,0 +1,440 @@
+"""tools/graftlint: each GL rule catches its deliberately-broken
+fixture and stays silent on the fixed twin.
+
+Pure AST analysis — no jax execution — so these run in milliseconds.
+The fixtures are small temp packages shaped like the real modules
+(``pkg/parallel/...``), because GL02/GL04 scope by path convention.
+The GL01 fixture reproduces the PR-2 bug shape: ``refill_slots``
+changing the meaning of persisted state without joining the snapshot
+identity surface.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.graftlint.core import (load_baseline, run_lint,
+                                  split_new_and_known, write_baseline)
+
+
+def _mkpkg(tmp_path, files):
+    """files: {relative path under pkg/: source}. Returns pkg dir."""
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def _codes(violations):
+    return sorted({v.code for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# GL01 — snapshot-identity completeness (the PR-2 refill_slots shape)
+# ---------------------------------------------------------------------------
+
+GL01_BROKEN = """
+    from typing import NamedTuple
+
+    class _StreamCarry(NamedTuple):
+        bag_l: object
+        acc: object
+        tasks: object
+        refill_slots: object    # <- PR-2 shape: never persisted
+
+    def run_cycles(c: _StreamCarry):
+        return c
+
+    def integrate(state, checkpoint_path):
+        out = run_cycles(state)
+        identity = {"engine": "walker", "eps": 1e-6}
+        save_family_checkpoint(
+            checkpoint_path, identity=identity,
+            bag_cols={"l": out.bag_l}, count=1, acc=out.acc,
+            totals={"tasks": 0})
+        return out
+"""
+
+
+def test_gl01_catches_missing_carry_field(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/walker.py": GL01_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL01"]
+    assert len(got) == 1, got
+    assert got[0].symbol == "_StreamCarry.refill_slots"
+    assert "refill_slots" in got[0].message
+    # bag_l is covered via the l/r/th/meta alias map, acc and tasks
+    # via the save call's keywords/strings — only the PR-2 field fires
+
+
+def test_gl01_fixed_by_joining_identity(tmp_path):
+    fixed = GL01_BROKEN.replace(
+        '{"engine": "walker", "eps": 1e-6}',
+        '{"engine": "walker", "eps": 1e-6, "refill_slots": 2}')
+    pkg = _mkpkg(tmp_path, {"parallel/walker.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL01"] == []
+
+
+def test_gl01_ignores_kernel_internal_carries(tmp_path):
+    # A carry never referenced by snapshot code (the _WalkCarry shape:
+    # folded back into the bag before any checkpoint) is out of scope.
+    src = GL01_BROKEN + """
+    class _InnerCarry(NamedTuple):
+        scratch: object
+
+    def _kernel_loop(c: _InnerCarry):
+        return c
+    """
+    pkg = _mkpkg(tmp_path, {"parallel/walker.py": src})
+    got = [v for v in run_lint(pkg) if v.code == "GL01"]
+    assert [v.symbol for v in got] == ["_StreamCarry.refill_slots"]
+
+
+# ---------------------------------------------------------------------------
+# GL02 — f64 dtype discipline
+# ---------------------------------------------------------------------------
+
+GL02_BROKEN = """
+    import jax.numpy as jnp
+
+    def seed(n):
+        a = jnp.zeros(n)                      # dtype-less
+        b = jnp.zeros(n, jnp.float64)         # ok: positional dtype
+        c = jnp.full(n, 0.5, dtype=jnp.float64)   # ok: kw dtype
+        d = jnp.asarray([1.0, 2.0])           # dtype-less literal
+        e = jnp.asarray(n)                    # ok: inherits
+        return a, b, c, d, e
+
+    def downcast(x):
+        return x.astype(jnp.float32)          # f32 in a numeric path
+"""
+
+
+def test_gl02_catches_dtype_less_and_f32(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL02"]
+    syms = sorted(v.symbol for v in got)
+    assert syms == ["downcast:float32", "seed:dtype-less-asarray",
+                    "seed:dtype-less-zeros"], got
+
+
+def test_gl02_scoped_to_numeric_paths(tmp_path):
+    # the same source outside parallel/ and ops/ is not in scope
+    pkg = _mkpkg(tmp_path, {"utils/num.py": GL02_BROKEN})
+    assert [v for v in run_lint(pkg) if v.code == "GL02"] == []
+
+
+def test_gl02_ds_limb_modules_exempt_from_f32(tmp_path):
+    # ops/ds_kernel.py IS f32 by representation — only the dtype-less
+    # creation check applies there, not the float32 check
+    pkg = _mkpkg(tmp_path, {"ops/ds_kernel.py": GL02_BROKEN})
+    syms = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL02")
+    assert syms == ["seed:dtype-less-asarray", "seed:dtype-less-zeros"]
+
+
+# ---------------------------------------------------------------------------
+# GL03 — host sync reachable from a jitted root
+# ---------------------------------------------------------------------------
+
+GL03_BROKEN = """
+    import functools
+    import jax
+    import numpy as np
+
+    def helper(x):
+        return np.asarray(x)                  # host sync, reachable
+
+    def host_only(x):
+        return np.asarray(x)                  # NOT reachable: silent
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def entry(x, *, n: int):
+        y = helper(x)
+        k = int(x)                            # coerces a traced value
+        m = int(n)                            # ok: static config
+        s = int(x.shape[0])                   # ok: shapes are static
+        return y, k, m, s
+"""
+
+
+def test_gl03_walks_call_graph_from_jit_roots(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": GL03_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL03"]
+    syms = sorted(v.symbol for v in got)
+    assert syms == ["entry:int()", "helper:np.asarray"], got
+
+
+def test_gl03_cross_module_reachability(tmp_path):
+    pkg = _mkpkg(tmp_path, {
+        "parallel/helpers.py": """
+            import numpy as np
+
+            def pull(x):
+                return np.asarray(x)
+        """,
+        "parallel/hot.py": """
+            import functools
+            import jax
+            from pkg.parallel.helpers import pull
+
+            @functools.partial(jax.jit, static_argnames=())
+            def entry(x):
+                return pull(x)
+        """,
+    })
+    got = [v for v in run_lint(pkg) if v.code == "GL03"]
+    assert [v.symbol for v in got] == ["pull:np.asarray"]
+    assert got[0].path.endswith("helpers.py")
+
+
+def test_gl03_jit_builder_roots(tmp_path):
+    # the sharded-engine shape: jax.jit(wrapper(body)) — body is a root
+    pkg = _mkpkg(tmp_path, {"parallel/sharded_thing.py": """
+        import jax
+
+        def build(mesh):
+            def body(x):
+                return jax.device_get(x)      # sync inside the program
+            return jax.jit(wrap(body))
+    """})
+    got = [v for v in run_lint(pkg) if v.code == "GL03"]
+    assert [v.symbol for v in got] == ["body:jax.device_get"]
+
+
+# ---------------------------------------------------------------------------
+# GL04 — uncounted collectives in the dd engine
+# ---------------------------------------------------------------------------
+
+GL04_BROKEN = """
+    from jax import lax
+
+    def bad_balance(x, axis):
+        g = lax.all_gather(x, axis)           # uncounted collective
+        return lax.psum(g, axis)
+
+    def good_balance(x, axis, crounds):
+        g = lax.all_gather(x, axis)
+        return lax.psum(g, axis), crounds + 1
+"""
+
+
+def test_gl04_catches_uncounted_collectives(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/sharded_walker.py": GL04_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL04"]
+    assert [v.symbol for v in got] == ["bad_balance"]
+    assert "2 collective(s)" in got[0].message
+
+
+def test_gl04_scoped_to_dd_engine(tmp_path):
+    # collectives in the wavefront/bag engines are not crounds-audited
+    pkg = _mkpkg(tmp_path, {"parallel/sharded_bag.py": GL04_BROKEN})
+    assert [v for v in run_lint(pkg) if v.code == "GL04"] == []
+
+
+def test_gl04_docstring_mention_does_not_count(tmp_path):
+    # prose is not accounting: a docstring saying "crounds is handled
+    # by the caller" must not suppress the rule — the allowlist (with
+    # a reviewable reason) is the only caller-counts-it escape hatch
+    src = GL04_BROKEN.replace(
+        "def bad_balance(x, axis):",
+        'def bad_balance(x, axis):\n'
+        '        "crounds is handled by the caller, trust me"')
+    pkg = _mkpkg(tmp_path, {"parallel/sharded_walker.py": src})
+    got = [v for v in run_lint(pkg) if v.code == "GL04"]
+    assert [v.symbol for v in got] == ["bad_balance"]
+
+
+# ---------------------------------------------------------------------------
+# GL05 — static-arg drift
+# ---------------------------------------------------------------------------
+
+GL05_BROKEN = """
+    import functools
+    import jax
+    from typing import Callable
+
+    @functools.partial(jax.jit, static_argnames=("f", "epz"))
+    def run(x, *, f: Callable, eps: float = 1e-6):
+        return f(x) * eps
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def rep(x, *, n: int):
+        return x * n
+
+    def storm(xs):
+        out = []
+        for i in range(8):
+            out.append(rep(xs, n=i))          # recompiles per iter
+        return out
+
+    def fine(xs, n):
+        return [rep(x, n=n) for x in xs]      # static is loop-invariant
+"""
+
+
+def test_gl05_catches_all_three_drifts(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/cfg.py": GL05_BROKEN})
+    got = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL05")
+    assert got == ["run:eps:undeclared-static",
+                   "run:epz:not-a-param",
+                   "storm:rep.n:loop-varying"], got
+
+
+def test_gl05_positional_config_params_flagged(tmp_path):
+    # config leaks through positional-or-keyword params just the same
+    # as through keyword-only ones
+    pkg = _mkpkg(tmp_path, {"parallel/poscfg.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=())
+        def run(x, eps: float = 1e-7):
+            return x * eps
+    """})
+    got = [v for v in run_lint(pkg) if v.code == "GL05"]
+    assert [v.symbol for v in got] == ["run:eps:undeclared-static"]
+
+
+def test_gl05_call_sites_resolve_through_imports(tmp_path):
+    # bare-name coincidences must not match: an unresolvable
+    # obj.method(...) and a same-named NON-jitted local function are
+    # not the jitted `rep` — only the import-resolved call fires
+    pkg = _mkpkg(tmp_path, {
+        "parallel/cfg.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def rep(x, *, n: int):
+                return x * n
+        """,
+        "parallel/other.py": """
+            from pkg.parallel.cfg import rep
+
+            def local_storm(xs):
+                return [rep(xs, n=i) for i in range(4)]
+        """,
+        "parallel/decoy.py": """
+            def rep(x, *, n):
+                return x + n              # NOT jitted: loop-feeding ok
+
+            def fine(xs, obj):
+                out = []
+                for i in range(4):
+                    out.append(rep(xs, n=i))
+                    out.append(obj.rep(xs, n=i))   # unresolvable attr
+                return out
+        """,
+    })
+    got = [v for v in run_lint(pkg) if v.symbol.endswith("loop-varying")]
+    assert [v.symbol for v in got] == ["local_storm:rep.n:loop-varying"]
+    assert got[0].path.endswith("other.py")
+
+
+# ---------------------------------------------------------------------------
+# pragmas, baseline workflow, and the real package
+# ---------------------------------------------------------------------------
+
+def test_inline_pragma_suppresses(tmp_path):
+    src = GL02_BROKEN.replace(
+        "a = jnp.zeros(n)                      # dtype-less",
+        "a = jnp.zeros(n)  # graftlint: GL02 (shape probe)")
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": src})
+    syms = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL02")
+    assert "seed:dtype-less-zeros" not in syms
+
+
+def test_pragma_reason_cannot_escalate_to_off(tmp_path):
+    # "off" inside a parenthesized REASON is prose, not a directive:
+    # a GL03 pragma with such a reason must not suppress the line's
+    # GL02 violation too
+    src = GL02_BROKEN.replace(
+        "a = jnp.zeros(n)                      # dtype-less",
+        "a = jnp.zeros(n)  # graftlint: GL03 (off the hot path)")
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": src})
+    syms = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL02")
+    assert "seed:dtype-less-zeros" in syms
+
+
+def test_baseline_grandfathers_and_reports_stale(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    violations = run_lint(pkg)
+    bpath = str(tmp_path / "baseline.json")
+    write_baseline(bpath, violations)
+    baseline = load_baseline(bpath)
+    # all grandfathered: nothing new
+    new, known, stale = split_new_and_known(violations, baseline)
+    assert new == [] and len(known) == len(violations) and stale == []
+    # fix one site -> its entry is reported stale, still nothing new
+    fixed = GL02_BROKEN.replace("x.astype(jnp.float32)", "x")
+    (tmp_path / "pkg/parallel/num.py").write_text(textwrap.dedent(fixed))
+    new, known, stale = split_new_and_known(run_lint(pkg), baseline)
+    assert new == []
+    assert any("downcast:float32" in k for k in stale)
+
+
+def test_single_file_target_rejected(tmp_path):
+    # a lone-file lint would skip the cross-module and path-scoped
+    # rules and report a false clean — refuse it loudly
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    with pytest.raises(ValueError, match="package directory"):
+        run_lint(os.path.join(pkg, "parallel", "num.py"))
+
+
+def test_write_baseline_preserves_comment_block(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    bpath = tmp_path / "baseline.json"
+    bpath.write_text(json.dumps(
+        {"version": 1, "_comment": ["policy text"], "grandfathered": []}))
+    write_baseline(str(bpath), run_lint(pkg))
+    data = json.loads(bpath.read_text())
+    assert data["_comment"] == ["policy text"]
+    assert len(data["grandfathered"]) == 3
+
+
+def test_violation_keys_are_line_free(tmp_path):
+    # inserting code above a grandfathered site must not churn the key
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    k1 = {v.key for v in run_lint(pkg)}
+    (tmp_path / "pkg/parallel/num.py").write_text(
+        "# a new leading comment\n\n" + textwrap.dedent(GL02_BROKEN))
+    k2 = {v.key for v in run_lint(pkg)}
+    assert k1 == k2
+
+
+def test_real_package_clean_against_committed_baseline():
+    """The acceptance gate: ppls_tpu lints clean against the committed
+    allowlist — no new violations, no stale entries. This is the same
+    check tools/ci.sh step 4 runs."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = run_lint(os.path.join(repo, "ppls_tpu"))
+    baseline = load_baseline(
+        os.path.join(repo, "tools", "graftlint_baseline.json"))
+    new, known, stale = split_new_and_known(violations, baseline)
+    assert new == [], "\n".join(v.render() for v in new)
+    assert stale == [], stale
+
+
+def test_cli_exit_codes(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    env = dict(os.environ, PYTHONPATH=repo)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", pkg],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "NEW violation" in r.stdout
+    # with a full baseline the same tree is green
+    bpath = str(tmp_path / "b.json")
+    write_baseline(bpath, run_lint(pkg))
+    r2 = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", pkg,
+         "--baseline", bpath],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "0 new" in r2.stdout

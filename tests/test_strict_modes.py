@@ -1,0 +1,174 @@
+"""Strict-mode sanitizer lane (ISSUE 5).
+
+Three runtime contracts the static rules (tools/graftlint) cannot
+prove, pinned dynamically:
+
+* rank-promotion discipline: the whole suite runs with
+  ``jax_numpy_rank_promotion="raise"`` (tests/conftest.py) — these
+  tests pin that the flag is really live in-process, so a conftest
+  refactor can't silently turn the sanitizer off;
+* retracing guard: ``run_stream_cycle`` and the walker cycle
+  (``_run_cycles``) compile EXACTLY ONCE across a multi-phase streamed
+  run / a 2-leg kill-and-resume — the "one compiled program serves the
+  whole stream" claim, asserted on the pjit cache itself;
+* loud-NaN contract: a NaN integrand surfaces as a
+  ``FloatingPointError`` through admit -> walk -> retire, never as a
+  silently-wrong finite area; the opt-in ``PPLS_DEBUG_NANS=1`` lane
+  (conftest) tightens this to raise at the producing primitive, and
+  the injection test proves that mode end-to-end here regardless of
+  the env flag.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ppls_tpu.models.integrands import (get_family, get_family_ds,
+                                        register_family,
+                                        register_family_ds)
+from ppls_tpu.ops import ds_kernel as dsk
+from ppls_tpu.parallel.walker import (_run_cycles,
+                                      integrate_family_walker,
+                                      resume_family_walker,
+                                      run_stream_cycle)
+from ppls_tpu.runtime.stream import StreamEngine
+
+# the walker-test sizing (small, interpret-friendly)
+STREAM_KW = dict(slots=8, chunk=1 << 10, capacity=1 << 16, lanes=256,
+                 roots_per_lane=2, refill_slots=2, seg_iters=32,
+                 min_active_frac=0.05)
+WALK_KW = dict(capacity=1 << 16, lanes=256, roots_per_lane=1,
+               seg_iters=8, max_segments=1, max_cycles=256,
+               min_active_frac=0.05)
+BOUNDS = (1e-2, 1.0)
+EPS = 1e-7
+THETA = 1.0 + np.arange(4) / 4.0
+
+
+# ---------------------------------------------------------------------------
+# rank promotion
+# ---------------------------------------------------------------------------
+
+def test_rank_promotion_strict_mode_is_live():
+    """The sanitizer must actually be on in this process — not just
+    written in conftest. An implicit (2,2)+(2,) promotion must raise,
+    and the package import must not have flipped the flag back."""
+    assert jax.config.jax_numpy_rank_promotion == "raise"
+    with pytest.raises((ValueError, TypeError)):
+        _ = jnp.ones((2, 2), jnp.float64) + jnp.ones(2, jnp.float64)
+
+
+def test_explicit_broadcast_still_allowed():
+    # The strict mode forbids IMPLICIT rank promotion only: the
+    # explicit spellings the package uses ([None], broadcast_to)
+    # must keep working.
+    a = jnp.ones((2, 2), jnp.float64)
+    b = jnp.ones(2, jnp.float64)
+    out = a + b[None, :]
+    assert out.shape == (2, 2)
+    out2 = a + jnp.broadcast_to(b, (2, 2))
+    assert out2.shape == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# retracing guards (compile exactly once)
+# ---------------------------------------------------------------------------
+
+def test_stream_cycle_compiles_exactly_once(compile_once_guard):
+    """A multi-phase streamed run (6 requests over 6 arrival phases)
+    drives run_stream_cycle once per phase; the phase index is traced
+    and everything else is static-stable, so the pjit cache must hold
+    EXACTLY ONE entry at the end. A second entry = some config leaked
+    into the traced signature and the stream recompiles per phase."""
+    reqs = [(float(t), BOUNDS) for t in 1.0 + np.arange(6) / 6.0]
+    with compile_once_guard(run_stream_cycle):
+        eng = StreamEngine("sin_recip_scaled", EPS, **STREAM_KW)
+        res = eng.run(reqs, arrival_phase=[0, 1, 2, 3, 4, 5])
+    assert len(res.completed) == len(reqs)
+    assert res.phases >= 3
+
+
+def test_walker_resume_compiles_exactly_once(compile_once_guard,
+                                             tmp_path):
+    """A 2-leg kill-and-resume walker run calls _run_cycles once per
+    leg in the dying process and again per leg in the resuming one —
+    all with identical statics (max_cycles=checkpoint_every), so one
+    compiled program must serve every leg."""
+    f = get_family("sin_recip_scaled")
+    f_ds = get_family_ds("sin_recip_scaled")
+    path = str(tmp_path / "walker.ckpt")
+    with compile_once_guard(_run_cycles):
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            integrate_family_walker(
+                f, f_ds, THETA, BOUNDS, EPS, **WALK_KW,
+                checkpoint_path=path, checkpoint_every=2,
+                _crash_after_legs=1)
+        res = resume_family_walker(path, f, f_ds, THETA, BOUNDS, EPS,
+                                   **WALK_KW, checkpoint_every=2)
+    assert res.metrics.tasks > 0
+
+
+# ---------------------------------------------------------------------------
+# loud-NaN contract (admit -> walk -> retire)
+# ---------------------------------------------------------------------------
+
+def _nan_inject(x, th):
+    """th > 8 poisons the right half of the domain with NaN — the
+    injected fault for the loud-NaN contract. Healthy thetas are the
+    dyadic quadratic of the stream determinism tests."""
+    poisoned = (th > 8.0) & (x > 0.5)
+    return jnp.where(poisoned, jnp.nan, th * x * x)
+
+
+def _nan_inject_ds(x, th):
+    # ds twin (only engaged by the Pallas walker; the injection tests
+    # run the pure-f64 streaming mode where every value is f64)
+    return dsk.ds_mul(th, dsk.ds_mul(x, x))
+
+
+register_family("nan_inject_test", _nan_inject)
+register_family_ds("nan_inject_test", _nan_inject_ds)
+
+
+@pytest.mark.nan_injection
+def test_stream_nan_injection_surfaces_loudly():
+    """A NaN integrand must travel admit -> walk -> retire and raise
+    at retirement — NOT retire as a silently-wrong finite area, and
+    NOT poison the healthy co-resident request's accounting path.
+    (Pure-f64 streaming mode: in walker mode NaN-err roots are
+    deliberately kept live for re-breeding, which is the right
+    batch-engine behavior but would keep a permanently-NaN family
+    in-flight forever. nan_injection marker: this pins the RETIRE-path
+    contract, so debug-nans must not preempt the NaN's journey.)"""
+    kw = dict(STREAM_KW, f64_rounds=4)
+    eng = StreamEngine("nan_inject_test", 1e-9, **kw)
+    eng.submit(1.0, (0.0, 1.0))      # healthy
+    eng.submit(9.0, (0.0, 1.0))      # poisoned
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        eng.drain()
+
+
+def test_stream_nan_injection_debug_nans_lane():
+    """The jax_debug_nans lane tightens the contract: the
+    FloatingPointError fires at the PRODUCING primitive inside the
+    jitted phase program, before the NaN ever reaches an accumulator.
+    A healthy stream first proves the lane is usable (no false
+    positives), then the injected fault proves it is loud."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        kw = dict(STREAM_KW, f64_rounds=4)
+        healthy = StreamEngine("nan_inject_test", 1e-9, **kw)
+        res = healthy.run([(1.0, (0.0, 1.0)), (2.0, (0.0, 1.0))])
+        assert len(res.completed) == 2
+        assert np.all(np.isfinite(res.areas))
+
+        eng = StreamEngine("nan_inject_test", 1e-9, **kw)
+        eng.submit(9.0, (0.0, 1.0))
+        with pytest.raises(FloatingPointError):
+            eng.drain()
+    finally:
+        # restore, don't hardcode False: in the PPLS_DEBUG_NANS=1 lane
+        # the flag must stay ON for the rest of the suite
+        jax.config.update("jax_debug_nans", prev)

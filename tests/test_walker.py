@@ -286,6 +286,7 @@ def _quad_family(x, th):
     return th * x * x
 
 
+@pytest.mark.nan_injection
 def test_order_roots_nan_key_stays_in_live_prefix():
     """ADVICE r5 #1 regression: a live root whose one-step error
     estimate is NaN must stay INSIDE the live prefix of the sorted

@@ -1,0 +1,3 @@
+# Makes `python -m tools.graftlint` resolvable from the repo root.
+# The standalone scripts in this directory (bench helpers, check_artifacts)
+# are still run by path; only graftlint is a real subpackage.

@@ -4,6 +4,9 @@
 #   1. tier-1 forced-CPU test suite (the ROADMAP gate, verbatim)
 #   2. `pip install -e .` smoke + `ppls-tpu --help` console script
 #   3. artifact schema check (BENCH_r*/MULTICHIP_r* round JSONs)
+#   4. graftlint static analysis (GL01-GL05 vs the committed baseline)
+#   5. C hygiene smoke: csrc compiles under -Wall -Wextra -Werror
+#      (skipped with a visible notice when no compiler is present)
 #
 # Usage: bash tools/ci.sh            # from anywhere inside the repo
 #        PPLS_CI_SKIP_INSTALL=1 bash tools/ci.sh   # tests + schema only
@@ -57,6 +60,51 @@ if python tools/check_artifacts.py; then
 else
     echo "ci: artifact schema check FAILED"
     FAILURES=$((FAILURES + 1))
+fi
+
+# --- 4. graftlint: project-specific static analysis ---
+# New violations fail; grandfathered ones are enumerated in the
+# committed baseline (tools/graftlint_baseline.json). See BASELINE.md
+# "Static analysis & strict modes" for the rule set and the allowlist
+# workflow.
+step "graftlint static analysis (GL01-GL05)"
+if python -m tools.graftlint ppls_tpu \
+        --baseline tools/graftlint_baseline.json --quiet; then
+    echo "ci: graftlint OK"
+else
+    echo "ci: graftlint FAILED (new violations vs the baseline)"
+    FAILURES=$((FAILURES + 1))
+fi
+
+# --- 5. C hygiene: csrc must compile warning-free ---
+# The stub-linked MPI binary is part of the tier-1 surface
+# (test_backend.py runs the real farmer/worker protocol through it),
+# so warnings in csrc are latent test-lane breakage.
+step "C hygiene (-Wall -Wextra -Werror)"
+CC_BIN="${CC:-}"
+if [ -z "$CC_BIN" ]; then
+    for c in cc gcc clang; do
+        if command -v "$c" > /dev/null 2>&1; then CC_BIN="$c"; break; fi
+    done
+fi
+if [ -z "$CC_BIN" ]; then
+    echo "ci: NOTICE - no C compiler found (cc/gcc/clang); skipping" \
+         "the csrc hygiene step"
+else
+    CSRC="ppls_tpu/backends/csrc"
+    CH_DIR="$(mktemp -d)"
+    ch_fail=0
+    "$CC_BIN" -Wall -Wextra -Werror -O2 -DAQ_MPI_STUB -pthread \
+        -c "$CSRC/aquad_mpi.c" -o "$CH_DIR/mpi_stub.o" || ch_fail=1
+    "$CC_BIN" -Wall -Wextra -Werror -O2 \
+        -c "$CSRC/aquad_seq.c" -o "$CH_DIR/seq.o" || ch_fail=1
+    rm -rf "$CH_DIR"
+    if [ "$ch_fail" -ne 0 ]; then
+        echo "ci: C hygiene FAILED (warnings under -Wall -Wextra -Werror)"
+        FAILURES=$((FAILURES + 1))
+    else
+        echo "ci: C hygiene OK ($CC_BIN, stub + seq translation units)"
+    fi
 fi
 
 echo

@@ -1,0 +1,33 @@
+"""graftlint — project-specific static analysis for the ppls_tpu repro.
+
+The correctness contract this repo defends is tiny and absolute
+(``Area=7583461.801486``, 6567 tasks, bit-for-bit — PAPER.md), and the
+recurring bug classes that threaten it across ~14k LoC of jitted,
+sharded, streaming JAX are all *statically visible*:
+
+* GL01 — a carry field missing from the checkpoint identity surface
+  (the PR-2 ``refill_slots`` near-miss: resume silently blends runs);
+* GL02 — dtype-less array creation / f32 leakage in f64 accumulator
+  paths (silent downcasts move the final bit);
+* GL03 — host syncs (``jax.device_get``, ``np.asarray``, ``int()`` on
+  traced values) inside functions reachable from a jitted root;
+* GL04 — collectives in the dd engine not paired with a ``crounds``
+  increment (corrupts the device-counted collective-round claims);
+* GL05 — static-arg drift on jitted entries (missing statics trace
+  config into the program; loop-varying statics recompile per call).
+
+Violations are keyed ``CODE:path:symbol`` (no line numbers, so edits
+elsewhere in a file don't churn the baseline) and grandfathered sites
+live in a committed allowlist (``tools/graftlint_baseline.json``) with
+a reason per entry.  ``python -m tools.graftlint ppls_tpu --baseline
+tools/graftlint_baseline.json`` fails only on NEW violations.
+"""
+
+from tools.graftlint.core import (  # noqa: F401
+    LintModule,
+    Violation,
+    load_baseline,
+    run_lint,
+    split_new_and_known,
+)
+from tools.graftlint.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.graftlint ppls_tpu [--baseline FILE]``.
+
+Exit status 1 iff there are NEW violations (not in the baseline).
+Grandfathered violations are enumerated (they are debt, not noise);
+stale baseline entries (fixed sites still allowlisted) are reported so
+the baseline shrinks over time instead of fossilizing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.graftlint.core import (load_baseline, run_lint,
+                                  split_new_and_known, write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="project-specific static analysis (GL01-GL05)")
+    ap.add_argument("target",
+                    help="package directory to lint (single files are "
+                         "rejected: the rules are cross-module)")
+    ap.add_argument("--baseline", default=None,
+                    help="committed allowlist JSON; only violations "
+                         "absent from it fail the run")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from the current "
+                         "violations (preserves existing reasons)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the grandfathered listing")
+    args = ap.parse_args(argv)
+
+    try:
+        violations = run_lint(args.target)
+    except ValueError as e:
+        print(f"graftlint: error: {e}", file=sys.stderr)
+        return 2
+    baseline = load_baseline(args.baseline)
+
+    if args.write_baseline:
+        if not args.baseline:
+            ap.error("--write-baseline requires --baseline")
+        write_baseline(args.baseline, violations, reasons=baseline)
+        print(f"graftlint: wrote {len({v.key for v in violations})} "
+              f"grandfathered entries to {args.baseline}")
+        return 0
+
+    new, known, stale = split_new_and_known(violations, baseline)
+    if known and not args.quiet:
+        print(f"graftlint: {len(known)} grandfathered violation(s) "
+              f"(allowlisted in {args.baseline}):")
+        for v in known:
+            reason = baseline.get(v.key, "")
+            tail = f"  [allowlisted: {reason}]" if reason else ""
+            print(f"  {v.render()}{tail}")
+    if stale:
+        print(f"graftlint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (site fixed — "
+              f"remove from the allowlist):")
+        for k in stale:
+            print(f"  {k}")
+    if new:
+        print(f"graftlint: {len(new)} NEW violation(s):")
+        for v in new:
+            print(f"  {v.render()}")
+        print("graftlint: FAIL (fix the sites above, or — for a "
+              "reviewed, deliberate exception — add them to the "
+              "baseline with a reason)")
+        return 1
+    print(f"graftlint: OK ({len(violations)} total, "
+          f"{len(known)} grandfathered, 0 new)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

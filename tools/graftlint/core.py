@@ -1,0 +1,216 @@
+"""graftlint core: module loading, violation model, baseline handling.
+
+A *rule* is a callable ``rule(modules: list[LintModule]) ->
+Iterable[Violation]`` operating on parsed ASTs of the whole target
+package at once (GL01/GL03 are cross-function and cross-module checks,
+so rules see everything, not one file at a time).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    code: str        # "GL01".."GL05"
+    path: str        # target-relative posix path of the offending file
+    line: int        # 1-based line (display only — NOT part of the key)
+    symbol: str      # stable anchor: "func", "Class.field", "func:detail"
+    message: str     # fixer-friendly: what is wrong and what to do
+
+    @property
+    def key(self) -> str:
+        """Baseline identity. Deliberately line-free: grandfathered
+        sites must survive unrelated edits above them."""
+        return f"{self.code}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.code} [{self.symbol}] "
+                f"{self.message}")
+
+
+@dataclasses.dataclass
+class LintModule:
+    """One parsed source file plus its intra-package import bindings."""
+
+    path: str                # target-relative posix path
+    tree: ast.Module
+    source: str
+    # name -> package-relative module path ("parallel/walker") for
+    # `from ppls_tpu.parallel import walker` / `import ... as` aliases
+    module_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # local name -> (module path, original name) for
+    # `from ppls_tpu.parallel.walker import _breed as b`
+    name_imports: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def modkey(self) -> str:
+        """Package-relative module key: "parallel/walker"."""
+        p = self.path
+        if p.endswith("__init__.py"):
+            p = p[: -len("__init__.py")] + "__init__"
+        elif p.endswith(".py"):
+            p = p[:-3]
+        parts = p.split("/")
+        return "/".join(parts[1:]) if len(parts) > 1 else parts[0]
+
+
+def _resolve_pkg_module(dotted: str, pkg_name: str) -> Optional[str]:
+    """'ppls_tpu.parallel.walker' -> 'parallel/walker' (None if not in
+    the linted package)."""
+    parts = dotted.split(".")
+    if parts[0] != pkg_name:
+        return None
+    return "/".join(parts[1:]) if len(parts) > 1 else "__init__"
+
+
+def _collect_imports(mod: LintModule, pkg_name: str) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                target = _resolve_pkg_module(alias.name, pkg_name)
+                if target is not None:
+                    mod.module_aliases[alias.asname
+                                       or alias.name.split(".")[-1]] = target
+        elif isinstance(node, ast.ImportFrom):
+            if node.module is None or node.level:
+                continue          # relative imports are not used here
+            base = _resolve_pkg_module(node.module, pkg_name)
+            if base is None:
+                continue
+            for alias in node.names:
+                local = alias.asname or alias.name
+                # `from ppls_tpu.parallel import walker` imports a
+                # MODULE; `from ...walker import _breed` imports a name.
+                sub = (f"{base}/{alias.name}" if base != "__init__"
+                       else alias.name)
+                mod.module_aliases.setdefault(local, sub)
+                mod.name_imports[local] = (base, alias.name)
+
+
+def load_package(target: str) -> List[LintModule]:
+    """Parse every .py under ``target`` (a package directory). Paths in
+    violations are relative to the target's parent, so
+    "ppls_tpu/parallel/walker.py" reads naturally from the repo root.
+
+    Single files are rejected: the rules are cross-module (GL01 needs
+    ``runtime/checkpoint.py``'s surface, GL03 the import graph) and
+    path-scoped (GL02/GL04), so a lone-file lint would silently skip
+    most of them and report a false clean."""
+    target = os.path.normpath(target)
+    if os.path.isfile(target):
+        raise ValueError(
+            f"graftlint target must be a package directory, got the "
+            f"file {target!r}: the rules are cross-module and "
+            f"path-scoped — lint the package root instead")
+    root = os.path.dirname(target) or "."
+    files: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in ("__pycache__", "build")]
+        files.extend(os.path.join(dirpath, f)
+                     for f in sorted(filenames) if f.endswith(".py"))
+    pkg_name = os.path.basename(target.rstrip("/"))
+    modules = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(f, root).replace(os.sep, "/")
+        mod = LintModule(path=rel, tree=ast.parse(src, filename=f),
+                         source=src)
+        _collect_imports(mod, pkg_name)
+        modules.append(mod)
+    return modules
+
+
+# --- inline pragma suppression ---------------------------------------------
+
+def _pragma_lines(mod: LintModule) -> Dict[int, set]:
+    """Lines carrying ``# graftlint: GL02 (reason)`` (or
+    ``# graftlint: off``) pragmas -> set of suppressed codes ({"*"}
+    for off). Only the directive part before the first ``(`` counts:
+    a parenthesized reason like ``(off the hot path)`` must not
+    escalate a single-rule pragma to suppress everything."""
+    out: Dict[int, set] = {}
+    for i, line in enumerate(mod.source.splitlines(), start=1):
+        if "graftlint:" not in line:
+            continue
+        directive = line.split("graftlint:", 1)[1].split("(", 1)[0]
+        codes = {t.strip(" ,").upper() for t in directive.split()
+                 if t.strip(" ,")}
+        out[i] = {"*"} if "OFF" in codes else {c for c in codes
+                                               if c.startswith("GL")}
+    return out
+
+
+def run_lint(target: str, rules=None) -> List[Violation]:
+    from tools.graftlint.rules import ALL_RULES
+    modules = load_package(target)
+    pragmas = {m.path: _pragma_lines(m) for m in modules}
+    out: List[Violation] = []
+    for rule in (rules if rules is not None else ALL_RULES):
+        for v in rule(modules):
+            suppressed = pragmas.get(v.path, {}).get(v.line, set())
+            if "*" in suppressed or v.code in suppressed:
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.code, v.symbol))
+    return out
+
+
+# --- baseline ---------------------------------------------------------------
+
+def load_baseline(path: Optional[str]) -> Dict[str, str]:
+    """Committed allowlist: violation key -> reason string."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["key"]: e.get("reason", "") for e in data["grandfathered"]}
+
+
+def write_baseline(path: str, violations: Iterable[Violation],
+                   reasons: Optional[Dict[str, str]] = None) -> None:
+    reasons = reasons or {}
+    # regeneration must not destroy the committed file's documentation
+    # (_comment block) or any other top-level keys
+    doc: Dict[str, object] = {"version": 1}
+    if os.path.exists(path):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                old = json.load(fh)
+            doc.update({k: v for k, v in old.items()
+                        if k != "grandfathered"})
+        except (OSError, ValueError):
+            pass
+    entries = []
+    seen = set()
+    for v in violations:
+        if v.key in seen:
+            continue
+        seen.add(v.key)
+        entries.append({"key": v.key,
+                        "reason": reasons.get(v.key, ""),
+                        "message": v.message})
+    doc["grandfathered"] = entries
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+
+
+def split_new_and_known(violations: List[Violation],
+                        baseline: Dict[str, str]
+                        ) -> Tuple[List[Violation], List[Violation],
+                                   List[str]]:
+    """-> (new, grandfathered, stale_baseline_keys)."""
+    keys = {v.key for v in violations}
+    new = [v for v in violations if v.key not in baseline]
+    known = [v for v in violations if v.key in baseline]
+    stale = sorted(k for k in baseline if k not in keys)
+    return new, known, stale

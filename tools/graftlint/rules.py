@@ -1,0 +1,776 @@
+"""GL01-GL05: the project-specific rule set.
+
+Each rule is ``rule(modules) -> Iterable[Violation]`` over the parsed
+package (see core.py).  Rules are deliberately *structural* — they key
+off the repo's own conventions (carry NamedTuples, the
+``save_family_checkpoint`` identity surface, the ``crounds`` counter,
+``static_argnames`` declarations) rather than generic JAX style, which
+is what makes a committed baseline of a handful of reviewed sites
+possible instead of hundreds of generic warnings.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint.core import LintModule, Violation
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def iter_functions(tree: ast.Module
+                   ) -> Iterator[Tuple[str, ast.FunctionDef]]:
+    """Top-level functions and class methods as (qualname, node).
+    Nested closures stay inside their parent's subtree (a function's
+    "scope" for every rule below is its whole subtree)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); '' if not dotted."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _jit_statics(fn: ast.FunctionDef) -> Optional[Tuple[str, ...]]:
+    """If ``fn`` is decorated as a jitted entry, return its declared
+    static_argnames (possibly empty); None when not jitted.
+
+    Recognized forms: ``@jax.jit``, ``@jit``, and
+    ``@[functools.]partial(jax.jit, static_argnames=(...))``.
+    """
+    for dec in fn.decorator_list:
+        d = _dotted(dec)
+        if d in ("jax.jit", "jit"):
+            return ()
+        if isinstance(dec, ast.Call):
+            head = _dotted(dec.func)
+            if head not in ("functools.partial", "partial"):
+                continue
+            if not dec.args or _dotted(dec.args[0]) not in ("jax.jit",
+                                                            "jit"):
+                continue
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    return tuple(_const_strings(kw.value))
+            return ()
+    return None
+
+
+def _const_strings(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_const_strings(e))
+        return out
+    return []
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs]
+            + ([a.vararg.arg] if a.vararg else [])
+            + ([a.kwarg.arg] if a.kwarg else []))
+
+
+def _docstring_consts(node: ast.AST) -> Set[int]:
+    """ids of the Constant nodes that are docstrings anywhere under
+    ``node`` — prose must not count as code-level accounting: a
+    docstring *mentioning* a counter or a field name is not the same
+    as persisting/incrementing it."""
+    out: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Module)):
+            body = n.body
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _string_surface(node: ast.AST) -> Set[str]:
+    """Every way a field name can be 'mentioned' by snapshot code:
+    string constants (dict keys, tuple-of-names tables, np.savez keys)
+    and keyword-argument names (``dict(tasks=0)``, ``overflow=ovf``).
+    Docstrings are excluded — prose is not persistence."""
+    docs = _docstring_consts(node)
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and id(n) not in docs:
+            out.add(n.value)
+        elif isinstance(n, ast.keyword) and n.arg:
+            out.add(n.arg)
+    return out
+
+
+def _called_names(node: ast.AST) -> Set[str]:
+    """Simple callee names (both ``f(...)`` and ``mod.f(...)``)."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Name):
+                out.add(n.func.id)
+            elif isinstance(n.func, ast.Attribute):
+                out.add(n.func.attr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL01 — snapshot-identity completeness
+# ---------------------------------------------------------------------------
+
+_CHECKPOINT_APIS = {
+    "save_family_checkpoint", "load_family_checkpoint",
+    "save_checkpoint", "load_checkpoint",
+    "_family_identity", "_family_ckpt_identity", "_stream_identity",
+    "_dd_ckpt_identity",
+}
+_SNAPSHOT_NAME_RE = re.compile(r"identity|checkpoint|snapshot|resume",
+                               re.IGNORECASE)
+
+# Spelling bridges between carry fields and their on-disk names.  Kept
+# deliberately tiny: a rename that breaks one of these should be FELT.
+_GL01_ALIASES: Dict[str, Set[str]] = {
+    "bag": {"bag_cols"},
+    "bag_l": {"l"}, "bag_r": {"r"}, "bag_th": {"th"},
+    "bag_meta": {"meta"},
+    "maxd": {"max_depth"},
+}
+
+
+def _carry_classes(mod: LintModule
+                   ) -> List[Tuple[ast.ClassDef, List[Tuple[str, int]]]]:
+    """NamedTuple/dataclass definitions named ``*Carry`` with their
+    (field, line) lists."""
+    out = []
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Carry")):
+            continue
+        is_nt = any(_dotted(b).split(".")[-1] == "NamedTuple"
+                    for b in node.bases)
+        is_dc = any(_dotted(d).split(".")[-1] == "dataclass"
+                    or (isinstance(d, ast.Call)
+                        and _dotted(d.func).split(".")[-1] == "dataclass")
+                    for d in node.decorator_list)
+        if not (is_nt or is_dc):
+            continue
+        fields = [(s.target.id, s.lineno) for s in node.body
+                  if isinstance(s, ast.AnnAssign)
+                  and isinstance(s.target, ast.Name)]
+        out.append((node, fields))
+    return out
+
+
+def rule_gl01(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL01: every field of every walker/stream/dd carry container must
+    be represented on the checkpoint identity surface.
+
+    The PR-2 near-miss this encodes: ``refill_slots`` changed the
+    meaning of the persisted state but was not part of the snapshot
+    identity, so a refill snapshot could silently resume a legacy run.
+    Mechanically: for each ``*Carry`` NamedTuple/dataclass that is
+    referenced by the module's snapshot code (directly, or by a
+    function the snapshot code calls — the run entry whose result gets
+    persisted), every field name must appear among the string
+    constants / keyword names of the snapshot functions themselves (or
+    of ``runtime/checkpoint.py``), modulo the tiny documented alias
+    map.  A field the snapshot surface never mentions is state the
+    resume path cannot restore."""
+    global_surface: Set[str] = set()
+    for mod in modules:
+        if mod.path.endswith("runtime/checkpoint.py"):
+            global_surface |= _string_surface(mod.tree)
+    for mod in modules:
+        carries = _carry_classes(mod)
+        if not carries:
+            continue
+        funcs = dict(iter_functions(mod.tree))
+        contributing = {
+            qn: fn for qn, fn in funcs.items()
+            if _SNAPSHOT_NAME_RE.search(qn)
+            or (_called_names(fn) & _CHECKPOINT_APIS)
+        }
+        if not contributing:
+            continue
+        surface = set(global_surface)
+        referencing: List[ast.AST] = []
+        one_hop: Set[str] = set()
+        for fn in contributing.values():
+            surface |= _string_surface(fn)
+            referencing.append(fn)
+            one_hop |= _called_names(fn)
+        for qn, fn in funcs.items():
+            if qn in one_hop and qn not in contributing:
+                referencing.append(fn)
+        in_scope_names: Set[str] = set()
+        for node in referencing:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name):
+                    in_scope_names.add(n.id)
+        for cls, fields in carries:
+            if cls.name not in in_scope_names:
+                continue        # kernel-internal carry, never persisted
+            for field, line in fields:
+                names = {field} | _GL01_ALIASES.get(field, set())
+                if names & surface:
+                    continue
+                yield Violation(
+                    code="GL01", path=mod.path, line=line,
+                    symbol=f"{cls.name}.{field}",
+                    message=(
+                        f"carry field {cls.name}.{field} is absent from "
+                        f"the snapshot identity surface: no snapshot/"
+                        f"identity function in this module mentions "
+                        f"{sorted(names)} — a resumed run cannot "
+                        f"restore it. Persist it (bag_cols/totals/"
+                        f"identity), or allowlist with the reason it "
+                        f"is derived state."))
+
+
+# ---------------------------------------------------------------------------
+# GL02 — f64 dtype discipline
+# ---------------------------------------------------------------------------
+
+# Creation calls whose dtype defaults are config-dependent (f32 without
+# jax_enable_x64).  jnp.array/asarray are only flagged for literal
+# payloads: wrapping an existing traced array inherits its dtype.
+_GL02_CREATORS = {"zeros", "ones", "empty", "full", "arange",
+                  "linspace"}
+_GL02_DTYPE_POSITION = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                        "array": 1, "asarray": 1}
+# The ds (double-double) representation IS a pair of f32 limbs: its
+# kernels are f32 by construction, not by accident.
+_GL02_F32_EXEMPT = re.compile(r"ops/(ds_kernel|pow2|ds)\.py$")
+
+
+def _is_literal_payload(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex, bool))
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literal_payload(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal_payload(node.operand)
+    return False
+
+
+def rule_gl02(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL02: f64 dtype discipline in ``parallel/`` and ``ops/``.
+
+    Flags (a) dtype-less ``jnp.zeros/ones/empty/full/arange/linspace``
+    and literal-payload ``jnp.array/asarray`` — their dtype is whatever
+    ``jax_enable_x64`` happens to be, i.e. f32 in any embedding that
+    forgot the flag, silently downcasting an accumulator path; and
+    (b) ``float32`` references outside the ds-limb modules (ds kernels
+    are f32 *by representation*; everywhere else f32 in a numeric path
+    is a downcast hazard).  Literal arithmetic (``0.5 * x``) is NOT
+    flagged: under weak typing literals adopt the array operand's
+    dtype, so the hazard is creation, not arithmetic."""
+    for mod in modules:
+        if "/parallel/" not in "/" + mod.path \
+                and "/ops/" not in "/" + mod.path:
+            continue
+        f32_hits: Dict[str, Tuple[int, int]] = {}
+        for qn, fn in iter_functions(mod.tree):
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    head = _dotted(n.func)
+                    parts = head.split(".")
+                    if len(parts) == 2 and parts[0] in ("jnp", "jax_np"):
+                        name = parts[1]
+                        has_dtype = any(kw.arg == "dtype"
+                                        for kw in n.keywords)
+                        pos = _GL02_DTYPE_POSITION.get(name)
+                        if pos is not None and len(n.args) > pos:
+                            has_dtype = True
+                        if name in _GL02_CREATORS and not has_dtype \
+                                and name not in ("array", "asarray"):
+                            yield Violation(
+                                code="GL02", path=mod.path,
+                                line=n.lineno,
+                                symbol=f"{qn}:dtype-less-{name}",
+                                message=(
+                                    f"jnp.{name}(...) without an "
+                                    f"explicit dtype in a numeric "
+                                    f"path: the result is f32 unless "
+                                    f"jax_enable_x64 is set — pass "
+                                    f"dtype=jnp.float64 (or the "
+                                    f"intended integer dtype)."))
+                        elif name in ("array", "asarray") \
+                                and not has_dtype and n.args \
+                                and _is_literal_payload(n.args[0]):
+                            yield Violation(
+                                code="GL02", path=mod.path,
+                                line=n.lineno,
+                                symbol=f"{qn}:dtype-less-{name}",
+                                message=(
+                                    f"jnp.{name}(<literal>) without "
+                                    f"dtype: literal payloads default "
+                                    f"to the x64-flag dtype — make "
+                                    f"the f64 (or integer) intent "
+                                    f"explicit."))
+                if not _GL02_F32_EXEMPT.search(mod.path):
+                    is_f32 = (
+                        (isinstance(n, ast.Attribute)
+                         and n.attr == "float32")
+                        or (isinstance(n, ast.Constant)
+                            and n.value == "float32"))
+                    if is_f32 and qn not in f32_hits:
+                        f32_hits[qn] = (n.lineno, 1)
+                    elif is_f32:
+                        line, cnt = f32_hits[qn]
+                        f32_hits[qn] = (line, cnt + 1)
+        for qn, (line, cnt) in f32_hits.items():
+            yield Violation(
+                code="GL02", path=mod.path, line=line,
+                symbol=f"{qn}:float32",
+                message=(
+                    f"{cnt} float32 reference(s) in {qn}: f32 in a "
+                    f"numeric path silently downcasts the f64 "
+                    f"accumulator chain. If the f32 is deliberate "
+                    f"(ds limbs, lane-state packing), allowlist this "
+                    f"function with that reason."))
+
+
+# ---------------------------------------------------------------------------
+# GL03 — host syncs reachable from jitted roots
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_ATTRS = {"device_get", "device_put", "block_until_ready",
+                    "item", "tolist"}
+_NP_ALIASES = {"np", "numpy", "onp"}
+
+
+def _jit_roots(mod: LintModule
+               ) -> List[Tuple[str, ast.FunctionDef, Tuple[str, ...]]]:
+    """Jitted entries of a module: decorated defs, plus local function
+    names passed (possibly through wrappers like ``shard_map_compat``)
+    into a ``jax.jit(...)`` call — the builder pattern the sharded
+    engines use."""
+    roots = []
+    for qn, fn in iter_functions(mod.tree):
+        statics = _jit_statics(fn)
+        if statics is not None:
+            roots.append((qn, fn, statics))
+    local_defs: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(mod.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs.setdefault(n.name, n)
+    seen = {qn for qn, _, _ in roots}
+
+    def names_in(node):
+        for x in ast.walk(node):
+            if isinstance(x, ast.Name):
+                yield x.id
+
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and _dotted(n.func) in ("jax.jit",
+                                                           "jit"):
+            for arg in n.args[:1]:
+                for name in names_in(arg):
+                    fn = local_defs.get(name)
+                    if fn is not None and name not in seen:
+                        seen.add(name)
+                        statics = tuple(
+                            s for kw in n.keywords
+                            if kw.arg in ("static_argnames",
+                                          "static_argnums")
+                            for s in _const_strings(kw.value))
+                        roots.append((name, fn, statics))
+    return roots
+
+
+def _build_call_index(modules: List[LintModule]
+                      ) -> Dict[str, Dict[str, ast.FunctionDef]]:
+    """modkey -> {top-level function/method name -> node}."""
+    return {m.modkey: dict(iter_functions(m.tree)) for m in modules}
+
+
+def _resolve_callee(mod: LintModule, call: ast.Call,
+                    index: Dict[str, Dict[str, ast.FunctionDef]]
+                    ) -> Optional[Tuple[str, str]]:
+    """(modkey, qualname) of an intra-package callee, else None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in index.get(mod.modkey, {}):
+            return mod.modkey, f.id
+        imp = mod.name_imports.get(f.id)
+        if imp is not None:
+            base, orig = imp
+            if orig in index.get(base, {}):
+                return base, orig
+        return None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        target_mod = mod.module_aliases.get(f.value.id)
+        if target_mod is not None and f.attr in index.get(target_mod,
+                                                          {}):
+            return target_mod, f.attr
+    return None
+
+
+def _static_name_pool(modules: List[LintModule]) -> Set[str]:
+    """Union of every declared static argname in the package: a name
+    in this pool passed to ``int()`` inside a traced body is a
+    trace-time config coercion, not a host sync."""
+    pool: Set[str] = set()
+    for mod in modules:
+        for _, fn, statics in _jit_roots(mod):
+            pool.update(statics)
+    return pool
+
+
+def _arg_is_trace_safe(node: ast.AST, static_pool: Set[str]) -> bool:
+    """int()/float() args that are NOT host syncs: constants, shape
+    reads (static under tracing), and static-config names."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                       "dtype"):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            return True
+    names = [n.id for n in ast.walk(node) if isinstance(n, ast.Name)]
+    if names and all(nm in static_pool for nm in names):
+        return True
+    return not names    # pure-constant expression
+
+
+def rule_gl03(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL03: host synchronization inside the traced hot path.
+
+    Walks the intra-package call graph from every jitted root (the
+    ``@jax.jit`` entries of walker.py/stream.py and the
+    ``jax.jit(shard_map_compat(...))`` builders of the sharded
+    engines) and flags, in any reachable function body:
+    ``jax.device_get/device_put``, ``.block_until_ready()``,
+    ``.item()/.tolist()``, ``np.*`` calls on non-constant arguments,
+    and ``int()/float()/bool()`` coercions of traced values.  Under
+    ``jit`` these either fail at trace time in the best case or —
+    with AOT-style retracing — force a device round-trip per cycle in
+    the hot loop, which is exactly the failure mode the device-counted
+    ``crounds``/phase claims exist to rule out."""
+    index = _build_call_index(modules)
+    mod_by_key = {m.modkey: m for m in modules}
+    static_pool = _static_name_pool(modules)
+    # nested defs too: builder-pattern roots (jax.jit(wrap(body)) where
+    # body is a closure) are not top-level functions
+    all_defs: Dict[str, Dict[str, ast.FunctionDef]] = {}
+    for m in modules:
+        d: Dict[str, ast.FunctionDef] = {}
+        for n in ast.walk(m.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                d.setdefault(n.name, n)
+        all_defs[m.modkey] = d
+
+    def _lookup(modkey: str, qn: str) -> Optional[ast.FunctionDef]:
+        return index[modkey].get(qn) or all_defs[modkey].get(qn)
+    # BFS the reachable set
+    queue: List[Tuple[str, str]] = []
+    root_set: Set[Tuple[str, str]] = set()
+    for mod in modules:
+        for qn, fn, _ in _jit_roots(mod):
+            queue.append((mod.modkey, qn))
+            root_set.add((mod.modkey, qn))
+    visited: Set[Tuple[str, str]] = set()
+    while queue:
+        key = queue.pop()
+        if key in visited:
+            continue
+        visited.add(key)
+        modkey, qn = key
+        mod = mod_by_key[modkey]
+        fn = _lookup(modkey, qn)
+        if fn is None:
+            continue
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                callee = _resolve_callee(mod, n, index)
+                if callee is not None and callee not in visited:
+                    queue.append(callee)
+    for modkey, qn in sorted(visited):
+        mod = mod_by_key[modkey]
+        fn = _lookup(modkey, qn)
+        if fn is None:
+            continue
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            head = _dotted(n.func)
+            parts = head.split(".")
+            sync = None
+            if head in ("jax.device_get", "jax.device_put"):
+                sync = head
+            elif isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("block_until_ready", "item",
+                                        "tolist"):
+                sync = f".{n.func.attr}()"
+            elif len(parts) == 2 and parts[0] in _NP_ALIASES:
+                # np.float32(eps) on a static config name is trace-time
+                # constant construction, not a sync
+                if any(not _arg_is_trace_safe(a, static_pool)
+                       for a in n.args):
+                    sync = head
+            elif isinstance(n.func, ast.Name) \
+                    and n.func.id in ("int", "float", "bool") \
+                    and n.args \
+                    and not _arg_is_trace_safe(n.args[0], static_pool):
+                sync = f"{n.func.id}()"
+            if sync is None:
+                continue
+            yield Violation(
+                code="GL03", path=mod.path, line=n.lineno,
+                symbol=f"{qn}:{sync}",
+                message=(
+                    f"{sync} inside {qn}, which is reachable from a "
+                    f"jitted root: a host sync in the traced hot path "
+                    f"either breaks tracing or forces a device "
+                    f"round-trip per cycle. Hoist it to the host "
+                    f"driver, or allowlist with the reason it only "
+                    f"runs at trace time."))
+
+
+# ---------------------------------------------------------------------------
+# GL04 — uncounted collectives in the dd engine
+# ---------------------------------------------------------------------------
+
+_COLLECTIVES = {"psum", "all_gather", "ppermute", "pmax", "pmin",
+                "pmean", "psum_scatter", "all_to_all"}
+_GL04_SCOPE = re.compile(r"(sharded_walker|mesh)\.py$")
+
+
+def rule_gl04(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL04: every collective in the dd engine must be paired with
+    ``crounds`` accounting.
+
+    The dd walker's headline claim (2.4-3.0 collective rounds/cycle vs
+    legacy's 7-10.5) is backed by the device-counted ``crounds``
+    counter; a collective added without touching ``crounds`` silently
+    falsifies that accounting.  Mechanically: any top-level function in
+    ``sharded_walker.py``/``mesh.py`` whose subtree performs a
+    ``lax.psum/all_gather/ppermute/...`` must also reference
+    ``crounds`` somewhere in the same subtree (increment, carry field,
+    or an explicit pass-through).  Primitives whose collectives are
+    counted by their caller belong in the allowlist with that reason.
+    """
+    for mod in modules:
+        if not _GL04_SCOPE.search(mod.path):
+            continue
+        for qn, fn in iter_functions(mod.tree):
+            hits: List[ast.Call] = []
+            counted = False
+            docs = _docstring_consts(fn)
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    head = _dotted(n.func)
+                    parts = head.split(".")
+                    if (parts[-1] in _COLLECTIVES
+                            and (len(parts) == 1
+                                 or parts[-2] in ("lax", "jax"))):
+                        hits.append(n)
+                if isinstance(n, ast.Name) and "crounds" in n.id:
+                    counted = True
+                elif isinstance(n, ast.Attribute) \
+                        and "crounds" in n.attr:
+                    counted = True
+                elif isinstance(n, ast.keyword) and n.arg \
+                        and "crounds" in n.arg:
+                    counted = True
+                elif isinstance(n, ast.Constant) \
+                        and isinstance(n.value, str) \
+                        and "crounds" in n.value \
+                        and id(n) not in docs:
+                    # a docstring saying "crounds is handled by the
+                    # caller" is prose — the allowlist (with a
+                    # reviewable reason) is the only sanctioned
+                    # caller-counts-it escape hatch
+                    counted = True
+            if hits and not counted:
+                yield Violation(
+                    code="GL04", path=mod.path, line=hits[0].lineno,
+                    symbol=qn,
+                    message=(
+                        f"{qn} performs {len(hits)} collective(s) "
+                        f"(lax.psum/all_gather/...) but never touches "
+                        f"the crounds counter: the device-counted "
+                        f"collective-round claims no longer cover "
+                        f"this path. Increment crounds at the "
+                        f"boundary, or allowlist with the reason the "
+                        f"caller counts it."))
+
+
+# ---------------------------------------------------------------------------
+# GL05 — static-arg drift
+# ---------------------------------------------------------------------------
+
+_HASHABLE_ANNOTATIONS = {"int", "float", "bool", "str", "Callable",
+                         "Rule"}
+
+
+def _is_config_param(arg: ast.arg, default: Optional[ast.AST]) -> bool:
+    ann = arg.annotation
+    if ann is not None:
+        if _dotted(ann).split(".")[-1] in _HASHABLE_ANNOTATIONS:
+            return True
+        # Callable[..., X] — subscripted form
+        if isinstance(ann, ast.Subscript) \
+                and _dotted(ann.value).split(".")[-1] == "Callable":
+            return True
+    if default is not None and isinstance(default, ast.Constant) \
+            and isinstance(default.value, (int, float, bool, str)) \
+            and default.value is not None:
+        return True
+    return False
+
+
+def rule_gl05(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL05: static-arg drift on jitted entries.
+
+    Three drifts, all of which have bitten jitted-config code before:
+    (a) a name in ``static_argnames`` that is no longer a parameter —
+    silently ignored by jax, so the "static" silently became traced
+    after a rename; (b) a hashable config parameter (Callable / int /
+    float / bool / str / Rule annotation, or scalar default) that is
+    NOT declared static — Callables fail at trace time, scalars trace
+    into the program and change numerics-by-config into
+    numerics-by-input; (c) a call site feeding a declared static from
+    an enclosing loop variable — one recompile per iteration, the
+    recompile-storm shape."""
+    # (modkey, bare name) -> statics, so same-named jitted functions in
+    # different modules don't shadow each other, and call sites resolve
+    # through the import bindings instead of by bare-name guesswork
+    jit_sigs: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+    for mod in modules:
+        for qn, fn, statics in _jit_roots(mod):
+            jit_sigs[(mod.modkey, qn.split(".")[-1])] = statics
+            params = set(_param_names(fn))
+            for s in statics:
+                if s not in params:
+                    yield Violation(
+                        code="GL05", path=mod.path, line=fn.lineno,
+                        symbol=f"{qn}:{s}:not-a-param",
+                        message=(
+                            f"static_argnames entry {s!r} of {qn} is "
+                            f"not a parameter: jax ignores unknown "
+                            f"names, so after a rename the value is "
+                            f"silently traced. Fix the declaration."))
+            # hashable config params anywhere in the signature:
+            # keyword-only (the dominant convention here) AND annotated
+            # / scalar-defaulted positional-or-keyword params — a
+            # jitted `def f(x, eps: float = 1e-7)` leaks config into
+            # the traced signature just the same
+            pos = fn.args.posonlyargs + fn.args.args
+            pos_defaults = [None] * (len(pos) - len(fn.args.defaults)) \
+                + list(fn.args.defaults)
+            candidates = list(zip(pos, pos_defaults)) \
+                + list(zip(fn.args.kwonlyargs, fn.args.kw_defaults))
+            for arg, default in candidates:
+                if arg.arg in statics:
+                    continue
+                if _is_config_param(arg, default):
+                    yield Violation(
+                        code="GL05", path=mod.path, line=arg.lineno,
+                        symbol=f"{qn}:{arg.arg}:undeclared-static",
+                        message=(
+                            f"keyword-only config param {arg.arg!r} "
+                            f"of jitted {qn} is hashable "
+                            f"(annotation/default) but not in "
+                            f"static_argnames: a Callable here fails "
+                            f"at trace time, a scalar gets traced "
+                            f"and varies the compiled program's "
+                            f"numerics per call. Declare it static "
+                            f"or drop the config flavor."))
+    def _callee_statics(mod: LintModule, call: ast.Call
+                        ) -> Tuple[Optional[str],
+                                   Optional[Tuple[str, ...]]]:
+        """(display name, statics) when the call site resolves to a
+        known jitted function via this module's bindings; (None, None)
+        otherwise — an unresolvable ``obj.method(...)`` must not match
+        a jitted function that happens to share the bare name."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if (mod.modkey, f.id) in jit_sigs:
+                return f.id, jit_sigs[(mod.modkey, f.id)]
+            imp = mod.name_imports.get(f.id)
+            if imp is not None and imp in jit_sigs:
+                return f.id, jit_sigs[imp]
+        elif isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name):
+            target_mod = mod.module_aliases.get(f.value.id)
+            if target_mod is not None \
+                    and (target_mod, f.attr) in jit_sigs:
+                return f.attr, jit_sigs[(target_mod, f.attr)]
+        return None, None
+
+    # (c) loop-varying statics at call sites, package-wide
+    for mod in modules:
+
+        def scan(node: ast.AST, loop_targets: Set[str], qn: str):
+            for child in ast.iter_child_nodes(node):
+                targets = loop_targets
+                if isinstance(child, ast.For):
+                    targets = loop_targets | {
+                        n.id for n in ast.walk(child.target)
+                        if isinstance(n, ast.Name)}
+                elif isinstance(child, (ast.ListComp, ast.SetComp,
+                                        ast.GeneratorExp, ast.DictComp)):
+                    # a call per comprehension element is the same
+                    # recompile storm as a for-statement body
+                    targets = loop_targets | {
+                        n.id for g in child.generators
+                        for n in ast.walk(g.target)
+                        if isinstance(n, ast.Name)}
+                if isinstance(child, ast.Call):
+                    name, statics = _callee_statics(mod, child)
+                    if statics:
+                        for kw in child.keywords:
+                            if kw.arg not in statics:
+                                continue
+                            used = {n.id for n in ast.walk(kw.value)
+                                    if isinstance(n, ast.Name)}
+                            bad = used & loop_targets
+                            if bad:
+                                yield Violation(
+                                    code="GL05", path=mod.path,
+                                    line=child.lineno,
+                                    symbol=(f"{qn}:{name}."
+                                            f"{kw.arg}:loop-varying"),
+                                    message=(
+                                        f"call to jitted {name} "
+                                        f"feeds static arg "
+                                        f"{kw.arg!r} from loop "
+                                        f"variable(s) "
+                                        f"{sorted(bad)}: one "
+                                        f"recompile per iteration "
+                                        f"(recompile storm). Hoist "
+                                        f"the value or make the "
+                                        f"arg traced."))
+                yield from scan(child, targets, qn)
+
+        for qn, fn in iter_functions(mod.tree):
+            yield from scan(fn, set(), qn)
+
+
+ALL_RULES = (rule_gl01, rule_gl02, rule_gl03, rule_gl04, rule_gl05)
