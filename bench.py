@@ -1062,15 +1062,26 @@ def bench_stream(k: int = 24, quick=None) -> dict:
     # --- saturated stream: all K admitted at phase 0 ---
     log("[bench-stream] saturated stream ...")
     StreamEngine(family, eps, **ekw).run(reqs)            # compile
-    res = StreamEngine(family, eps, **ekw).run(reqs)
+    eng = StreamEngine(family, eps, **ekw)
+    res = eng.run(reqs)
     lanes = ekw.get("lanes", 1 << 14)
-    stream_rate = res.totals["tasks"] / res.wall_s if res.wall_s else 0
+    # Registry-sourced counters (round 10): every number below reads
+    # the engine's telemetry registry — the identical accounting the
+    # serve summary and the --metrics-port endpoint expose — instead
+    # of a bench-local re-sum of the phase rows. (res.totals is itself
+    # registry-sourced; reading through reg here makes the dependency
+    # explicit and lets the test pin bench == registry == endpoint.)
+    reg = eng.telemetry.registry
+    stream_tasks = reg.value("ppls_stream_tasks_total")
+    stream_rate = stream_tasks / res.wall_s if res.wall_s else 0
     vs_batch = stream_rate / batch_rate if batch_rate else 0.0
     vs_cold = cold_wall / res.wall_s if res.wall_s else 0.0
     stream_proxy = {"phases": res.phases,
-                    "rounds_plus_segs": int(res.totals["rounds"]
-                                            + res.totals["segs"]),
-                    "kernel_steps": int(res.totals["wsteps"])}
+                    "rounds_plus_segs": int(
+                        reg.value("ppls_stream_rounds_total")
+                        + reg.value("ppls_stream_segs_total")),
+                    "kernel_steps": int(
+                        reg.value("ppls_stream_wsteps_total"))}
     boundary_ratio = (cold_proxy["rounds_plus_segs"]
                       / max(stream_proxy["rounds_plus_segs"], 1))
     worst = float(np.max(np.abs(res.areas - cold_areas)))

@@ -185,6 +185,21 @@ def build_parser() -> argparse.ArgumentParser:
                           "written every --checkpoint-every phases); "
                           "resumes from it if it exists")
     srv.add_argument("--checkpoint-every", type=int, default=8)
+    srv.add_argument("--events", default=None, metavar="FILE",
+                     help="structured JSONL event log (obs.spans): the "
+                          "run -> phase span timeline with admit/"
+                          "retire/checkpoint events and device-counter "
+                          "deltas attached; schema-validated shape "
+                          "(tools/check_artifacts.py --events FILE); a "
+                          "resumed run APPENDS a new segment")
+    srv.add_argument("--metrics-port", type=int, default=None,
+                     metavar="PORT",
+                     help="serve Prometheus-style exposition text "
+                          "(queue depth, slot occupancy, per-phase "
+                          "counters, compile-cache size, rolling "
+                          "p50/p99 retire latency) on 127.0.0.1:PORT "
+                          "for the lifetime of the run (0 = ephemeral "
+                          "port, printed to stderr)")
     srv.add_argument("--watchdog", type=float, default=None,
                      metavar="SECONDS",
                      help="hang watchdog around the serve loop "
@@ -404,17 +419,58 @@ def _main_serve(args) -> int:
     if args.lanes:
         kw["lanes"] = args.lanes
 
+    # Unified telemetry (round 10): one Telemetry handle per engine
+    # attempt — registry (served live on --metrics-port) + the --events
+    # span timeline. Built inside make_engine so a watchdog retry gets
+    # a fresh registry (the resume replay rebuilds its deterministic
+    # totals) and the events file gains an appended resume segment
+    # instead of clobbering the pre-crash timeline.
+    holder = {}
+
     def make_engine():
+        from ppls_tpu.obs import Telemetry
         from ppls_tpu.runtime.stream import StreamEngine
-        if args.checkpoint and os.path.exists(args.checkpoint):
+        resuming = bool(args.checkpoint
+                        and os.path.exists(args.checkpoint))
+        if "tel" in holder:
+            # watchdog retry: release the previous attempt's events
+            # file handle before the new segment opens it (the stale
+            # attempt cannot be killed — guard.py's contract — but its
+            # tracer must not keep the fh alive past this point)
+            holder["tel"].close()
+        tel = Telemetry(
+            events_path=args.events,
+            meta={"mode": "serve", "engine": args.engine,
+                  "family": args.family, "eps": args.eps,
+                  "rule": args.rule, "slots": args.slots,
+                  "lanes": args.lanes or 0, "seed": args.seed,
+                  "requests": len(reqs), "resumed": resuming},
+            append=resuming)
+        holder["tel"] = tel
+        if resuming:
             return StreamEngine.resume(args.checkpoint, args.family,
-                                       args.eps, **kw)
+                                       args.eps, telemetry=tel, **kw)
         return StreamEngine(args.family, args.eps,
-                            checkpoint_path=args.checkpoint, **kw)
+                            checkpoint_path=args.checkpoint,
+                            telemetry=tel, **kw)
+
+    metrics_srv = None
+    if args.metrics_port is not None:
+        from ppls_tpu.obs import MetricsRegistry, MetricsServer
+        _empty = MetricsRegistry()
+        metrics_srv = MetricsServer(
+            lambda: (holder["tel"].registry if "tel" in holder
+                     else _empty),
+            port=args.metrics_port)
+        print(f"serve: metrics on {metrics_srv.url}", file=sys.stderr,
+              flush=True)
 
     def serve_loop():
         t0 = time.perf_counter()
         eng = make_engine()
+        span = eng.telemetry.span("run", mode="serve",
+                                  engine=f"{args.engine}-stream",
+                                  requests=len(reqs))
         # rids are assigned in submission order, so a resumed engine
         # skips the prefix it already submitted before the crash
         k = eng.next_rid
@@ -431,32 +487,43 @@ def _main_serve(args) -> int:
                     "phases_in_flight": c.phases_in_flight,
                     "latency_phases": c.latency_phases,
                     "latency_s": round(c.latency_s, 4)}), flush=True)
+        span.close(phases=eng.phase, completed=len(eng.completed))
         return eng, time.perf_counter() - t0
 
-    if args.watchdog:
-        from ppls_tpu.runtime.guard import run_with_watchdog
-        eng, wall = run_with_watchdog(
-            serve_loop, args.watchdog, what="serve loop",
-            resume_fn=serve_loop if args.checkpoint else None)
-    else:
-        eng, wall = serve_loop()
+    try:
+        if args.watchdog:
+            from ppls_tpu.runtime.guard import run_with_watchdog
+            eng, wall = run_with_watchdog(
+                serve_loop, args.watchdog, what="serve loop",
+                resume_fn=serve_loop if args.checkpoint else None)
+        else:
+            eng, wall = serve_loop()
 
-    if args.checkpoint:
-        eng.clear_snapshot()
-    res = eng.result(wall_s=wall)
-    summary = {
-        "summary": True,
-        "engine": args.engine, "family": args.family, "eps": args.eps,
-        "rule": args.rule, "slots": args.slots,
-        "completed": len(res.completed), "phases": res.phases,
-        "wall_s": round(wall, 3),
-        "requests_per_sec": round(res.requests_per_sec, 3),
-        "latency": res.latency_percentiles(),
-        "occupancy": res.occupancy_summary(eng.lanes),
-        "totals": res.totals,
-    }
-    print(json.dumps(summary))
-    return 0
+        if args.checkpoint:
+            eng.clear_snapshot()
+        res = eng.result(wall_s=wall)
+        summary = {
+            "summary": True,
+            "engine": args.engine, "family": args.family,
+            "eps": args.eps,
+            "rule": args.rule, "slots": args.slots,
+            "completed": len(res.completed), "phases": res.phases,
+            "wall_s": round(wall, 3),
+            "requests_per_sec": round(res.requests_per_sec, 3),
+            # registry-sourced: the same histogram quantile + counter
+            # values the --metrics-port endpoint serves and bench.py
+            # stream reports (identical numbers on identical runs)
+            "latency": res.latency_percentiles(),
+            "occupancy": res.occupancy_summary(eng.lanes),
+            "totals": res.totals,
+        }
+        print(json.dumps(summary))
+        return 0
+    finally:
+        if "tel" in holder:
+            holder["tel"].close()
+        if metrics_srv is not None:
+            metrics_srv.close()
 
 
 def _main_2d(args) -> int:

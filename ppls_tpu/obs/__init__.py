@@ -1,0 +1,55 @@
+"""``ppls_tpu.obs`` — the unified telemetry layer (round 10).
+
+One import surface for everything observability:
+
+* :class:`MetricsRegistry` / counters / gauges / exponential-bucket
+  histograms with the deterministic quantile (``obs.registry``);
+* :class:`SpanTracer` — hierarchical span/event JSONL timelines
+  (``obs.spans``; schema validated by
+  ``utils.artifact_schema.validate_events_text``);
+* :class:`Telemetry` — the handle the engines thread through their
+  boundary hooks; :func:`default_telemetry` for the process-wide sink
+  (``obs.telemetry``);
+* :class:`MetricsServer` — live Prometheus-text exposition for
+  ``ppls-tpu serve --metrics-port`` (``obs.server``);
+* the pre-existing per-run record types and the ``jax.profiler``
+  wrapper are absorbed by re-export: :class:`RoundStats` /
+  :class:`RunMetrics` (``utils.metrics``) and :func:`trace` /
+  :func:`annotate` (``utils.tracing``) — one layer, not three.
+
+The layer's one invariant: telemetry publishes consume values the
+boundary ALREADY fetched (one device pull per phase/run boundary) and
+live only in host boundary hooks — never inside jitted cycle bodies.
+graftlint GL06 enforces it statically.
+"""
+
+from ppls_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    PHASE_BUCKETS,
+    SECONDS_BUCKETS,
+    exp_buckets,
+)
+from ppls_tpu.obs.server import MetricsServer  # noqa: F401
+from ppls_tpu.obs.spans import SpanTracer  # noqa: F401
+from ppls_tpu.obs.telemetry import (  # noqa: F401
+    Telemetry,
+    default_telemetry,
+    set_default,
+)
+from ppls_tpu.utils.metrics import (  # noqa: F401 — absorbed surface
+    RoundStats,
+    RunMetrics,
+    round_stats_from_rows,
+)
+from ppls_tpu.utils.tracing import annotate, trace  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "PHASE_BUCKETS", "SECONDS_BUCKETS", "exp_buckets",
+    "MetricsServer", "SpanTracer", "Telemetry", "default_telemetry",
+    "set_default", "RoundStats", "RunMetrics", "round_stats_from_rows",
+    "annotate", "trace",
+]

@@ -1,0 +1,140 @@
+"""Host-side span tracing: a structured JSONL timeline of a run.
+
+``jax.profiler`` traces answer "what did the chip do" but need
+TensorBoard and a live profiler session; this module answers "what did
+the ENGINE do" — run -> cycle -> phase -> boundary spans plus
+admit/retire/reshard/checkpoint events — as plain JSONL any script can
+replay after the fact (``tools/analyze_occupancy.py --from-events``),
+which matters on this repo's standing CPU-only blocker: a TPU-attached
+round's behavior must be diagnosable from its artifact trail alone.
+
+One line per record, flushed as written (a crashed run keeps its
+prefix; consumers tolerate unbalanced spans via
+``validate_events_text(require_balanced=False)``):
+
+* ``{"ev": "meta", "schema": "ppls-events-v1", "t": 0.0, "wall": ...,
+  "attrs": {...}}`` — first line; ``wall`` is the one wall-clock
+  anchor, every other ``t`` is monotonic seconds since it.
+* ``{"ev": "span_open", "id": N, "parent": M|null, "name": ...,
+  "t": ..., "attrs": {...}}`` / ``{"ev": "span_close", "id": N,
+  "t": ..., "attrs": {...}}`` — hierarchical spans; close attrs carry
+  the span's summary (e.g. a phase span closes with its device-counter
+  delta row attached).
+* ``{"ev": "event", "name": ..., "span": N|null, "t": ...,
+  "attrs": {...}}`` — point events (admit/retire/checkpoint/...).
+
+Timestamps are ``time.monotonic()`` deltas — monotone by construction
+(the schema validator asserts non-decreasing ``t``), immune to wall
+clock steps. DETERMINISM contract: timestamps and ``wall`` vary
+between runs; every attr published from device-counted values (areas,
+phase stats deltas, crounds, latency in phases) is bit-stable across
+reruns and kill-and-resume — the comparison surface the acceptance
+tests extract.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, List, Optional
+
+
+class SpanTracer:
+    """JSONL span/event writer. ``path=None`` makes every call a cheap
+    no-op, so engines can emit unconditionally."""
+
+    def __init__(self, path: Optional[str] = None,
+                 meta: Optional[dict] = None, append: bool = False):
+        """``append=True`` continues an existing timeline (the serve
+        resume path): a fresh ``meta`` line marks the new segment —
+        its monotonic clock restarts, so the schema validator checks
+        ``t`` monotonicity per segment, not globally."""
+        self.path = path
+        self._fh: Optional[IO[str]] = None
+        self._t0 = time.monotonic()
+        self._next_id = 0
+        self._stack: List[int] = []
+        if path:
+            self._fh = open(path, "a" if append else "w",
+                            encoding="utf-8")
+            self._write({"ev": "meta", "schema": "ppls-events-v1",
+                         "t": 0.0, "wall": time.time(),
+                         "attrs": meta or {}})
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def _write(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def _now(self) -> float:
+        return round(time.monotonic() - self._t0, 6)
+
+    def span(self, name: str, **attrs) -> "_Span":
+        """Open a hierarchical span; use as a context manager, or call
+        ``.close(**summary_attrs)`` explicitly to attach the span's
+        summary (device-counter deltas) at close."""
+        if self._fh is None:
+            return _Span(self, None)
+        sid = self._next_id
+        self._next_id += 1
+        parent = self._stack[-1] if self._stack else None
+        self._write({"ev": "span_open", "id": sid, "parent": parent,
+                     "name": name, "t": self._now(), "attrs": attrs})
+        self._stack.append(sid)
+        return _Span(self, sid)
+
+    def event(self, name: str, **attrs) -> None:
+        if self._fh is None:
+            return
+        self._write({"ev": "event", "name": name,
+                     "span": self._stack[-1] if self._stack else None,
+                     "t": self._now(), "attrs": attrs})
+
+    def _close_span(self, sid: int, attrs: dict) -> None:
+        if self._fh is None:
+            return
+        # close any children left open (crash-robust nesting): a span
+        # close implies its subtree is done
+        while self._stack and self._stack[-1] != sid:
+            dangling = self._stack.pop()
+            self._write({"ev": "span_close", "id": dangling,
+                         "t": self._now(), "attrs": {}})
+        if self._stack and self._stack[-1] == sid:
+            self._stack.pop()
+        self._write({"ev": "span_close", "id": sid, "t": self._now(),
+                     "attrs": attrs})
+
+    def close(self) -> None:
+        if self._fh is None:
+            return
+        while self._stack:
+            self._close_span(self._stack[-1], {})
+        self._fh.close()
+        self._fh = None
+
+
+class _Span:
+    """Handle for one open span (no-op when the tracer is disabled)."""
+
+    __slots__ = ("_tracer", "_sid", "_closed")
+
+    def __init__(self, tracer: SpanTracer, sid: Optional[int]):
+        self._tracer = tracer
+        self._sid = sid
+        self._closed = sid is None
+
+    def close(self, **attrs) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._tracer._close_span(self._sid, attrs)
+
+    def __enter__(self) -> "_Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(**({"error": f"{exc_type.__name__}"} if exc_type
+                      else {}))

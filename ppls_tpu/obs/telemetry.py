@@ -1,0 +1,146 @@
+"""The unified ``Telemetry`` handle the engines thread through their
+boundary hooks: one metrics registry + one span tracer behind a single
+object, so ``serve``, the benches, and the batch CLIs all publish and
+read through the same surface.
+
+Publication sites are HOST boundary hooks only (stream ``step()``,
+batch-engine result assembly, checkpoint leg loops): the device values
+they publish are the ones the boundary already fetched — one device
+pull per boundary, no telemetry-added syncs. graftlint GL06 enforces
+this statically: a registry publish or event emit inside a function
+reachable from a jitted root is a lint violation.
+
+Two usage modes:
+
+* **Per-engine handle** (the stream engine): ``Telemetry()`` owns a
+  fresh registry, so per-run totals read back exactly (the stream's
+  ``result()`` sources its totals from it).
+* **Process default** (batch engines, benches):
+  ``default_telemetry()`` — a process-wide handle whose counters are
+  cumulative across runs, Prometheus-style. ``set_default()`` lets the
+  CLI point it at an events file / shared registry for a run.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ppls_tpu.obs.registry import (MetricsRegistry, PHASE_BUCKETS,
+                                   SECONDS_BUCKETS)
+from ppls_tpu.obs.spans import SpanTracer
+
+# run-level counter stats every batch engine shares (RunMetrics names)
+_RUN_COUNTERS = ("tasks", "splits", "leaves", "rounds",
+                 "integrand_evals")
+
+
+class Telemetry:
+    """Registry + tracer behind one handle (see module docstring)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 events_path: Optional[str] = None,
+                 meta: Optional[dict] = None, append: bool = False):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.tracer = SpanTracer(events_path, meta=meta, append=append)
+
+    # -- tracer passthroughs ------------------------------------------------
+
+    def span(self, name: str, **attrs):
+        return self.tracer.span(name, **attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        self.tracer.event(name, **attrs)
+
+    def close(self) -> None:
+        self.tracer.close()
+
+    # -- boundary-hook publishers -------------------------------------------
+    # (host-only; each consumes values its caller already holds)
+
+    def publish_run(self, engine: str, metrics, *, cycles: int = 0,
+                    crounds: int = 0, lane_efficiency: float = 0.0,
+                    walker_fraction: float = 0.0) -> None:
+        """Run-completion boundary: fold one finished batch run's
+        ``RunMetrics`` into the registry (labeled by engine)."""
+        reg = self.registry
+        lab = ("engine",)
+        reg.counter("ppls_runs_total",
+                    "completed integration runs", lab) \
+            .labels(engine=engine).inc()
+        for k in _RUN_COUNTERS:
+            reg.counter(f"ppls_{k}_total",
+                        f"device-counted {k} across runs", lab) \
+                .labels(engine=engine).inc(float(getattr(metrics, k)))
+        if cycles:
+            reg.counter("ppls_cycles_total", "engine cycles", lab) \
+                .labels(engine=engine).inc(float(cycles))
+        if crounds:
+            reg.counter("ppls_crounds_total",
+                        "lockstep collective boundaries", lab) \
+                .labels(engine=engine).inc(float(crounds))
+        reg.gauge("ppls_max_depth", "max refinement depth seen", lab) \
+            .labels(engine=engine).set_max(float(metrics.max_depth))
+        reg.gauge("ppls_lane_efficiency",
+                  "walker tasks / kernel lane-steps (last run)", lab) \
+            .labels(engine=engine).set(float(lane_efficiency))
+        reg.gauge("ppls_walker_fraction",
+                  "share of tasks done by the Pallas kernel "
+                  "(last run)", lab) \
+            .labels(engine=engine).set(float(walker_fraction))
+
+    def publish_compile_cache(self, engine: str, entries: int) -> None:
+        self.registry.gauge(
+            "ppls_compile_cache_entries",
+            "pjit cache entries of the engine's cycle program "
+            "(compile-once invariant: stays at 1)",
+            ("engine",)).labels(engine=engine).set(float(entries))
+
+    # stream-specific registration helpers (the stream engine owns the
+    # calls; centralizing the names/buckets here keeps bench + serve +
+    # analyze reading the same metric names)
+
+    def stream_counter(self, stat: str):
+        return self.registry.counter(
+            f"ppls_stream_{stat}_total",
+            f"device-counted per-phase {stat}, summed over phases")
+
+    def stream_gauge(self, name: str, help: str = ""):
+        return self.registry.gauge(f"ppls_stream_{name}", help)
+
+    def latency_phases_histogram(self):
+        return self.registry.histogram(
+            "ppls_stream_retire_latency_phases",
+            "request latency submit->retire in device phases",
+            buckets=PHASE_BUCKETS)
+
+    def latency_seconds_histogram(self):
+        return self.registry.histogram(
+            "ppls_stream_retire_latency_seconds",
+            "request latency submit->retire in seconds",
+            buckets=SECONDS_BUCKETS)
+
+
+_default_lock = threading.Lock()
+_default: Optional[Telemetry] = None
+
+
+def default_telemetry() -> Telemetry:
+    """The process-wide handle (registry only, no events file unless
+    ``set_default`` installed one). Batch engines publish here."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Telemetry()
+        return _default
+
+
+def set_default(tel: Optional[Telemetry]) -> Optional[Telemetry]:
+    """Install (or with None: reset) the process default; returns the
+    previous handle so callers can restore it."""
+    global _default
+    with _default_lock:
+        prev = _default
+        _default = tel
+        return prev

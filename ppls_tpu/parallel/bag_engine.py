@@ -403,6 +403,12 @@ def integrate_family(f_theta: Callable, theta: Sequence[float],
         n_chips=1,
         tasks_per_chip=[tasks],
     )
+    # run-completion telemetry boundary (round 10): values already
+    # pulled above — host dict arithmetic only
+    from ppls_tpu.obs.telemetry import default_telemetry
+    default_telemetry().publish_run(
+        "bag", metrics,
+        lane_efficiency=tasks / (iters * chunk) if iters else 0.0)
     return FamilyResult(
         areas=acc_np,
         metrics=metrics,

@@ -219,6 +219,9 @@ def device_integrate(config: QuadConfig = QuadConfig(),
         n_chips=1,
         tasks_per_chip=[tasks],
     )
+    # run-completion telemetry boundary (round 10)
+    from ppls_tpu.obs.telemetry import default_telemetry
+    default_telemetry().publish_run("device", metrics)
     return DeviceResult(
         area=float(acc_s + acc_c),
         state=out,

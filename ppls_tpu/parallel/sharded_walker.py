@@ -759,6 +759,15 @@ def integrate_family_walker_dd(
         tasks_per_chip=tasks_per_chip,
     )
     denom = tot["wsteps"] * lanes
+    # run-completion telemetry boundary (round 10): the per-chip
+    # counters were already pulled once at the leg boundary above —
+    # publishing is host dict arithmetic, no extra device fetch
+    from ppls_tpu.obs.telemetry import default_telemetry
+    default_telemetry().publish_run(
+        "walker-dd", metrics, cycles=tot["cycles"],
+        crounds=tot["crounds"],
+        lane_efficiency=wtasks / denom if denom else 0.0,
+        walker_fraction=wtasks / tasks if tasks else 0.0)
     return WalkerResult(
         areas=areas,
         metrics=metrics,

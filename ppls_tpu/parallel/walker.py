@@ -687,10 +687,16 @@ C_CAP = 64      # per-cycle stats ring rows
 
 # column order of the per-segment stats ring (one row per kernel segment)
 SEG_STAT_FIELDS = ("steps", "live_at_exit", "queue_left", "refilled")
-# column order of the per-cycle stats ring (one row per engine cycle)
+# column order of the per-cycle stats ring (one row per engine cycle).
+# `tasks`/`splits` (round 10) are the cycle's aggregate device counts —
+# the columns utils.metrics.round_stats_from_rows reads to give every
+# engine the shared per-round RoundStats record; appended LAST so the
+# positional readers (occupancy_summary, analyze_occupancy) keep their
+# column indexes.
 CYCLE_STAT_FIELDS = ("bred_roots", "breed_iters", "roots_consumed",
                      "walker_tasks", "walker_steps", "segments",
-                     "expand_tasks", "drain_tasks", "sort_rows")
+                     "expand_tasks", "drain_tasks", "sort_rows",
+                     "tasks", "splits")
 
 
 class _WalkCarry(NamedTuple):
@@ -1600,7 +1606,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             bred.count.astype(jnp.int64), bred.iters,
             roots_taken, wt,
             walk.steps.astype(jnp.int64), walk.segs.astype(jnp.int64),
-            o.bag2_count.astype(jnp.int64), bag3.tasks, srows_d])
+            o.bag2_count.astype(jnp.int64), bag3.tasks, srows_d,
+            bag_tasks + wt, bag_splits + ws])
         cyc_stats = lax.dynamic_update_slice(
             c.cyc_stats, cyc_row[None, :],
             (jnp.minimum(c.cycles, C_CAP - 1), jnp.int32(0)))
@@ -1654,10 +1661,15 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
 # Streaming hooks (runtime/stream.py): the continuous-batching engine
 # drives the SAME per-cycle computation as _run_cycles, one phase per
 # call, with request admission/retirement at the host boundary between
-# calls. Per-phase row layout of the device-counted stream stats:
+# calls. Per-phase row layout of the device-counted stream stats.
+# Round 10 appends `splits` (total across bag + walker, so the shared
+# RoundStats record can be emitted per phase) and `crounds` (the dd
+# stream's lockstep collective boundaries this phase; 0 single-chip) —
+# appended LAST so positional readers keep their indexes.
 STREAM_STAT_FIELDS = ("tasks", "btasks", "wtasks", "wsplits", "roots",
                       "rounds", "segs", "wsteps", "srows", "maxd",
-                      "live_tasks", "live_families")
+                      "live_tasks", "live_families", "splits",
+                      "crounds")
 
 
 def family_live_counts_cols(bag_meta: jnp.ndarray, count, m: int
@@ -1752,6 +1764,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         wt, ws, roots_taken, srows = z64, z64, z64, z64
         segs, wsteps = z64, z64
         bag_tasks = bag3.tasks
+        bag_splits = bag3.splits
         rounds = bag3.iters
         maxd = bag3.max_depth
         overflow = bag3.overflow
@@ -1778,6 +1791,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         segs = walk.segs.astype(jnp.int64)
         wsteps = walk.steps.astype(jnp.int64)
         bag_tasks = bred.tasks + bag3.tasks
+        bag_splits = bred.splits + bag3.splits
         rounds = bred.iters + bag3.iters
         maxd = jnp.maximum(jnp.maximum(bred.max_depth, bag3.max_depth),
                            jnp.max(walk.lanes.maxd))
@@ -1797,6 +1811,10 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         maxd.astype(jnp.int64),
         bag3.count.astype(jnp.int64),
         jnp.sum((fam_live > 0).astype(jnp.int64)),
+        bag_splits + ws,
+        # crounds: the single-chip cycle pays no collectives; the dd
+        # stream fills this column host-side from its crounds delta
+        jnp.zeros((), jnp.int64),
     ])
     next_bag = bag3._replace(
         acc=jnp.zeros_like(bag3.acc),
@@ -2287,8 +2305,25 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         n_chips=1,
         tasks_per_chip=[tasks],
     )
+    # Round 10: the shared per-round record (satellite 1) — the cycle
+    # ring's device-counted tasks/splits columns become RoundStats so
+    # the walker reports per-round structure through the same type the
+    # legacy wavefront engines populate. Direct assignment, NOT
+    # record_round: the aggregates above are already device-counted
+    # (record_round would double-count them), and `rounds` keeps its
+    # walker meaning (bag rounds + kernel segments, not cycle count).
+    from ppls_tpu.utils.metrics import round_stats_from_rows
+    if cyc_stats is not None and len(np.shape(cyc_stats)) == 2 \
+            and np.shape(cyc_stats)[1] >= len(CYCLE_STAT_FIELDS) \
+            and int(tot["cycles"]) <= len(cyc_stats):
+        # the ring holds C_CAP rows: past that, later cycles overwrite
+        # the last row and the per-round reconciliation (sum of
+        # frontier_width == tasks) would be silently wrong — leave
+        # per_round empty rather than publish truncated accounting
+        metrics.per_round = round_stats_from_rows(
+            cyc_stats, CYCLE_STAT_FIELDS, padded_width=int(lanes))
     denom = int(tot["wsteps"]) * lanes
-    return WalkerResult(
+    res = WalkerResult(
         areas=acc,
         metrics=metrics,
         lane_efficiency=wtasks / denom if denom else 0.0,
@@ -2300,6 +2335,15 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         kernel_steps=int(tot["wsteps"]),
         refill_slots=int(refill_slots),
     )
+    # run-completion telemetry boundary (host values already in hand —
+    # no extra device fetch; the registry is the process default, so
+    # benches/CLIs read one cumulative surface across runs)
+    from ppls_tpu.obs.telemetry import default_telemetry
+    default_telemetry().publish_run(
+        "walker", metrics, cycles=res.cycles,
+        lane_efficiency=res.lane_efficiency,
+        walker_fraction=res.walker_fraction)
+    return res
 
 
 def collect_family_walker(d: WalkerDispatch) -> WalkerResult:

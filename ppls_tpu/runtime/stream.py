@@ -71,6 +71,7 @@ import numpy as np
 from jax import lax
 
 from ppls_tpu.config import Rule
+from ppls_tpu.obs.telemetry import Telemetry
 from ppls_tpu.parallel.bag_engine import DEPTH_BITS, BagState
 from ppls_tpu.parallel.walker import (
     DEFAULT_LANES,
@@ -78,6 +79,12 @@ from ppls_tpu.parallel.walker import (
     run_stream_cycle,
     walker_sizing,
 )
+
+# STREAM_STAT_FIELDS columns that accumulate as registry counters
+# (everything except the running max). live_tasks/live_families keep
+# their historical summed-over-phases totals semantics: the sum is the
+# task-phase / family-phase residency integral.
+_COUNTER_STATS = tuple(k for k in STREAM_STAT_FIELDS if k != "maxd")
 
 
 @dataclasses.dataclass
@@ -129,12 +136,21 @@ class StreamResult:
     completed: List[CompletedRequest]
     phases: int
     wall_s: float
-    totals: dict                 # summed STREAM_STAT_FIELDS rows
+    totals: dict                 # registry-sourced STREAM_STAT_FIELDS sums
     phase_stats: np.ndarray      # (phases, len(STREAM_STAT_FIELDS)) i64
     # per-slot streaming surface (device-counted; the walker hooks):
     fam_done: Optional[np.ndarray] = None         # (slots,) bool
     fam_first_phase: Optional[np.ndarray] = None  # (slots,) i32, -1=never
     fam_last_phase: Optional[np.ndarray] = None   # (slots,) i32, -1=never
+    # registry latency histograms (round 10): the ONE quantile path
+    # bench + serve both read — None on hand-assembled results, where
+    # latency_percentiles() rebuilds transient histograms from
+    # `completed` through the identical bucket tables
+    latency_hist_phases: Optional[object] = None
+    latency_hist_seconds: Optional[object] = None
+    # shared per-round record (satellite 1): one RoundStats per phase,
+    # from the device-counted phase rows
+    per_round: List = dataclasses.field(default_factory=list)
 
     @property
     def areas(self) -> np.ndarray:
@@ -149,16 +165,33 @@ class StreamResult:
 
     def latency_percentiles(self) -> dict:
         """p50/p99 request latency in phases and seconds (the bench's
-        latency definition: submit -> retire, queue wait included)."""
+        latency definition: submit -> retire, queue wait included).
+
+        Round 10: sourced from the registry's exponential-bucket
+        histograms through the deterministic bucket-edge quantile
+        (``obs.registry.Histogram.quantile``), so bench and serve
+        report IDENTICAL numbers on identical runs — the previous
+        ``np.percentile`` over a sorted list interpolated across tied
+        phase counts, which let two readers of the same run disagree
+        in the last digits."""
         if not self.completed:
             return {}
-        ph = np.array([c.latency_phases for c in self.completed])
-        se = np.array([c.latency_s for c in self.completed])
+        hp, hs = self.latency_hist_phases, self.latency_hist_seconds
+        if hp is None or hs is None or hp.count != len(self.completed):
+            # hand-assembled result: rebuild through the same buckets
+            from ppls_tpu.obs.registry import (PHASE_BUCKETS,
+                                               SECONDS_BUCKETS,
+                                               Histogram)
+            hp = Histogram(PHASE_BUCKETS)
+            hs = Histogram(SECONDS_BUCKETS)
+            for c in self.completed:
+                hp.observe(c.latency_phases)
+                hs.observe(c.latency_s)
         return {
-            "p50_phases": float(np.percentile(ph, 50)),
-            "p99_phases": float(np.percentile(ph, 99)),
-            "p50_s": float(np.percentile(se, 50)),
-            "p99_s": float(np.percentile(se, 99)),
+            "p50_phases": float(hp.quantile(0.5)),
+            "p99_phases": float(hp.quantile(0.99)),
+            "p50_s": float(hs.quantile(0.5)),
+            "p99_s": float(hs.quantile(0.99)),
         }
 
     def occupancy_summary(self, lanes: int) -> dict:
@@ -260,7 +293,8 @@ class StreamEngine:
                  engine: str = "walker",
                  mesh=None, n_devices: Optional[int] = None,
                  checkpoint_path: Optional[str] = None,
-                 checkpoint_every: int = 8):
+                 checkpoint_every: int = 8,
+                 telemetry: Optional[Telemetry] = None):
         from ppls_tpu.models.integrands import get_family, get_family_ds
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -307,6 +341,44 @@ class StreamEngine:
         # program); capped by the store slack so the push never clamps
         aw = slots if admit_window is None else int(admit_window)
         self._admit_window = max(1, min(aw, 2 * slack_chunk))
+
+        # telemetry (round 10): per-engine handle by default so the
+        # registry's per-run totals read back exactly; pass a shared
+        # Telemetry (serve does: events file + metrics server) to pool.
+        # All publishes below consume host values the phase boundary
+        # already fetched — zero telemetry-added device syncs.
+        self.telemetry = telemetry if telemetry is not None \
+            else Telemetry()
+        tel = self.telemetry
+        self._stat_counters = {k: tel.stream_counter(k)
+                               for k in _COUNTER_STATS}
+        self._g_maxd = tel.stream_gauge(
+            "max_depth", "max refinement depth seen across phases")
+        self._g_queue = tel.stream_gauge(
+            "queue_depth", "pending (not yet admitted) requests")
+        self._g_resident = tel.stream_gauge(
+            "resident", "requests holding a family slot")
+        self._g_free = tel.stream_gauge("free_slots",
+                                        "free family slots")
+        self._g_phase = tel.stream_gauge("phase",
+                                         "current phase index")
+        self._g_live_tasks = tel.stream_gauge(
+            "live_tasks_now", "live bag rows after the last phase")
+        self._c_admitted = tel.registry.counter(
+            "ppls_stream_admitted_total", "requests admitted to slots")
+        self._c_retired = tel.registry.counter(
+            "ppls_stream_retired_total", "requests retired with areas")
+        self._h_lat_phases = tel.latency_phases_histogram()
+        self._h_lat_seconds = tel.latency_seconds_histogram()
+        # precomputed rolling quantiles (the same bucket-edge values a
+        # scraper would derive from the histogram) so a bare curl of
+        # /metrics shows p50/p99 without PromQL
+        self._g_lat = {
+            (q, unit): tel.stream_gauge(
+                f"retire_latency_{unit}_p{int(q * 100)}",
+                f"rolling p{int(q * 100)} retire latency ({unit}; "
+                f"bucket-edge quantile)")
+            for q in (0.5, 0.99) for unit in ("phases", "seconds")}
 
         # host bookkeeping
         self._pending: List[StreamRequest] = []
@@ -527,6 +599,12 @@ class StreamEngine:
                 slot=slot, admit_phase=self.phase)
             self._fam_first[slot] = self.phase
             admitted.append(req)
+            self.telemetry.event(
+                "admit", rid=req.rid, slot=slot, phase=self.phase,
+                theta=req.theta, bounds=list(req.bounds),
+                submit_phase=req.submit_phase)
+        if n_new:
+            self._c_admitted.inc(n_new)
         self._apply_admit(sl, sr, sth, sm, n_new, clear)
         self._count += n_new
         return admitted
@@ -627,18 +705,52 @@ class StreamEngine:
         count = int(np.sum(np.asarray(count_c)))
         # CTR64 order: tasks, splits, btasks, wtasks, wsplits, roots,
         # rounds, segs, wsteps, srows, crounds -> STREAM_STAT_FIELDS
+        # (splits and crounds land in the round-10 tail columns; the dd
+        # stream is the one engine with a nonzero per-phase crounds)
         stats = np.array([
             delta[0], delta[2], delta[3], delta[4], delta[5],
             delta[6], delta[7], delta[8], delta[9],
             int(np.max(np.asarray(maxd_c))),
-            count, int(np.sum(fam_live_tot > 0))], dtype=np.int64)
+            count, int(np.sum(fam_live_tot > 0)),
+            delta[1], delta[10]], dtype=np.int64)
         return (fam_live_tot, acc, np.zeros_like(acc),
                 self._dd_fam_last, count, bool(np.any(np.asarray(ovf_c))),
                 stats)
 
+    def _publish_phase_row(self, row: np.ndarray) -> dict:
+        """Fold one device-counted phase row into the registry (the
+        counters bench/serve/analyze all read). Host arithmetic on
+        values :meth:`_cycle_and_pull` already fetched."""
+        vals = {k: int(v) for k, v in zip(STREAM_STAT_FIELDS, row)}
+        for k, c in self._stat_counters.items():
+            c.inc(vals[k])
+        self._g_maxd.set_max(vals["maxd"])
+        self._g_live_tasks.set(vals["live_tasks"])
+        return vals
+
+    def _publish_gauges(self) -> None:
+        self._g_queue.set(len(self._pending))
+        self._g_resident.set(len(self._slot_req))
+        self._g_free.set(len(self._free))
+        self._g_phase.set(self.phase)
+        for (q, unit), g in self._g_lat.items():
+            h = (self._h_lat_phases if unit == "phases"
+                 else self._h_lat_seconds)
+            v = h.quantile(q)
+            if v is not None:
+                g.set(v)
+        fn = (run_stream_cycle if self.engine == "walker"
+              else getattr(self, "_dd_run", None))
+        cache_size = getattr(fn, "_cache_size", None)
+        if callable(cache_size):
+            self.telemetry.publish_compile_cache(
+                f"{self.engine}-stream", int(cache_size()))
+
     def step(self) -> List[CompletedRequest]:
         """One phase: admit -> cycle -> retire. Returns the requests
         retired this phase (empty when idle)."""
+        tel = self.telemetry
+        span = tel.span("phase", phase=self.phase)
         self._admit()
         if self._count == 0 and not self._slot_req:
             # nothing live on device (and nothing was admissible): an
@@ -646,17 +758,23 @@ class StreamEngine:
             # still advances so open-loop arrival schedules with gaps
             # make progress
             self.phase += 1
+            self._publish_gauges()
+            span.close(idle=True)
             return []
         (fam_live, acc, acc_c, fam_last, count, overflow,
          stats) = self._cycle_and_pull()
         self._last_fam_live = fam_live
         self._last_fam_last = np.asarray(fam_last, dtype=np.int32)
         if overflow:
+            tel.event("overflow", phase=self.phase, count=int(count))
+            span.close(error="overflow")
             raise RuntimeError(
                 "stream walker bag overflowed; raise capacity or lower "
                 "the offered load / admit window")
         self._count = count
-        self._phase_rows.append(stats.astype(np.int64))
+        row = stats.astype(np.int64)
+        self._phase_rows.append(row)
+        vals = self._publish_phase_row(row)
         retired = []
         now = time.perf_counter()
         for slot in sorted(self._slot_req):
@@ -666,10 +784,13 @@ class StreamEngine:
             rec = self._records.pop(req.rid)
             area = float(acc[slot] + acc_c[slot])
             if not np.isfinite(area):
+                tel.event("nan_retire", rid=req.rid, slot=slot,
+                          phase=self.phase)
+                span.close(error="nan_retire")
                 raise FloatingPointError(
                     f"stream request {req.rid} produced a non-finite "
                     f"area — refusing to report garbage")
-            retired.append(CompletedRequest(
+            c = CompletedRequest(
                 rid=req.rid, theta=req.theta, bounds=req.bounds,
                 area=area,
                 submit_phase=req.submit_phase,
@@ -677,11 +798,29 @@ class StreamEngine:
                 retire_phase=self.phase,
                 latency_s=now - req.submit_t,
                 first_seeded_phase=int(self._fam_first[slot]),
-                last_credited_phase=int(fam_last[slot])))
+                last_credited_phase=int(fam_last[slot]))
+            retired.append(c)
             self._free.append(slot)
+            self._c_retired.inc()
+            self._h_lat_phases.observe(c.latency_phases)
+            self._h_lat_seconds.observe(c.latency_s)
+            # every attr below except latency_s is device-counted or
+            # schedule-determined: bit-stable across rerun and resume
+            tel.event("retire", rid=c.rid, slot=slot, area=c.area,
+                      submit_phase=c.submit_phase,
+                      admit_phase=c.admit_phase,
+                      retire_phase=c.retire_phase,
+                      latency_phases=c.latency_phases,
+                      first_seeded_phase=c.first_seeded_phase,
+                      last_credited_phase=c.last_credited_phase,
+                      latency_s=round(c.latency_s, 6))
         self._free.sort()
         self.completed.extend(retired)
         self.phase += 1
+        self._publish_gauges()
+        # the phase span closes carrying the phase's device-counter
+        # delta row — the timeline IS the per-phase stats trail
+        span.close(retired=len(retired), **vals)
         if self.checkpoint_path and \
                 self.phase % self.checkpoint_every == 0:
             self.snapshot()
@@ -723,6 +862,8 @@ class StreamEngine:
         order = sorted(range(len(requests)), key=lambda i: sched[i])
         queue = [(sched[i], requests[i]) for i in order]
         phases0 = self.phase
+        run_span = self.telemetry.span(
+            "run", engine=f"{self.engine}-stream", requests=len(queue))
         k = 0
         phases = 0
         while k < len(queue) or not self.idle:
@@ -739,22 +880,35 @@ class StreamEngine:
                     f"simulated crash after {phases} phases (test hook)")
             if phases > (1 << 14):
                 raise RuntimeError("stream did not converge")
+        run_span.close(phases=phases, completed=len(self.completed))
         return self.result(wall_s=time.perf_counter() - t0)
 
     def result(self, wall_s: float = 0.0) -> StreamResult:
+        from ppls_tpu.utils.metrics import round_stats_from_rows
         rows = (np.stack(self._phase_rows) if self._phase_rows
                 else np.zeros((0, len(STREAM_STAT_FIELDS)), np.int64))
-        totals = {k: int(rows[:, i].sum()) if len(rows) else 0
-                  for i, k in enumerate(STREAM_STAT_FIELDS)}
-        totals["maxd"] = int(rows[:, STREAM_STAT_FIELDS.index(
-            "maxd")].max()) if len(rows) else 0
+        # totals are REGISTRY-SOURCED (round 10): the counters the
+        # metrics endpoint serves are the same numbers the bench and
+        # the serve summary report — one accounting surface, no
+        # ad-hoc twin sums to drift apart (the per-phase rows stay on
+        # phase_stats for timeline consumers)
+        reg = self.telemetry.registry
+        totals = {k: int(reg.value(f"ppls_stream_{k}_total"))
+                  for k in _COUNTER_STATS}
+        totals["maxd"] = int(reg.value("ppls_stream_max_depth"))
         return StreamResult(completed=list(self.completed),
                             phases=self.phase, wall_s=wall_s,
                             totals=totals, phase_stats=rows,
                             fam_done=np.asarray(self._last_fam_live)
                             == 0,
                             fam_first_phase=self._fam_first.copy(),
-                            fam_last_phase=self._last_fam_last.copy())
+                            fam_last_phase=self._last_fam_last.copy(),
+                            latency_hist_phases=self._h_lat_phases
+                            .solo(),
+                            latency_hist_seconds=self._h_lat_seconds
+                            .solo(),
+                            per_round=round_stats_from_rows(
+                                rows, STREAM_STAT_FIELDS))
 
     # ------------------------------------------------------------------
     # snapshot / resume
@@ -811,6 +965,10 @@ class StreamEngine:
             self.checkpoint_path, identity=self._identity(),
             bag_cols=bag_cols, count=count, acc=acc_pair,
             totals=totals)
+        self.telemetry.event(
+            "checkpoint", phase=self.phase, count=count,
+            pending=len(self._pending), resident=len(self._slot_req),
+            completed=len(self.completed))
 
     @classmethod
     def resume(cls, checkpoint_path: str, family: str, eps: float,
@@ -856,7 +1014,28 @@ class StreamEngine:
             eng._restore_device(bag_cols, count, acc_pair,
                                 np.asarray(totals["fam_last"],
                                            dtype=np.int32))
+        eng._replay_registry()
+        eng.telemetry.event(
+            "resume", phase=eng.phase, count=eng._count,
+            pending=len(eng._pending), resident=len(eng._slot_req),
+            completed=len(eng.completed))
         return eng
+
+    def _replay_registry(self) -> None:
+        """Rebuild the registry from the restored DETERMINISTIC record
+        (device-counted phase rows, completed-request latencies) so a
+        resumed run's registry-sourced totals and histogram quantiles
+        match the uninterrupted run's bit-for-bit."""
+        for row in self._phase_rows:
+            self._publish_phase_row(np.asarray(row, dtype=np.int64))
+        n_admitted = len(self.completed) + len(self._slot_req)
+        if n_admitted:
+            self._c_admitted.inc(n_admitted)
+        for c in self.completed:
+            self._c_retired.inc()
+            self._h_lat_phases.observe(c.latency_phases)
+            self._h_lat_seconds.observe(c.latency_s)
+        self._publish_gauges()
 
     def _restore_device(self, bag_cols, count, acc_pair, fam_last):
         d = self._dev

@@ -21,6 +21,13 @@ carries, not the per-leg payloads:
 Secondary legs (``secondary`` dict) are validated recursively with the
 same envelope unless they are error records (``{"error": ...}``) or
 explicitly skipped (``{"skipped": ...}``).
+
+Round 10 adds a second document type: the telemetry EVENT LOG
+(``ppls-tpu serve --events``, ``obs.spans.SpanTracer``) —
+``validate_events_text`` checks the span/event JSONL shape (record
+kinds, required keys, per-segment monotonic timestamps, span-nesting
+balance) so a truncated or hand-edited timeline fails CI instead of
+silently replaying as a partial run.
 """
 
 from __future__ import annotations
@@ -125,6 +132,107 @@ def validate_artifact_text(text: str, *, where: str = "artifact",
         found += sub_found
     if require_records and not found:
         problems.append(f"{where}: no bench records found")
+    return problems
+
+
+EVENT_KINDS = ("meta", "span_open", "span_close", "event")
+
+
+def validate_events_text(text: str, *, where: str = "events",
+                         require_balanced: bool = True) -> List[str]:
+    """Validate a telemetry event log (``obs.spans`` JSONL timeline).
+
+    Per line: a JSON object with ``ev`` in :data:`EVENT_KINDS`; every
+    non-meta record carries a finite ``t`` that is non-decreasing
+    WITHIN its segment (a ``meta`` line starts a new segment — the
+    serve resume path appends one, restarting the monotonic clock);
+    ``span_open`` carries int ``id``, non-empty ``name`` and a
+    ``parent`` that is null or an OPEN span id; ``span_close`` closes
+    an open id; ``event`` carries a non-empty ``name``; ``attrs``
+    (when present) is an object. ``require_balanced=False`` tolerates
+    unclosed spans — the shape a killed run leaves behind.
+
+    Returns a list of problem strings (empty = clean).
+    """
+    problems: List[str] = []
+    open_spans: set = set()
+    last_t = None
+    found = 0
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            problems.append(f"{where}:{i}: unparseable event line")
+            continue
+        if not isinstance(rec, dict):
+            problems.append(f"{where}:{i}: not a JSON object")
+            continue
+        found += 1
+        ev = rec.get("ev")
+        if ev not in EVENT_KINDS:
+            problems.append(f"{where}:{i}: unknown ev {ev!r}")
+            continue
+        if ev == "meta":
+            # new segment (the resume-append path): the monotonic
+            # clock AND the span-id space restart. Spans the previous
+            # segment left open are the crashed-run shape — flagged
+            # only under require_balanced, then forgotten so the new
+            # segment's ids (restarting at 0) don't read as reopens.
+            last_t = None
+            if require_balanced and open_spans:
+                problems.append(
+                    f"{where}:{i}: {len(open_spans)} span(s) left "
+                    f"open at segment boundary: {sorted(open_spans)}")
+            open_spans.clear()
+            if rec.get("schema") != "ppls-events-v1":
+                problems.append(f"{where}:{i}: meta without "
+                                f"schema=ppls-events-v1")
+            continue
+        t = rec.get("t")
+        if not _is_finite_number(t):
+            problems.append(f"{where}:{i}: missing/non-finite 't'")
+        elif last_t is not None and t < last_t:
+            problems.append(f"{where}:{i}: timestamp goes backwards "
+                            f"({t} < {last_t})")
+        else:
+            last_t = t
+        attrs = rec.get("attrs")
+        if attrs is not None and not isinstance(attrs, dict):
+            problems.append(f"{where}:{i}: 'attrs' must be an object")
+        if ev == "span_open":
+            sid = rec.get("id")
+            if not isinstance(sid, int):
+                problems.append(f"{where}:{i}: span_open without int "
+                                f"'id'")
+                continue
+            parent = rec.get("parent")
+            if parent is not None and parent not in open_spans:
+                problems.append(f"{where}:{i}: parent {parent} is not "
+                                f"an open span")
+            if not isinstance(rec.get("name"), str) or not rec["name"]:
+                problems.append(f"{where}:{i}: span_open without "
+                                f"'name'")
+            if sid in open_spans:
+                problems.append(f"{where}:{i}: span id {sid} reopened")
+            open_spans.add(sid)
+        elif ev == "span_close":
+            sid = rec.get("id")
+            if sid not in open_spans:
+                problems.append(f"{where}:{i}: span_close for "
+                                f"unopened id {sid!r}")
+            else:
+                open_spans.discard(sid)
+        elif ev == "event":
+            if not isinstance(rec.get("name"), str) or not rec["name"]:
+                problems.append(f"{where}:{i}: event without 'name'")
+    if not found:
+        problems.append(f"{where}: no event records found")
+    elif require_balanced and open_spans:
+        problems.append(f"{where}: {len(open_spans)} span(s) never "
+                        f"closed: {sorted(open_spans)}")
     return problems
 
 

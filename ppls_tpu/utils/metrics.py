@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 
 @dataclasses.dataclass
@@ -60,6 +60,11 @@ class RunMetrics:
         return self.tasks / self.wall_time_s / max(self.n_chips, 1)
 
     def record_round(self, stats: RoundStats) -> None:
+        """Legacy wavefront-engine hook: append AND accumulate the
+        aggregate counters from the round. The walker/stream engines
+        count their aggregates on-device instead — they populate
+        ``per_round`` directly via :func:`round_stats_from_rows`
+        without double-counting through this method."""
         self.per_round.append(stats)
         self.rounds = len(self.per_round)
         self.tasks += stats.frontier_width
@@ -77,3 +82,33 @@ class RunMetrics:
         d = dataclasses.asdict(self)
         d["evals_per_sec_per_chip"] = self.evals_per_sec_per_chip
         return json.dumps(d)
+
+
+def round_stats_from_rows(rows, fields: Sequence[str],
+                          padded_width: int = 0) -> List[RoundStats]:
+    """Convert device-counted per-cycle/per-phase stat rows into the
+    shared :class:`RoundStats` record type (round 10: one per-round
+    record across ALL engines — the walker engines predating this
+    helper left ``per_round`` empty and only the legacy bag engines
+    populated it).
+
+    ``rows`` is the (n, len(fields)) integer array an engine's stats
+    ring / phase log produced; ``fields`` its column-name tuple, which
+    must carry ``tasks`` and ``splits`` columns (both
+    ``CYCLE_STAT_FIELDS`` and ``STREAM_STAT_FIELDS`` do). One
+    ``RoundStats`` per row: frontier_width = that round's device-
+    counted tasks, leaves = tasks - splits (every task either splits
+    or is accepted — the reference invariant, ``aquadPartA.c``'s
+    3283/3284 split of 6567).
+    """
+    if rows is None or len(rows) == 0:
+        return []
+    i_t = list(fields).index("tasks")
+    i_s = list(fields).index("splits")
+    out: List[RoundStats] = []
+    for i, row in enumerate(rows):
+        t, s = int(row[i_t]), int(row[i_s])
+        out.append(RoundStats(round_index=i, frontier_width=t,
+                              splits=s, leaves=t - s,
+                              padded_width=padded_width))
+    return out

@@ -21,12 +21,18 @@ from typing import Iterator, Optional
 
 @contextlib.contextmanager
 def trace(trace_dir: Optional[str]) -> Iterator[None]:
-    """Capture a jax.profiler trace into ``trace_dir`` (no-op if None)."""
+    """Capture a jax.profiler trace into ``trace_dir`` (no-op if None).
+    The directory is created if missing — a ``--trace`` run must not
+    die after the integration finished because the capture dir's
+    parent path was never made."""
     if not trace_dir:
         yield
         return
+    import os
+
     import jax
 
+    os.makedirs(trace_dir, exist_ok=True)
     with jax.profiler.trace(trace_dir):
         yield
 

@@ -337,6 +337,102 @@ def test_gl05_call_sites_resolve_through_imports(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# GL06 — telemetry publishes only at host boundaries
+# ---------------------------------------------------------------------------
+
+GL06_BROKEN = """
+    import functools
+    import jax
+    from pkg.obs.telemetry import default_telemetry
+
+    def publish(x):
+        tel = default_telemetry()             # obs name, reachable
+        tel.event("phase", tasks=x)           # emit inside the trace
+        return x
+
+    @functools.partial(jax.jit, static_argnames=())
+    def entry(x):
+        return publish(x)
+
+    def boundary_hook(row):
+        # the sanctioned shape: a host boundary hook publishing values
+        # the boundary already fetched — NOT reachable from any root
+        tel = default_telemetry()
+        tel.event("phase", tasks=row)
+        tel.registry.counter("t").inc(row)
+"""
+
+
+def test_gl06_catches_telemetry_in_traced_path(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": GL06_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL06"]
+    syms = sorted(v.symbol for v in got)
+    # both the obs-imported name call and the .event emit fire; the
+    # boundary hook (unreachable from the jit root) stays silent
+    assert syms == ["publish:default_telemetry", "publish:event"], got
+    assert "trace time" in got[0].message
+
+
+def test_gl06_fixed_by_moving_to_boundary_hook(tmp_path):
+    # the fixed twin: the jitted entry no longer calls the publisher —
+    # telemetry only happens in the host boundary hook
+    fixed = GL06_BROKEN.replace("return publish(x)", "return x")
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL06"] == []
+
+
+def test_gl06_api_names_need_obs_import(tmp_path):
+    # `.inc()` / `.observe()` attribute spellings only count in modules
+    # that bind something from obs — a jax `.at[i].set()`-style
+    # coincidence in a non-telemetry module must not fire
+    src = """
+        import functools
+        import jax
+
+        class Thing:
+            def inc(self, n):
+                return n
+
+        def helper(x, t: "Thing"):
+            t.inc(1)                      # same spelling, not obs
+            return x
+
+        @functools.partial(jax.jit, static_argnames=())
+        def entry(x):
+            return helper(x, Thing())
+    """
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": src})
+    assert [v for v in run_lint(pkg) if v.code == "GL06"] == []
+
+
+def test_gl06_module_alias_calls_flagged(tmp_path):
+    # obs reached through a module alias (`from pkg.obs import
+    # telemetry as t; t.default_telemetry()`) fires too
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": """
+        import functools
+        import jax
+        from pkg.obs import telemetry as t
+
+        @functools.partial(jax.jit, static_argnames=())
+        def entry(x):
+            t.default_telemetry()
+            return x
+    """})
+    got = [v for v in run_lint(pkg) if v.code == "GL06"]
+    assert [v.symbol for v in got] == ["entry:t.default_telemetry"]
+
+
+def test_gl06_real_package_clean():
+    # the package-level acceptance: all telemetry publishes live in
+    # boundary hooks (zero new baseline entries for GL06)
+    from tools.graftlint.rules import rule_gl06
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    got = [v for v in run_lint(os.path.join(repo, "ppls_tpu"),
+                               rules=(rule_gl06,))]
+    assert got == [], "\n".join(v.render() for v in got)
+
+
+# ---------------------------------------------------------------------------
 # pragmas, baseline workflow, and the real package
 # ---------------------------------------------------------------------------
 

@@ -19,13 +19,129 @@ Round 7: ``python tools/analyze_occupancy.py dd`` decomposes the
 DEMAND-DRIVEN engine instead — refill vs legacy collective rounds per
 cycle, per-chip balance, and the per-chip headroom split at the dd
 lane count (main_dd).
+
+Round 10: ``python tools/analyze_occupancy.py --from-events FILE
+[--lanes N]`` replays a telemetry event log (``ppls-tpu serve
+--events``, obs.spans JSONL) OFFLINE — no jax, no device — and prints
+the same occupancy/boundary decomposition from the device-counter
+deltas the phase spans carry, plus the retire-latency quantiles
+through the shared histogram (identical numbers to the serve summary
+by construction). This is the post-mortem path the CPU-only blocker
+makes essential: a TPU-attached serve round is diagnosable from its
+timeline alone.
 """
 
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main_from_events(path: str, lanes: int = 0) -> int:
+    """Offline timeline decomposition (round 10): replay an obs.spans
+    event log and print the phase/occupancy/latency breakdown from the
+    device-counter deltas attached to the phase spans. No device, no
+    compile cache, no engine imports — it works on any host that can
+    read the file and import the (pure-Python) obs layer."""
+    from ppls_tpu.obs.registry import PHASE_BUCKETS, Histogram
+    from ppls_tpu.utils.artifact_schema import validate_events_text
+
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    problems = validate_events_text(text, where=os.path.basename(path),
+                                    require_balanced=False)
+    for p in problems:
+        print(f"WARNING schema: {p}")
+
+    meta_attrs = {}
+    phase_rows = []          # span_close attrs of "phase" spans
+    phase_walls = []         # close.t - open.t per phase span
+    open_phase = {}          # id -> (open t)
+    names = {}               # id -> span name
+    retires = []
+    checkpoints = 0
+    segments = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue          # already reported by the validator above
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        if ev == "meta":
+            segments += 1
+            meta_attrs.update(rec.get("attrs") or {})
+            # span ids restart per segment (resume-append): drop the
+            # previous segment's bookkeeping so ids don't collide
+            open_phase.clear()
+            names.clear()
+        elif ev == "span_open" and isinstance(rec.get("id"), int):
+            names[rec["id"]] = rec.get("name")
+            if rec.get("name") == "phase":
+                open_phase[rec["id"]] = rec.get("t", 0.0)
+        elif ev == "span_close":
+            if names.get(rec.get("id")) == "phase":
+                attrs = rec.get("attrs") or {}
+                if not attrs.get("idle"):
+                    phase_rows.append(attrs)
+                t0 = open_phase.pop(rec["id"], None)
+                if t0 is not None:
+                    phase_walls.append(rec.get("t", t0) - t0)
+        elif ev == "event" and rec.get("name") == "retire":
+            retires.append(rec.get("attrs") or {})
+        elif ev == "event" and rec.get("name") == "checkpoint":
+            checkpoints += 1
+
+    lanes = int(lanes or meta_attrs.get("lanes") or 0)
+    print(f"=== timeline: {os.path.basename(path)} ===")
+    print(f"meta: {meta_attrs}")
+    print(f"segments={segments} (1 + one per resume), "
+          f"device phases={len(phase_rows)}, retires={len(retires)}, "
+          f"checkpoints={checkpoints}")
+
+    def tot(key):
+        return sum(int(r.get(key, 0)) for r in phase_rows)
+
+    if phase_rows:
+        tasks, wtasks, wsteps = tot("tasks"), tot("wtasks"), tot("wsteps")
+        print(f"tasks={tasks} (walker {wtasks}, bag {tot('btasks')}), "
+              f"splits={tot('splits')}, kernel steps={wsteps}")
+        print(f"boundaries: rounds={tot('rounds')} segs={tot('segs')} "
+              f"sort_rows={tot('srows')} crounds={tot('crounds')}")
+        if lanes and wsteps:
+            print(f"lane_efficiency={wtasks / (wsteps * lanes):.4f} "
+                  f"(walker tasks / kernel lane-steps @ lanes={lanes})")
+        print(f"walker_fraction="
+              f"{wtasks / tasks if tasks else 0.0:.4f}")
+        n = len(phase_rows)
+        print(f"mean live_families={tot('live_families') / n:.2f}, "
+              f"mean live_tasks={tot('live_tasks') / n:.1f}, "
+              f"max depth={max(int(r.get('maxd', 0)) for r in phase_rows)}")
+        if phase_walls:
+            print(f"phase wall: mean={sum(phase_walls)/len(phase_walls)*1e3:.1f} ms "
+                  f"max={max(phase_walls)*1e3:.1f} ms")
+    if retires:
+        h = Histogram(PHASE_BUCKETS)
+        for r in retires:
+            h.observe(int(r.get("latency_phases", 0)))
+        print(f"retire latency (phases): p50={h.quantile(0.5)} "
+              f"p99={h.quantile(0.99)} (shared histogram quantile — "
+              f"identical to the serve summary)")
+    return 1 if problems else 0
+
+
+if "--from-events" in sys.argv:
+    _i = sys.argv.index("--from-events")
+    _lanes = 0
+    if "--lanes" in sys.argv:
+        _lanes = int(sys.argv[sys.argv.index("--lanes") + 1])
+    sys.exit(main_from_events(sys.argv[_i + 1], lanes=_lanes))
 
 from ppls_tpu.utils.compile_cache import enable_compile_cache
 enable_compile_cache()

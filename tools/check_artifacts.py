@@ -4,10 +4,18 @@ against the bench record envelope (ppls_tpu.utils.artifact_schema), so
 malformed blocks fail CI loudly instead of silently dropping from the
 round-over-round trajectory.
 
+Round 10: also validates telemetry event logs (the second artifact
+document type — ``ppls-tpu serve --events`` span timelines) via
+``--events FILE``; CI runs a short seeded synthetic serve and gates
+its timeline through this path.
+
 Usage:
     python tools/check_artifacts.py [FILE ...]   # default: repo-root
                                                  # BENCH_r*/MULTICHIP_r*
     some-bench | python tools/check_artifacts.py -   # validate stdin
+    python tools/check_artifacts.py --events EVENTS.jsonl [...]
+        # validate event logs (--unbalanced-ok tolerates the unclosed
+        # spans a killed run leaves behind)
 """
 
 from __future__ import annotations
@@ -19,11 +27,40 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from ppls_tpu.utils.artifact_schema import validate_artifact_text  # noqa: E402
+from ppls_tpu.utils.artifact_schema import (  # noqa: E402
+    validate_artifact_text,
+    validate_events_text,
+)
 
 
 def main(argv) -> int:
-    paths = argv[1:]
+    args = list(argv[1:])
+    balanced = True
+    if "--unbalanced-ok" in args:
+        args.remove("--unbalanced-ok")
+        balanced = False
+    event_paths = []
+    while "--events" in args:
+        i = args.index("--events")
+        if i + 1 >= len(args):
+            print("check_artifacts: --events requires a FILE",
+                  file=sys.stderr)
+            return 2
+        event_paths.append(args[i + 1])
+        del args[i:i + 2]
+    paths = args
+    problems = []
+    for p in event_paths:
+        with open(p) as fh:
+            problems += validate_events_text(
+                fh.read(), where=os.path.basename(p),
+                require_balanced=balanced)
+    if event_paths and not paths:
+        for msg in problems:
+            print(f"check_artifacts: {msg}", file=sys.stderr)
+        print(f"check_artifacts: {len(event_paths)} event log(s), "
+              f"{len(problems)} problem(s)")
+        return 1 if problems else 0
     if not paths:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))
@@ -32,7 +69,6 @@ def main(argv) -> int:
         if not paths:
             print("check_artifacts: no artifact files found", flush=True)
             return 0
-    problems = []
     for p in paths:
         if p == "-":
             problems += validate_artifact_text(sys.stdin.read(),
@@ -47,7 +83,7 @@ def main(argv) -> int:
                 require_records=base.startswith("BENCH"))
     for msg in problems:
         print(f"check_artifacts: {msg}", file=sys.stderr)
-    print(f"check_artifacts: {len(paths)} file(s), "
+    print(f"check_artifacts: {len(paths) + len(event_paths)} file(s), "
           f"{len(problems)} problem(s)")
     return 1 if problems else 0
 

@@ -4,8 +4,11 @@
 #   1. tier-1 forced-CPU test suite (the ROADMAP gate, verbatim)
 #   2. `pip install -e .` smoke + `ppls-tpu --help` console script
 #   3. artifact schema check (BENCH_r*/MULTICHIP_r* round JSONs)
-#   4. graftlint static analysis (GL01-GL05 vs the committed baseline)
-#   5. C hygiene smoke: csrc compiles under -Wall -Wextra -Werror
+#   4. graftlint static analysis (GL01-GL06 vs the committed baseline)
+#   5. serve telemetry smoke: a short seeded synthetic Poisson load
+#      through `ppls-tpu serve --events`, then the event-log schema
+#      check (the round-10 timeline artifact must stay valid end-to-end)
+#   6. C hygiene smoke: csrc compiles under -Wall -Wextra -Werror
 #      (skipped with a visible notice when no compiler is present)
 #
 # Usage: bash tools/ci.sh            # from anywhere inside the repo
@@ -67,7 +70,7 @@ fi
 # committed baseline (tools/graftlint_baseline.json). See BASELINE.md
 # "Static analysis & strict modes" for the rule set and the allowlist
 # workflow.
-step "graftlint static analysis (GL01-GL05)"
+step "graftlint static analysis (GL01-GL06)"
 if python -m tools.graftlint ppls_tpu \
         --baseline tools/graftlint_baseline.json --quiet; then
     echo "ci: graftlint OK"
@@ -76,7 +79,28 @@ else
     FAILURES=$((FAILURES + 1))
 fi
 
-# --- 5. C hygiene: csrc must compile warning-free ---
+# --- 5. serve telemetry smoke: seeded synthetic load + event log ---
+# A short `ppls-tpu serve` run on the deterministic Poisson schedule
+# (interpret-friendly sizing, same shape as tests/test_stream.py's
+# CLI test) must produce a schema-valid --events timeline: the
+# round-10 observability artifact is gated end-to-end, not just at
+# the unit level.
+step "serve --events telemetry smoke"
+EV_FILE="$(mktemp /tmp/ppls_ci_events.XXXXXX.jsonl)"
+if JAX_PLATFORMS=cpu python -m ppls_tpu serve \
+        --synthetic 4 --arrival-rate 2 --seed 0 --eps 1e-6 \
+        -a 1e-2 -b 1.0 --slots 8 --chunk 512 --capacity 65536 \
+        --lanes 256 --refill-slots 2 \
+        --events "$EV_FILE" > /dev/null 2>&1 \
+        && python tools/check_artifacts.py --events "$EV_FILE"; then
+    echo "ci: serve events OK"
+else
+    echo "ci: serve --events telemetry smoke FAILED"
+    FAILURES=$((FAILURES + 1))
+fi
+rm -f "$EV_FILE"
+
+# --- 6. C hygiene: csrc must compile warning-free ---
 # The stub-linked MPI binary is part of the tier-1 surface
 # (test_backend.py runs the real farmer/worker protocol through it),
 # so warnings in csrc are latent test-lane breakage.

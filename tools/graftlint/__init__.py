@@ -14,7 +14,12 @@ sharded, streaming JAX are all *statically visible*:
 * GL04 — collectives in the dd engine not paired with a ``crounds``
   increment (corrupts the device-counted collective-round claims);
 * GL05 — static-arg drift on jitted entries (missing statics trace
-  config into the program; loop-varying statics recompile per call).
+  config into the program; loop-varying statics recompile per call);
+* GL06 — telemetry publishes (obs registry/span emits) inside
+  functions reachable from a jitted root: the side effect fires at
+  trace time (phantom samples) and its inputs force a host sync —
+  publishes belong in the boundary hooks that already hold the
+  fetched values.
 
 Violations are keyed ``CODE:path:symbol`` (no line numbers, so edits
 elsewhere in a file don't churn the baseline) and grandfathered sites

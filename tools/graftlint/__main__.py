@@ -18,7 +18,7 @@ from tools.graftlint.core import (load_baseline, run_lint,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="project-specific static analysis (GL01-GL05)")
+        description="project-specific static analysis (GL01-GL06)")
     ap.add_argument("target",
                     help="package directory to lint (single files are "
                          "rejected: the rules are cross-module)")

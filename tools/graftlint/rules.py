@@ -458,23 +458,16 @@ def _arg_is_trace_safe(node: ast.AST, static_pool: Set[str]) -> bool:
     return not names    # pure-constant expression
 
 
-def rule_gl03(modules: List[LintModule]) -> Iterator[Violation]:
-    """GL03: host synchronization inside the traced hot path.
+def _jit_reachable(modules: List[LintModule]):
+    """BFS the intra-package call graph from every jitted root.
 
-    Walks the intra-package call graph from every jitted root (the
-    ``@jax.jit`` entries of walker.py/stream.py and the
-    ``jax.jit(shard_map_compat(...))`` builders of the sharded
-    engines) and flags, in any reachable function body:
-    ``jax.device_get/device_put``, ``.block_until_ready()``,
-    ``.item()/.tolist()``, ``np.*`` calls on non-constant arguments,
-    and ``int()/float()/bool()`` coercions of traced values.  Under
-    ``jit`` these either fail at trace time in the best case or —
-    with AOT-style retracing — force a device round-trip per cycle in
-    the hot loop, which is exactly the failure mode the device-counted
-    ``crounds``/phase claims exist to rule out."""
+    Returns ``(visited, lookup)``: the reachable ``(modkey, qualname)``
+    set and a resolver to each function's AST node. Shared by GL03
+    (host syncs) and GL06 (telemetry publishes) — both defend the same
+    boundary: code reachable from a jitted root runs under tracing.
+    """
     index = _build_call_index(modules)
     mod_by_key = {m.modkey: m for m in modules}
-    static_pool = _static_name_pool(modules)
     # nested defs too: builder-pattern roots (jax.jit(wrap(body)) where
     # body is a closure) are not top-level functions
     all_defs: Dict[str, Dict[str, ast.FunctionDef]] = {}
@@ -489,11 +482,9 @@ def rule_gl03(modules: List[LintModule]) -> Iterator[Violation]:
         return index[modkey].get(qn) or all_defs[modkey].get(qn)
     # BFS the reachable set
     queue: List[Tuple[str, str]] = []
-    root_set: Set[Tuple[str, str]] = set()
     for mod in modules:
         for qn, fn, _ in _jit_roots(mod):
             queue.append((mod.modkey, qn))
-            root_set.add((mod.modkey, qn))
     visited: Set[Tuple[str, str]] = set()
     while queue:
         key = queue.pop()
@@ -510,6 +501,26 @@ def rule_gl03(modules: List[LintModule]) -> Iterator[Violation]:
                 callee = _resolve_callee(mod, n, index)
                 if callee is not None and callee not in visited:
                     queue.append(callee)
+    return visited, _lookup
+
+
+def rule_gl03(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL03: host synchronization inside the traced hot path.
+
+    Walks the intra-package call graph from every jitted root (the
+    ``@jax.jit`` entries of walker.py/stream.py and the
+    ``jax.jit(shard_map_compat(...))`` builders of the sharded
+    engines) and flags, in any reachable function body:
+    ``jax.device_get/device_put``, ``.block_until_ready()``,
+    ``.item()/.tolist()``, ``np.*`` calls on non-constant arguments,
+    and ``int()/float()/bool()`` coercions of traced values.  Under
+    ``jit`` these either fail at trace time in the best case or —
+    with AOT-style retracing — force a device round-trip per cycle in
+    the hot loop, which is exactly the failure mode the device-counted
+    ``crounds``/phase claims exist to rule out."""
+    mod_by_key = {m.modkey: m for m in modules}
+    static_pool = _static_name_pool(modules)
+    visited, _lookup = _jit_reachable(modules)
     for modkey, qn in sorted(visited):
         mod = mod_by_key[modkey]
         fn = _lookup(modkey, qn)
@@ -773,4 +784,87 @@ def rule_gl05(modules: List[LintModule]) -> Iterator[Violation]:
             yield from scan(fn, set(), qn)
 
 
-ALL_RULES = (rule_gl01, rule_gl02, rule_gl03, rule_gl04, rule_gl05)
+# ---------------------------------------------------------------------------
+# GL06 — telemetry publishes only at host boundaries
+# ---------------------------------------------------------------------------
+
+# The obs-layer publish/emit surface (obs.telemetry / obs.registry /
+# obs.spans method names). `.set` is deliberately ABSENT: jax's
+# `x.at[i].set(v)` shares the attribute name, and gauges are only
+# reachable through the obs-imported handles the name check below
+# already covers.
+_GL06_API = {"inc", "set_max", "observe", "event", "span",
+             "publish_run", "publish_phase", "publish_compile_cache",
+             "stream_counter", "stream_gauge", "emit_event"}
+
+
+def _imports_obs(mod: LintModule) -> bool:
+    """Whether the module binds anything from the obs subpackage."""
+    if any(v == "obs" or v.startswith("obs/")
+           for v in mod.module_aliases.values()):
+        return True
+    return any(base == "obs" or base.startswith("obs/")
+               for base, _ in mod.name_imports.values())
+
+
+def rule_gl06(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL06: telemetry reads/writes (registry publishes, span/event
+    emits) may only occur in boundary-hook functions — never inside a
+    function reachable from a jitted root.
+
+    The telemetry layer's contract is "one device fetch per boundary,
+    publishes are host dict arithmetic on values the boundary already
+    pulled" (obs/__init__.py). A publish that drifts into the traced
+    cycle body breaks it two ways at once: the Python side effect
+    runs at TRACE time (the registry records one phantom sample per
+    compile, not per execution — silently wrong counts), and any
+    value it needs forces the GL03 host-sync shape. Mechanically: in
+    any function reachable from a jitted root (the GL03 BFS), flag
+    (a) calls to names imported from ``obs`` modules, and (b) — in
+    modules that import obs — attribute calls spelling an obs API
+    method (``.inc``/``.observe``/``.event``/``.span``/
+    ``publish_*``/...)."""
+    mod_by_key = {m.modkey: m for m in modules}
+    visited, _lookup = _jit_reachable(modules)
+    for modkey, qn in sorted(visited):
+        mod = mod_by_key[modkey]
+        fn = _lookup(modkey, qn)
+        if fn is None:
+            continue
+        obs_mod = _imports_obs(mod)
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            hit = None
+            f = n.func
+            if isinstance(f, ast.Name):
+                imp = mod.name_imports.get(f.id)
+                if imp is not None and (imp[0] == "obs"
+                                        or imp[0].startswith("obs/")):
+                    hit = f.id
+            elif isinstance(f, ast.Attribute):
+                if obs_mod and f.attr in _GL06_API:
+                    hit = f.attr
+                # obs_module.anything(...) through a module alias
+                elif isinstance(f.value, ast.Name):
+                    tgt = mod.module_aliases.get(f.value.id)
+                    if tgt is not None and (tgt == "obs"
+                                            or tgt.startswith("obs/")):
+                        hit = f"{f.value.id}.{f.attr}"
+            if hit is None:
+                continue
+            yield Violation(
+                code="GL06", path=mod.path, line=n.lineno,
+                symbol=f"{qn}:{hit}",
+                message=(
+                    f"telemetry publish/emit {hit!r} inside {qn}, "
+                    f"which is reachable from a jitted root: the "
+                    f"side effect fires at trace time (one phantom "
+                    f"sample per compile) and its inputs force a "
+                    f"host sync. Move the publish to the host "
+                    f"boundary hook that already holds the fetched "
+                    f"values."))
+
+
+ALL_RULES = (rule_gl01, rule_gl02, rule_gl03, rule_gl04, rule_gl05,
+             rule_gl06)
