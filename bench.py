@@ -487,6 +487,11 @@ def main():
         # (VERDICT r4 #6: the artifact itself must carry the numbers
         # occupancy work is judged by)
         "occupancy": r.occupancy_summary(),
+        # round-11 lane-waste attribution: the device-counted
+        # decomposition of every kernel lane-cycle (reconciles to
+        # lanes x kernel steps; dominant_waste names the bucket the
+        # next ceiling-hunt round should attack)
+        "attribution": r.attribution(),
         # collect-completion deltas: UNRELIABLE as rates — a collect
         # that lands after its run already finished on device returns
         # in ~1 tunnel RTT regardless of device time, so mid-pipeline
@@ -962,7 +967,13 @@ def bench_dd(m: int = 64, eps: float = 1e-10) -> dict:
                "legacy_collective_rounds_per_cycle": round(
                    lg.collective_rounds_per_cycle, 2),
                "tasks_per_chip": rf.metrics.tasks_per_chip,
-           }}
+           },
+           # round-11 lane-waste attribution (mesh aggregate + the
+           # per-chip split the flight recorder reasons over)
+           "attribution": rf.attribution(),
+           "waste_per_chip": (rf.waste_per_chip.tolist()
+                              if rf.waste_per_chip is not None
+                              else None)}
     if n_dev == 1:
         # collectives are degenerate on a 1-chip mesh (psum/all_gather
         # are no-ops); the real refill-vs-legacy comparison lives in
@@ -1163,31 +1174,24 @@ def bench_quick() -> dict:
     signal."""
     import jax
 
-    from ppls_tpu.models.integrands import get_family, get_family_ds
-    from ppls_tpu.parallel.walker import integrate_family_walker
+    # the walker leg is OWNED by tools/bench_history.py: the same
+    # function produces this record, the committed gate reference
+    # (bench_quick_ref.json), and the CI --gate-run measurement, so
+    # the regression gate can never silently measure a different
+    # workload than the committed quick records (round-11 review fix)
+    from tools.bench_history import run_quick_proxies
 
-    theta = 1.0 + np.arange(8) / 8.0
-    kw = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
-              refill_slots=2, seg_iters=32, min_active_frac=0.05)
-    r = integrate_family_walker(
-        get_family("sin_recip_scaled"), get_family_ds("sin_recip_scaled"),
-        theta, (1e-2, 1.0), 1e-7, **kw)
+    proxy = run_quick_proxies()
     stream_rec = bench_stream(quick=True)
     return {
         "metric": "interpret-mode quick proxies",
-        "value": float(r.metrics.tasks),
+        "value": float(proxy["walker"]["tasks"]),
         "unit": "walker tasks (device-counted)",
         "vs_baseline": 0.0,       # no chip: proxies only, by design
         "interpret_mode": jax.default_backend() != "tpu",
-        "walker": {
-            "tasks": r.metrics.tasks,
-            "cycles": r.cycles,
-            "kernel_steps": r.kernel_steps,
-            "boundaries_rounds_plus_segs": r.metrics.rounds,
-            "lane_efficiency": round(r.lane_efficiency, 4),
-            "walker_fraction": round(r.walker_fraction, 4),
-            "occupancy": r.occupancy_summary(),
-        },
+        # the walker block doubles as the regression-gate record
+        # (tools/bench_history.py --gate)
+        "walker": proxy["walker"],
         "secondary": {"stream": stream_rec},
     }
 

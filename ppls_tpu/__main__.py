@@ -462,6 +462,11 @@ def _main_serve(args) -> int:
             lambda: (holder["tel"].registry if "tel" in holder
                      else _empty),
             port=args.metrics_port)
+        # --metrics-port 0 binds an ephemeral port (the only usable
+        # configuration on shared CI hosts): the BOUND port is
+        # announced here (stderr, before the first phase runs) and
+        # again on the summary line, so scrapers and test harnesses
+        # can discover it without racing the run
         print(f"serve: metrics on {metrics_srv.url}", file=sys.stderr,
               flush=True)
 
@@ -517,6 +522,9 @@ def _main_serve(args) -> int:
             "occupancy": res.occupancy_summary(eng.lanes),
             "totals": res.totals,
         }
+        if metrics_srv is not None:
+            summary["metrics_port"] = metrics_srv.port
+            summary["metrics_url"] = metrics_srv.url
         print(json.dumps(summary))
         return 0
     finally:

@@ -23,6 +23,7 @@ live only in host boundary hooks — never inside jitted cycle bodies.
 graftlint GL06 enforces it statically.
 """
 
+from ppls_tpu.obs.flight import ChipFlightRecorder  # noqa: F401
 from ppls_tpu.obs.registry import (  # noqa: F401
     Counter,
     Gauge,
@@ -36,6 +37,7 @@ from ppls_tpu.obs.server import MetricsServer  # noqa: F401
 from ppls_tpu.obs.spans import SpanTracer  # noqa: F401
 from ppls_tpu.obs.telemetry import (  # noqa: F401
     Telemetry,
+    WASTE_BUCKETS,
     default_telemetry,
     set_default,
 )
@@ -47,8 +49,9 @@ from ppls_tpu.utils.metrics import (  # noqa: F401 — absorbed surface
 from ppls_tpu.utils.tracing import annotate, trace  # noqa: F401
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "PHASE_BUCKETS", "SECONDS_BUCKETS", "exp_buckets",
+    "ChipFlightRecorder", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry",
+    "PHASE_BUCKETS", "SECONDS_BUCKETS", "WASTE_BUCKETS", "exp_buckets",
     "MetricsServer", "SpanTracer", "Telemetry", "default_telemetry",
     "set_default", "RoundStats", "RunMetrics", "round_stats_from_rows",
     "annotate", "trace",

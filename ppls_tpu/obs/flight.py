@@ -1,0 +1,139 @@
+"""Per-chip flight recorder (round 11): the attribution face of the
+multi-chip timeline.
+
+The dd stream's phase span used to close with MESH-AGGREGATE counter
+deltas only — a straggling chip was invisible until its skew showed up
+as a slow phase with no named cause (exactly the blindness ROADMAP
+item 5's elastic-mesh work cannot afford). This module turns the
+per-chip values the phase boundary ALREADY fetches (one device pull —
+the telemetry contract is unchanged) into:
+
+* one ``chip`` child span per chip under the open ``phase`` span,
+  closing with that chip's device-counted deltas — kernel steps,
+  tasks, lane-waste buckets — plus its bank occupancy (live rows) and
+  the phase's bank-occupancy delta;
+* a ``collective_boundary`` event when the phase paid lockstep
+  collective rounds (the ``crounds`` delta);
+* registry gauges for chip bank-occupancy max/min/spread and work-
+  share max/min (``Telemetry.publish_chip_balance``);
+* a STRAGGLER DETECTOR: a chip whose share of the phase's kernel
+  steps exceeds ``straggler_share`` for ``straggler_phases``
+  CONSECUTIVE phases emits a ``straggler`` event (chip, share, streak
+  length) and bumps ``ppls_straggler_events_total``; the streak then
+  restarts, so a persistently skewed chip re-fires every
+  ``straggler_phases`` phases instead of spamming every phase.
+
+Every span/event attribute except the timestamps is device-counted or
+deterministically derived from device counts, so the flight-recorder
+timeline is bit-stable across reruns and kill-and-resume — the same
+determinism contract as the phase rows (tests/test_obs.py pins it on
+the virtual 8-mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ppls_tpu.obs.telemetry import Telemetry, WASTE_BUCKETS
+
+
+class ChipFlightRecorder:
+    """Boundary-hook publisher of per-chip phase attribution.
+
+    ``record_phase`` MUST be called while the phase span is open (the
+    chip spans nest under the innermost open span) and only with
+    host values the boundary already holds — it performs no device
+    work of its own (graftlint GL06 polices that statically).
+    """
+
+    def __init__(self, telemetry: Telemetry, n_dev: int,
+                 engine: str = "walker-dd-stream",
+                 straggler_share: Optional[float] = None,
+                 straggler_phases: int = 3):
+        self.tel = telemetry
+        self.n_dev = int(n_dev)
+        self.engine = engine
+        # default threshold: 2x the fair share, capped below 1 so a
+        # 2-chip mesh can still trip it
+        self.straggler_share = (float(straggler_share)
+                                if straggler_share is not None
+                                else min(0.9, 2.0 / max(n_dev, 1)))
+        self.straggler_phases = max(int(straggler_phases), 1)
+        self._streak = [0] * self.n_dev
+        lab = ("engine",)
+        reg = telemetry.registry
+        self._c_straggler = reg.counter(
+            "ppls_straggler_events_total",
+            "chips whose kernel-step share exceeded the straggler "
+            "threshold for the configured number of consecutive "
+            "phases", lab).labels(engine=engine)
+        self._g_occ_max = reg.gauge(
+            "ppls_chip_occupancy_max",
+            "largest per-chip live-row (bank occupancy) count after "
+            "the last phase", lab).labels(engine=engine)
+        self._g_occ_min = reg.gauge(
+            "ppls_chip_occupancy_min",
+            "smallest per-chip live-row (bank occupancy) count after "
+            "the last phase", lab).labels(engine=engine)
+        self._g_occ_spread = reg.gauge(
+            "ppls_chip_occupancy_spread",
+            "per-chip live-row max/min ratio after the last phase "
+            "(1.0 = perfectly balanced)", lab).labels(engine=engine)
+
+    def record_phase(self, phase: int, *, wsteps, tasks, live_rows,
+                     bank_delta, waste=None, crounds: int = 0) -> None:
+        """One phase's per-chip attribution. All arguments are host
+        sequences of per-chip values (deltas for wsteps/tasks/waste;
+        absolutes for live_rows) the boundary fetch already produced."""
+        tel = self.tel
+        n = self.n_dev
+        wsteps = [int(v) for v in wsteps]
+        total_steps = sum(wsteps)
+        for chip in range(n):
+            attrs = dict(chip=chip,
+                         wsteps=wsteps[chip],
+                         tasks=int(tasks[chip]),
+                         live_rows=int(live_rows[chip]),
+                         bank_delta=int(bank_delta[chip]))
+            if waste is not None:
+                for k, v in zip(WASTE_BUCKETS, waste[chip]):
+                    attrs[k] = int(v)
+            # one child span per chip under the open phase span: open
+            # and close back-to-back — the chip's "duration" is not
+            # host-measurable (chips run inside one device program),
+            # the span exists to carry the attribution attrs in a
+            # shape timeline viewers nest correctly
+            tel.span("chip", chip=chip).close(
+                **{k: v for k, v in attrs.items() if k != "chip"})
+        if crounds:
+            tel.event("collective_boundary", phase=int(phase),
+                      crounds=int(crounds))
+
+        # registry face: bank-occupancy spread + work-share balance
+        rows = [int(v) for v in live_rows]
+        mx, mn = max(rows), min(rows)
+        self._g_occ_max.set(mx)
+        self._g_occ_min.set(mn)
+        self._g_occ_spread.set(mx / max(mn, 1))
+        if total_steps > 0:
+            tel.publish_chip_balance(self.engine, wsteps)
+
+        # straggler detector: consecutive-phase share breach.
+        # Undefined on a 1-chip mesh (the sole chip's share is always
+        # 1.0 — bench_dd treats n_dev == 1 as a legal degenerate case,
+        # so it must not spam straggler events every K phases).
+        if n < 2:
+            return
+        for chip in range(n):
+            share = (wsteps[chip] / total_steps) if total_steps else 0.0
+            if total_steps and share > self.straggler_share:
+                self._streak[chip] += 1
+            else:
+                self._streak[chip] = 0
+            if self._streak[chip] >= self.straggler_phases:
+                self._c_straggler.inc()
+                tel.event("straggler", chip=chip, phase=int(phase),
+                          share=round(share, 4),
+                          phases=self._streak[chip],
+                          threshold=round(self.straggler_share, 4))
+                self._streak[chip] = 0

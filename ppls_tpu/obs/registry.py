@@ -80,11 +80,27 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and line feed must be escaped (in that order — escaping the
+    backslash first keeps the other two escapes unambiguous). A label
+    value carrying any of them used to produce an unparseable
+    exposition line that silently broke every scraper."""
+    return v.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping per the text format: backslash and line feed
+    only (quotes are legal in HELP text)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _label_str(labelnames: Sequence[str], labelvalues: Sequence[str]
                ) -> str:
     if not labelnames:
         return ""
-    inner = ",".join(f'{k}="{v}"'
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
                      for k, v in zip(labelnames, labelvalues))
     return "{" + inner + "}"
 
@@ -317,7 +333,7 @@ class MetricsRegistry:
             families = sorted(self._families.items())
         for name, fam in families:
             if fam.help:
-                lines.append(f"# HELP {name} {fam.help}")
+                lines.append(f"# HELP {name} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {name} {fam.kind}")
             for key, child in fam.items():
                 ls = _label_str(fam.labelnames, key)

@@ -34,6 +34,32 @@ from ppls_tpu.obs.spans import SpanTracer
 _RUN_COUNTERS = ("tasks", "splits", "leaves", "rounds",
                  "integrand_evals")
 
+# round-11 lane-waste attribution buckets (walker.WASTE_FIELDS order;
+# spelled locally so the pure-Python obs layer stays importable with no
+# jax — analyze_occupancy --from-events depends on that)
+WASTE_BUCKETS = ("eval_active", "masked_dead", "refill_stall",
+                 "drain_tail")
+
+
+def build_attribution(buckets: dict, lane_cycles: int) -> dict:
+    """THE attribution record: one builder for every reader —
+    ``WalkerResult.attribution()``, ``StreamResult.occupancy_summary``,
+    and the analyze-occupancy printers — so the dominant-bucket rule
+    and the reconciliation definition can never diverge between bench,
+    serve, and the offline tools."""
+    lane_cycles = int(lane_cycles)
+    buckets = {k: int(buckets.get(k, 0)) for k in WASTE_BUCKETS}
+    wasted = {k: buckets[k] for k in WASTE_BUCKETS[1:]}
+    return {
+        "lane_cycles": lane_cycles,
+        "buckets": buckets,
+        "fractions": {k: (round(v / lane_cycles, 4) if lane_cycles
+                          else 0.0) for k, v in buckets.items()},
+        "reconciles": sum(buckets.values()) == lane_cycles,
+        "dominant_waste": (max(wasted, key=wasted.get)
+                           if any(wasted.values()) else None),
+    }
+
 
 class Telemetry:
     """Registry + tracer behind one handle (see module docstring)."""
@@ -44,6 +70,12 @@ class Telemetry:
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.tracer = SpanTracer(events_path, meta=meta, append=append)
+        # compile observability (round 11): last-seen pjit cache entry
+        # count per engine, so growth — a recompile under the
+        # compile-once invariant — surfaces as an event + counter
+        # instead of only failing the conftest guard
+        self._compile_seen: dict = {}
+        self._compile_lock = threading.Lock()
 
     # -- tracer passthroughs ------------------------------------------------
 
@@ -61,9 +93,14 @@ class Telemetry:
 
     def publish_run(self, engine: str, metrics, *, cycles: int = 0,
                     crounds: int = 0, lane_efficiency: float = 0.0,
-                    walker_fraction: float = 0.0) -> None:
+                    walker_fraction: float = 0.0,
+                    waste=None, tasks_per_chip=None) -> None:
         """Run-completion boundary: fold one finished batch run's
-        ``RunMetrics`` into the registry (labeled by engine)."""
+        ``RunMetrics`` into the registry (labeled by engine).
+
+        ``waste`` (round 11) is the 4-vector of device-counted
+        lane-waste buckets (WASTE_BUCKETS order); ``tasks_per_chip``
+        feeds the chip-balance gauges on multi-chip runs."""
         reg = self.registry
         lab = ("engine",)
         reg.counter("ppls_runs_total",
@@ -89,6 +126,35 @@ class Telemetry:
                   "share of tasks done by the Pallas kernel "
                   "(last run)", lab) \
             .labels(engine=engine).set(float(walker_fraction))
+        if waste is not None:
+            fam = reg.counter(
+                "ppls_lane_cycles_total",
+                "kernel lane-cycles by attribution bucket "
+                "(eval_active + masked_dead + refill_stall + "
+                "drain_tail = lanes x kernel steps)",
+                ("engine", "bucket"))
+            for k, v in zip(WASTE_BUCKETS, waste):
+                fam.labels(engine=engine, bucket=k).inc(float(v))
+        if tasks_per_chip is not None and len(tasks_per_chip) > 1:
+            self.publish_chip_balance(engine, tasks_per_chip)
+
+    def publish_chip_balance(self, engine: str, per_chip) -> None:
+        """Chip-balance gauges (round-11 flight recorder): max/min/
+        spread of a per-chip work vector — the registry face of the
+        per-chip spans the dd stream writes to the events file."""
+        vals = [float(v) for v in per_chip]
+        mx, mn = max(vals), min(vals)
+        lab = ("engine",)
+        g = self.registry.gauge
+        g("ppls_chip_share_max", "largest per-chip work share "
+          "(last run/phase)", lab).labels(engine=engine) \
+            .set(mx / max(sum(vals), 1.0))
+        g("ppls_chip_share_min", "smallest per-chip work share "
+          "(last run/phase)", lab).labels(engine=engine) \
+            .set(mn / max(sum(vals), 1.0))
+        g("ppls_chip_spread", "per-chip work max/min ratio "
+          "(1.0 = perfectly balanced)", lab).labels(engine=engine) \
+            .set(mx / max(mn, 1.0))
 
     def publish_compile_cache(self, engine: str, entries: int) -> None:
         self.registry.gauge(
@@ -96,6 +162,49 @@ class Telemetry:
             "pjit cache entries of the engine's cycle program "
             "(compile-once invariant: stays at 1)",
             ("engine",)).labels(engine=engine).set(float(entries))
+
+    def publish_compile(self, engine: str, entries: int,
+                        wall_s: float = 0.0) -> None:
+        """Compile observability (round 11), wired through the
+        compile-once guard surface (``fn._cache_size()``): publish the
+        engine's pjit cache entry count, and when it GREW since this
+        handle last looked, emit a ``jit_cache_entry`` event and count
+        it — entries beyond the engine's first observation are
+        recompiles under the compile-once invariant, so any recompile
+        shows up in the events file and on /metrics instead of only
+        failing a test. ``wall_s`` is the caller's wall clock for the
+        step/run that grew the cache (the stream attributes its phase
+        wall; batch engines pass 0 — their compile happens inside one
+        opaque run call)."""
+        entries = int(entries)
+        with self._compile_lock:
+            prev = self._compile_seen.get(engine)
+            self._compile_seen[engine] = entries
+        self.publish_compile_cache(engine, entries)
+        if prev is not None and entries > prev:
+            delta = entries - prev
+            lab = ("engine",)
+            self.registry.counter(
+                "ppls_recompiles_total",
+                "pjit cache growth events after the engine's first "
+                "observation (compile-once invariant violations)",
+                lab).labels(engine=engine).inc(delta)
+            if wall_s:
+                self.registry.counter(
+                    "ppls_compile_wall_seconds_total",
+                    "wall seconds of steps that grew the pjit cache "
+                    "(compile + retrace time, attributed per engine)",
+                    lab).labels(engine=engine).inc(float(wall_s))
+            self.event("jit_cache_entry", engine=engine,
+                       entries=entries, new_entries=delta,
+                       wall_s=round(float(wall_s), 6))
+        elif prev is None:
+            # first observation: baseline, not a recompile — but the
+            # cache-entry count still lands in the timeline so a
+            # TPU-attached round's compile cadence is reconstructable
+            self.event("jit_cache_entry", engine=engine,
+                       entries=entries, new_entries=0,
+                       wall_s=round(float(wall_s), 6))
 
     # stream-specific registration helpers (the stream engine owns the
     # calls; centralizing the names/buckets here keeps bench + serve +

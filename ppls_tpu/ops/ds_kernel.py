@@ -246,6 +246,18 @@ def _exp_poly(r: DS) -> DS:
     return ds_add(ds_add(one, r), ds_mul(ds_mul(r, r), p))
 
 
+def mask_count(mask) -> jnp.ndarray:
+    """Scalar int32 popcount of a boolean lane mask, Mosaic-safe.
+
+    The count accumulates in f32 — exact for any lane grid up to 2^24
+    rows*128 — because the integer-sum path promotes to int64 under
+    global x64, which Mosaic cannot lower. This is THE in-kernel
+    counting primitive of the walker kernels (live-lane exits, refill
+    candidates, and the round-11 lane-waste buckets); keeping it here
+    means every kernel counts the same way."""
+    return jnp.sum(mask.astype(_F32)).astype(jnp.int32)
+
+
 def ds_exp(x: DS) -> DS:
     """exp(x) in ds precision; results below the f32 subnormal range
     flush to 0 (the argument range of interest is |x| <= ~88)."""

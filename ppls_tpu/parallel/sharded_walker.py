@@ -136,6 +136,9 @@ class _DDCarry(NamedTuple):
     #                         taken phase reshards (replicated by
     #                         construction — every chip counts the same
     #                         lockstep collectives)
+    waste: jnp.ndarray      # (4,) i64 per-chip lane-waste buckets
+    #                         (walker.WASTE_FIELDS; reconcile to
+    #                         lanes x wsteps per chip)
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32 (replicated by construction)
     overflow: jnp.ndarray   # bool (replicated via psum)
@@ -425,6 +428,7 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             wsteps=c.wsteps + walk.steps.astype(jnp.int64),
             srows=c.srows + srows_d,
             crounds=bred.crounds + d_crounds,
+            waste=c.waste + walk.waste,
             maxd=jnp.maximum(jnp.maximum(bred.maxd, bag3.max_depth),
                              jnp.max(walk.lanes.maxd)),
             cycles=c.cycles + 1,
@@ -460,14 +464,14 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
     def shard_body(bag_l, bag_r, bag_th, bag_meta, count, acc, tasks,
                    splits, btasks, wtasks, wsplits, roots, rounds, segs,
-                   wsteps, srows, crounds, maxd, cycles, overflow,
+                   wsteps, srows, crounds, waste, maxd, cycles, overflow,
                    *admit_args):
         c = _DDCarry(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
                      bag_meta=bag_meta, count=count[0], acc=acc[0],
                      tasks=tasks[0], splits=splits[0], btasks=btasks[0],
                      wtasks=wtasks[0], wsplits=wsplits[0], roots=roots[0],
                      rounds=rounds[0], segs=segs[0], wsteps=wsteps[0],
-                     srows=srows[0], crounds=crounds[0],
+                     srows=srows[0], crounds=crounds[0], waste=waste[0],
                      maxd=maxd[0], cycles=cycles[0], overflow=overflow[0])
         if admit_window:
             adm_l, adm_r, adm_th, adm_meta, adm_n, adm_clear = admit_args
@@ -479,14 +483,14 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                out.splits[None], out.btasks[None], out.wtasks[None],
                out.wsplits[None], out.roots[None], out.rounds[None],
                out.segs[None], out.wsteps[None], out.srows[None],
-               out.crounds[None],
+               out.crounds[None], out.waste[None],
                out.maxd[None], out.cycles[None], out.overflow[None])
         if admit_window:
             res = res + (_fam_live_local(out)[None],)
         return res
 
     sh = P(axis)
-    n_state = 20
+    n_state = 21
     n_in = n_state + (6 if admit_window else 0)
     n_out = n_state + (1 if admit_window else 0)
     # check_vma=False: the Pallas segment kernel's out_shape carries no
@@ -625,6 +629,10 @@ def integrate_family_walker_dd(
              "rounds", "segs", "wsteps", "srows", "crounds")
     per_chip = {k: np.zeros(n_dev, dtype=np.int64) for k in CTR64}
     per_chip["maxd"] = np.zeros(n_dev, dtype=np.int32)
+    # round-11 lane-waste buckets, (n_dev, 4) — per-chip, unlike the
+    # scalar CTR64 counters, so the flight recorder can attribute
+    # straggler wsteps chip by chip
+    per_chip["waste"] = np.zeros((n_dev, 4), dtype=np.int64)
     acc0 = np.zeros((n_dev, m), dtype=np.float64)
     cycles_done = 0
     if _totals_override is not None:
@@ -637,6 +645,9 @@ def integrate_family_walker_dd(
                 dtype=np.int64)
         per_chip["maxd"] = np.asarray(_totals_override["pc_maxd"],
                                       dtype=np.int32)
+        per_chip["waste"] = np.asarray(
+            _totals_override.get("waste", per_chip["waste"]),
+            dtype=np.int64).reshape(n_dev, 4)
         cycles_done = int(_totals_override["cycles"])
 
     t0 = time.perf_counter()
@@ -646,6 +657,7 @@ def integrate_family_walker_dd(
              jnp.asarray(count0, dtype=jnp.int32),
              jnp.asarray(acc0))
     counters = tuple(jnp.asarray(per_chip[k]) for k in CTR64) + (
+        jnp.asarray(per_chip["waste"]),
         jnp.asarray(per_chip["maxd"]),
         jnp.zeros(n_dev, dtype=jnp.int32),
         jnp.zeros(n_dev, dtype=bool))
@@ -655,13 +667,13 @@ def integrate_family_walker_dd(
         out = run(*state, *counters)
         (bl, br, bth, bmeta, count, acc, tasks_c, splits_c, bt_c, wt_c,
          ws_c, roots_c, rounds_c, segs_c, wsteps_c, srows_c, crounds_c,
-         maxd_c, cycles_c, ovf_c) = out
+         waste_c, maxd_c, cycles_c, ovf_c) = out
         (count_h, tasks_h, splits_h, bt_h, wt_h, ws_h, roots_h, rounds_h,
-         segs_h, wsteps_h, srows_h, crounds_h, maxd_h, cycles_h,
+         segs_h, wsteps_h, srows_h, crounds_h, waste_h, maxd_h, cycles_h,
          ovf_h) = jax.device_get(
              (count, tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
-              rounds_c, segs_c, wsteps_c, srows_c, crounds_c, maxd_c,
-              cycles_c, ovf_c))
+              rounds_c, segs_c, wsteps_c, srows_c, crounds_c, waste_c,
+              maxd_c, cycles_c, ovf_c))
         left = int(np.sum(count_h))
         overflow = bool(np.any(ovf_h))
         for k, v in zip(CTR64, (tasks_h, splits_h, bt_h, wt_h, ws_h,
@@ -669,6 +681,7 @@ def integrate_family_walker_dd(
                                 srows_h, crounds_h)):
             per_chip[k] = np.asarray(v, dtype=np.int64)
         per_chip["maxd"] = np.asarray(maxd_h, dtype=np.int32)
+        per_chip["waste"] = np.asarray(waste_h, dtype=np.int64)
         cycles_done += int(np.max(cycles_h))
         if checkpoint_path is None or overflow or left == 0:
             break
@@ -691,6 +704,7 @@ def integrate_family_walker_dd(
         acc_h = np.asarray(jax.device_get(acc))
         totals = {"pc_" + k: per_chip[k].tolist() for k in CTR64}
         totals["pc_maxd"] = per_chip["maxd"].tolist()
+        totals["waste"] = per_chip["waste"].tolist()
         totals["cycles"] = cycles_done
         totals["acc_per_chip"] = acc_h.tolist()
         save_family_checkpoint(
@@ -707,7 +721,7 @@ def integrate_family_walker_dd(
         state = (bl, br, bth, bmeta, count, acc)
         counters = (tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
                     rounds_c, segs_c, wsteps_c, srows_c, crounds_c,
-                    maxd_c,
+                    waste_c, maxd_c,
                     jnp.zeros(n_dev, dtype=jnp.int32), ovf_c)
     acc_h = np.asarray(jax.device_get(acc))
     wall = time.perf_counter() - t0
@@ -759,15 +773,20 @@ def integrate_family_walker_dd(
         tasks_per_chip=tasks_per_chip,
     )
     denom = tot["wsteps"] * lanes
+    waste_pc = np.asarray(per_chip["waste"], dtype=np.int64)
+    waste_tot = waste_pc.sum(axis=0)
     # run-completion telemetry boundary (round 10): the per-chip
     # counters were already pulled once at the leg boundary above —
     # publishing is host dict arithmetic, no extra device fetch
     from ppls_tpu.obs.telemetry import default_telemetry
-    default_telemetry().publish_run(
+    tel = default_telemetry()
+    tel.publish_run(
         "walker-dd", metrics, cycles=tot["cycles"],
         crounds=tot["crounds"],
         lane_efficiency=wtasks / denom if denom else 0.0,
-        walker_fraction=wtasks / tasks if tasks else 0.0)
+        walker_fraction=wtasks / tasks if tasks else 0.0,
+        waste=waste_tot, tasks_per_chip=tasks_per_chip)
+    tel.publish_compile("walker-dd", run._cache_size())
     return WalkerResult(
         areas=areas,
         metrics=metrics,
@@ -783,6 +802,8 @@ def integrate_family_walker_dd(
         # taken phase reshards) — the refill mode's acceptance number
         # is collective_rounds / cycles strictly below legacy's
         collective_rounds=tot["crounds"],
+        waste=waste_tot,
+        waste_per_chip=waste_pc,
     )
 
 

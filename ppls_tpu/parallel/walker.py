@@ -450,6 +450,9 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             resh_ref = refs[13 + 2 * n_fields]
             resl_ref = refs[14 + 2 * n_fields]
             steps_ref = refs[15 + 2 * n_fields]
+            # round-11 lane-waste accounting: one (1, 1) SMEM scalar per
+            # bucket (eval_active, masked_dead, refill_stall, drain_tail)
+            waste_refs = refs[16 + 2 * n_fields:20 + 2 * n_fields]
 
             s0 = WalkState(*(r[:] for r in in_refs))
             slot0 = slot_ref[:]
@@ -531,9 +534,11 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             live0, nref0 = counts(s0, slot0)
             resh0 = tuple(z32 for _ in range(R))
             resl0 = tuple(z32 for _ in range(R))
+            n_lanes = jnp.int32(s0.i.size)
+            zc = jnp.int32(0)
 
             def cond(c):
-                k, st, sl, live, nref, resh, resl = c
+                k, st, sl, live, nref, resh, resl = c[:7]
                 return jnp.logical_or(
                     k == 0,
                     jnp.logical_and(
@@ -541,7 +546,7 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                         jnp.logical_or(live > thresh, nref > 0)))
 
             def body(c):
-                k, st, sl, live, nref, resh, resl = c
+                k, st, sl, live, nref, resh, resl, wa, wd, ws, wt = c
                 # refill BEFORE the step: freshly parked lanes from the
                 # previous step join the candidate pool, and a fully
                 # parked start (phase seeding) refills on iteration 0
@@ -550,13 +555,37 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                     jnp.logical_or(nref >= batch, live <= thresh))
                 st, sl, resh, resl = lax.cond(
                     do, do_refill, lambda op: op, (st, sl, resh, resl))
+                # lane-waste classification of the state THIS step
+                # evaluates (post-refill): a live lane's eval is useful
+                # work; a parked lane's benign eval is wasted and splits
+                # by cause — takeable (waiting on the refill batch
+                # cadence) = refill-stall; no-root with nothing left to
+                # take = masked-dead (never fed this phase); the rest
+                # (finished its slots, or OVF) = drain-tail. The four
+                # buckets partition the lane set every step, so their
+                # phase sums reconcile to lanes x steps exactly.
+                parked = (st.flags & _PARKED) != 0
+                noroot = (st.flags & _NO_ROOT) != 0
+                ovfl = (st.flags & _OVF) != 0
+                takeable = jnp.logical_and(
+                    jnp.logical_and(parked, jnp.logical_not(ovfl)),
+                    sl < nslots)
+                live_n = dsk.mask_count(jnp.logical_not(parked))
+                stall_n = dsk.mask_count(takeable)
+                dead_n = dsk.mask_count(jnp.logical_and(
+                    noroot, jnp.logical_not(takeable)))
+                tail_n = n_lanes - live_n - stall_n - dead_n
                 st = step(st)
                 live, nref = counts(st, sl)
-                return k + 1, st, sl, live, nref, resh, resl
+                return (k + 1, st, sl, live, nref, resh, resl,
+                        wa + live_n, wd + dead_n, ws + stall_n,
+                        wt + tail_n)
 
-            k, out, slot_o, _, _, resh, resl = lax.while_loop(
-                cond, body,
-                (jnp.int32(0), s0, slot0, live0, nref0, resh0, resl0))
+            (k, out, slot_o, _, _, resh, resl, wa, wd, ws, wt) = \
+                lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), s0, slot0, live0, nref0, resh0,
+                     resl0, zc, zc, zc, zc))
             for r, v in zip(out_refs, out):
                 r[:] = v
             slot_out_ref[:] = slot_o
@@ -564,28 +593,33 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                 resh_ref[kk] = resh[kk]
                 resl_ref[kk] = resl[kk]
             steps_ref[0, 0] = k
+            for r, v in zip(waste_refs, (wa, wd, ws, wt)):
+                r[0, 0] = v
 
         def run_segment_rf(state: WalkState, slot, thresh, cap, batch,
                            nslots, bank):
             """One refill-kernel launch. ``bank`` is the 7-tuple of
             (R, rows, 128) dealt root arrays; returns (state, slot,
-            resbank_h, resbank_l, steps)."""
+            resbank_h, resbank_l, steps, waste4) where ``waste4`` is
+            the launch's device-counted lane-waste bucket 4-tuple."""
             shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
                            for x in state)
             bank_shape = (R,) + state.a_h.shape
             smem = pl.BlockSpec(memory_space=pltpu.SMEM)
             vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+            scalar = jax.ShapeDtypeStruct((1, 1), jnp.int32)
             out = pl.pallas_call(
                 kernel_rf,
                 out_shape=shapes + (
                     jax.ShapeDtypeStruct(state.i.shape, jnp.int32),
                     jax.ShapeDtypeStruct(bank_shape, jnp.float32),
                     jax.ShapeDtypeStruct(bank_shape, jnp.float32),
-                    jax.ShapeDtypeStruct((1, 1), jnp.int32)),
+                    scalar, scalar, scalar, scalar, scalar),
                 in_specs=[smem, smem, smem]
                 + [vmem] * (1 + 7 + 1)
                 + [vmem] * n_fields,
-                out_specs=(vmem,) * n_fields + (vmem, vmem, vmem, smem),
+                out_specs=(vmem,) * n_fields
+                + (vmem, vmem, vmem) + (smem,) * 5,
                 interpret=interpret,
             )(thresh.reshape(1, 1).astype(jnp.int32),
               cap.reshape(1, 1).astype(jnp.int32),
@@ -593,7 +627,8 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
               nslots, *bank, slot, *state)
             return (WalkState(*out[:n_fields]), out[n_fields],
                     out[n_fields + 1], out[n_fields + 2],
-                    out[n_fields + 3][0, 0])
+                    out[n_fields + 3][0, 0],
+                    tuple(out[n_fields + 4 + j][0, 0] for j in range(4)))
 
         return run_segment_rf
 
@@ -629,49 +664,71 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         in_refs = refs[2:2 + n_fields]
         out_refs = refs[2 + n_fields:2 + 2 * n_fields]
         steps_ref = refs[2 + 2 * n_fields]
+        # round-11 lane-waste accounting: eval-active, masked-dead
+        # (parked, no root), and parked-with-root step counts. The
+        # kernel cannot see the root queue, so the XLA boundary splits
+        # the third bucket into refill-stall (queue had roots: the lane
+        # was waiting for the segment's bank/refill boundary) vs
+        # drain-tail (queue dry: nothing could have fed it).
+        wa_ref, wd_ref, wr_ref = refs[3 + 2 * n_fields:6 + 2 * n_fields]
         s = WalkState(*(r[:] for r in in_refs))
         thresh = thresh_ref[0, 0]
         cap = cap_ref[0, 0]
+        n_lanes = jnp.int32(s.i.size)
 
         def live_count(st):
-            # f32 accumulation: exact for lanes <= 2^24, and avoids the
-            # int64-promoting integer-sum path Mosaic cannot lower under
-            # global x64
-            live = ((st.flags & _PARKED) == 0).astype(jnp.float32)
-            return jnp.sum(live).astype(jnp.int32)
+            # shared f32-accumulation popcount (exact <= 2^24 lanes;
+            # the integer-sum path int64-promotes under global x64,
+            # which Mosaic cannot lower)
+            return dsk.mask_count((st.flags & _PARKED) == 0)
 
         def cond(carry):
-            k, st = carry
+            k, _, live = carry[:3]
             # always take at least one step (the XLA loop guarantees
             # progress is useful before launching), never exceed the cap
             return jnp.logical_or(
                 k == 0,
-                jnp.logical_and(k < cap, live_count(st) > thresh))
+                jnp.logical_and(k < cap, live > thresh))
 
         def body(carry):
-            k, st = carry
-            return k + 1, step(st)
+            # the live count is threaded through the carry (computed
+            # once per step, read by cond AND the waste accounting —
+            # while_loop's cond/body are separate programs with no
+            # cross-CSE, so recomputing it would double the per-step
+            # popcount cost)
+            k, st, live_n, wa, wd, wr = carry
+            dead_n = dsk.mask_count((st.flags & _NO_ROOT) != 0)
+            st2 = step(st)
+            return (k + 1, st2, live_count(st2), wa + live_n,
+                    wd + dead_n, wr + (n_lanes - live_n - dead_n))
 
-        k, out = lax.while_loop(cond, body, (jnp.int32(0), s))
+        zc = jnp.int32(0)
+        k, out, _, wa, wd, wr = lax.while_loop(
+            cond, body, (jnp.int32(0), s, live_count(s), zc, zc, zc))
         for r, v in zip(out_refs, out):
             r[:] = v
         steps_ref[0, 0] = k
+        wa_ref[0, 0] = wa
+        wd_ref[0, 0] = wd
+        wr_ref[0, 0] = wr
 
     def run_segment_ee(state: WalkState, thresh, cap):
         shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
                        for x in state)
         smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+        scalar = jax.ShapeDtypeStruct((1, 1), jnp.int32)
         out = pl.pallas_call(
             kernel_ee,
-            out_shape=shapes + (jax.ShapeDtypeStruct((1, 1), jnp.int32),),
+            out_shape=shapes + (scalar, scalar, scalar, scalar),
             in_specs=[smem, smem]
             + [pl.BlockSpec(memory_space=pltpu.VMEM)] * n_fields,
             out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * n_fields
-            + (smem,),
+            + (smem,) * 4,
             interpret=interpret,
         )(thresh.reshape(1, 1).astype(jnp.int32),
           cap.reshape(1, 1).astype(jnp.int32), *state)
-        return WalkState(*out[:n_fields]), out[n_fields][0, 0]
+        return (WalkState(*out[:n_fields]), out[n_fields][0, 0],
+                tuple(out[n_fields + 1 + j][0, 0] for j in range(3)))
 
     return run_segment_ee
 
@@ -687,16 +744,32 @@ C_CAP = 64      # per-cycle stats ring rows
 
 # column order of the per-segment stats ring (one row per kernel segment)
 SEG_STAT_FIELDS = ("steps", "live_at_exit", "queue_left", "refilled")
+# Round-11 lane-waste attribution buckets: every kernel lane-step of a
+# walk phase lands in exactly one —
+#   eval_active:  the lane was live, its eval was useful work;
+#   masked_dead:  parked with no root and nothing left to take (a lane
+#                 the deal never fed, structurally masked all phase);
+#   refill_stall: parked but refillable — waiting on the refill batch
+#                 cadence (in-kernel) or the segment's XLA boundary
+#                 (legacy mode with a non-dry queue);
+#   drain_tail:   parked with work exhausted (bank/queue dry, or OVF) —
+#                 burning steps until the phase suspends.
+# RECONCILIATION INVARIANT: the four sums equal lanes x kernel steps per
+# phase, device-counted end to end (BASELINE.md round 11).
+WASTE_FIELDS = ("eval_active", "masked_dead", "refill_stall",
+                "drain_tail")
+
 # column order of the per-cycle stats ring (one row per engine cycle).
 # `tasks`/`splits` (round 10) are the cycle's aggregate device counts —
 # the columns utils.metrics.round_stats_from_rows reads to give every
-# engine the shared per-round RoundStats record; appended LAST so the
+# engine the shared per-round RoundStats record; the round-11 lane-waste
+# buckets (WASTE_FIELDS) follow. Tail columns are appended LAST so the
 # positional readers (occupancy_summary, analyze_occupancy) keep their
 # column indexes.
 CYCLE_STAT_FIELDS = ("bred_roots", "breed_iters", "roots_consumed",
                      "walker_tasks", "walker_steps", "segments",
                      "expand_tasks", "drain_tasks", "sort_rows",
-                     "tasks", "splits")
+                     "tasks", "splits") + WASTE_FIELDS
 
 
 class _WalkCarry(NamedTuple):
@@ -709,6 +782,7 @@ class _WalkCarry(NamedTuple):
                             # makes this != segs*seg_iters)
     gsegs: jnp.ndarray      # int32 global segment counter (ring index)
     seg_stats: jnp.ndarray  # (S_CAP, len(SEG_STAT_FIELDS)) int32 ring
+    waste: jnp.ndarray      # (4,) i64 lane-waste buckets (WASTE_FIELDS)
 
 
 def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
@@ -985,7 +1059,8 @@ def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
     return _WalkCarry(lanes=new_lanes, bag=c.bag,
                       cursor=c.cursor + n_taken, acc=acc,
                       segs=c.segs + 1, steps=c.steps,
-                      gsegs=c.gsegs, seg_stats=c.seg_stats)
+                      gsegs=c.gsegs, seg_stats=c.seg_stats,
+                      waste=c.waste)
 
 
 def _idle_lanes(s: WalkState):
@@ -1039,7 +1114,8 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
                        acc=jnp.zeros(m, jnp.float64), segs=jnp.int32(-1),
                        steps=jnp.int32(0),
                        gsegs=jnp.asarray(gsegs0, jnp.int32),
-                       seg_stats=seg_stats0)
+                       seg_stats=seg_stats0,
+                       waste=jnp.zeros(4, jnp.int64))
     carry = _bank_and_refill(carry, m, lanes)   # initial seeding
     min_active = jnp.int32(int(lanes * min_active_frac))
     exit_thresh = jnp.int32(int(lanes * exit_frac))
@@ -1071,7 +1147,8 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
         thresh = jnp.where(queue_left > 0, exit_thresh,
                            jnp.maximum(min_active, suspend_thresh))
         cap = jnp.clip(step_budget - c.steps, 1, seg_iters)
-        new_lanes, si_used = run_segment(c.lanes, thresh, cap)
+        new_lanes, si_used, (wa, wd, wr) = run_segment(c.lanes, thresh,
+                                                       cap)
         live_exit = lanes - jnp.sum((new_lanes.flags & _PARKED) != 0,
                                     dtype=jnp.int32)
         out = _bank_and_refill(c._replace(lanes=new_lanes), m, lanes)
@@ -1080,8 +1157,19 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
         stats = lax.dynamic_update_slice(
             out.seg_stats, row[None, :],
             (jnp.minimum(out.gsegs, S_CAP - 1), jnp.int32(0)))
+        # lane-waste buckets (WASTE_FIELDS order): the kernel counts
+        # parked-with-root steps as one number; the queue state at
+        # launch decides the cause — roots were available, so parked
+        # lanes were waiting on this boundary (refill_stall), or the
+        # queue was dry and nothing could feed them (drain_tail)
+        zq = jnp.zeros((), jnp.int32)
+        waste_row = jnp.stack([
+            wa, wd,
+            jnp.where(queue_left > 0, wr, zq),
+            jnp.where(queue_left > 0, zq, wr)]).astype(jnp.int64)
         return out._replace(steps=out.steps + si_used,
-                            gsegs=out.gsegs + 1, seg_stats=stats)
+                            gsegs=out.gsegs + 1, seg_stats=stats,
+                            waste=out.waste + waste_row)
 
     out = lax.while_loop(cond, body, carry)
     # Final credit: lanes still mid-walk (suspended) hold accepted-leaf
@@ -1257,17 +1345,19 @@ def _run_walk_kernel_refill(
             slot < nslots), dtype=jnp.int32)
 
     def cond(c):
-        s, slot, resh, resl, steps, segs, gsegs, stats, taken = c
+        s, slot = c[0], c[1]
+        steps = c[4]
         live = lanes - _idle_lanes(s)
         return jnp.logical_and(
             steps < step_budget,
             jnp.logical_or(live > floor, takeable_count(s, slot) > 0))
 
     def body(c):
-        s, slot, resh, resl, steps, segs, gsegs, stats, taken = c
+        (s, slot, resh, resl, steps, segs, gsegs, stats, taken,
+         waste) = c
         cap = jnp.clip(step_budget - steps, 1, seg_iters)
-        s2, slot2, rh, rl, si = run_segment(s, slot, floor, cap, batch,
-                                            nslots, bank)
+        s2, slot2, rh, rl, si, w4 = run_segment(s, slot, floor, cap,
+                                                batch, nslots, bank)
         live_exit = lanes - _idle_lanes(s2)
         taken2 = jnp.sum(slot2, dtype=jnp.int32)
         row = jnp.stack([si, live_exit, top - taken,
@@ -1279,13 +1369,14 @@ def _run_walk_kernel_refill(
         # across the whole phase (slot is monotone), so accumulating
         # per-launch banks by plain addition is exact
         return (s2, slot2, resh + rh, resl + rl, steps + si, segs + 1,
-                gsegs + 1, stats, taken2)
+                gsegs + 1, stats, taken2,
+                waste + jnp.stack(w4).astype(jnp.int64))
 
-    (s, slot, resh, resl, steps, segs, gsegs, stats, taken) = \
+    (s, slot, resh, resl, steps, segs, gsegs, stats, taken, waste) = \
         lax.while_loop(cond, body, (
             lane0, slot0, resbank0, resbank0, jnp.int32(0),
             jnp.int32(0), jnp.asarray(gsegs0, jnp.int32), seg_stats0,
-            jnp.int32(0)))
+            jnp.int32(0), jnp.zeros(4, jnp.int64)))
 
     # Phase-end credit, ONE exact segment-sum: completed-root results
     # from the bank (ids from the dealt meta grid) + every lane's
@@ -1306,7 +1397,7 @@ def _run_walk_kernel_refill(
 
     carry = _WalkCarry(lanes=s, bag=bag, cursor=navail, acc=acc,
                        segs=segs, steps=steps, gsegs=gsegs,
-                       seg_stats=stats)
+                       seg_stats=stats, waste=waste)
     extras = _KernelRefillExtras(slot=slot, nslots=nslots, dealt_l=dl,
                                  dealt_r=dr, dealt_th=dth,
                                  dealt_meta=dmeta, taken=taken)
@@ -1530,6 +1621,7 @@ class _CycleCarry(NamedTuple):
     segs: jnp.ndarray       # i64 walker segments (boundaries)
     wsteps: jnp.ndarray     # i64 walker kernel iterations
     srows: jnp.ndarray      # i64 live rows err-scored by the root sort
+    waste: jnp.ndarray      # (4,) i64 lane-waste buckets (WASTE_FIELDS)
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32
     overflow: jnp.ndarray   # bool
@@ -1602,12 +1694,12 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         ws = jnp.sum(walk.lanes.splits.astype(jnp.int64))
         bag_tasks = bred.tasks + bag3.tasks
         bag_splits = bred.splits + bag3.splits
-        cyc_row = jnp.stack([
+        cyc_row = jnp.concatenate([jnp.stack([
             bred.count.astype(jnp.int64), bred.iters,
             roots_taken, wt,
             walk.steps.astype(jnp.int64), walk.segs.astype(jnp.int64),
             o.bag2_count.astype(jnp.int64), bag3.tasks, srows_d,
-            bag_tasks + wt, bag_splits + ws])
+            bag_tasks + wt, bag_splits + ws]), walk.waste])
         cyc_stats = lax.dynamic_update_slice(
             c.cyc_stats, cyc_row[None, :],
             (jnp.minimum(c.cycles, C_CAP - 1), jnp.int32(0)))
@@ -1631,6 +1723,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             segs=c.segs + walk.segs.astype(jnp.int64),
             wsteps=c.wsteps + walk.steps.astype(jnp.int64),
             srows=c.srows + srows_d,
+            waste=c.waste + walk.waste,
             maxd=jnp.maximum(
                 jnp.maximum(c.maxd, jnp.max(walk.lanes.maxd)),
                 jnp.maximum(bred.max_depth, bag3.max_depth)),
@@ -1649,6 +1742,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         acc=acc0 if acc0 is not None else jnp.zeros(m, jnp.float64),
         tasks=z64, splits=z64, btasks=z64, wtasks=z64, wsplits=z64,
         roots=z64, rounds=z64, segs=z64, wsteps=z64, srows=z64,
+        waste=jnp.zeros(4, jnp.int64),
         maxd=jnp.zeros((), jnp.int32), cycles=jnp.zeros((), jnp.int32),
         overflow=jnp.zeros((), bool),
         seg_stats=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)), jnp.int32),
@@ -1664,12 +1758,14 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
 # calls. Per-phase row layout of the device-counted stream stats.
 # Round 10 appends `splits` (total across bag + walker, so the shared
 # RoundStats record can be emitted per phase) and `crounds` (the dd
-# stream's lockstep collective boundaries this phase; 0 single-chip) —
+# stream's lockstep collective boundaries this phase; 0 single-chip);
+# round 11 appends the four lane-waste attribution buckets
+# (WASTE_FIELDS — reconcile to lanes x wsteps per phase) — tail columns
 # appended LAST so positional readers keep their indexes.
 STREAM_STAT_FIELDS = ("tasks", "btasks", "wtasks", "wsplits", "roots",
                       "rounds", "segs", "wsteps", "srows", "maxd",
                       "live_tasks", "live_families", "splits",
-                      "crounds")
+                      "crounds") + WASTE_FIELDS
 
 
 def family_live_counts_cols(bag_meta: jnp.ndarray, count, m: int
@@ -1763,6 +1859,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         z64 = jnp.zeros((), jnp.int64)
         wt, ws, roots_taken, srows = z64, z64, z64, z64
         segs, wsteps = z64, z64
+        waste4 = jnp.zeros(4, jnp.int64)   # no kernel, no lane-cycles
         bag_tasks = bag3.tasks
         bag_splits = bag3.splits
         rounds = bag3.iters
@@ -1790,6 +1887,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         roots_taken, srows = o.roots_taken, o.srows
         segs = walk.segs.astype(jnp.int64)
         wsteps = walk.steps.astype(jnp.int64)
+        waste4 = walk.waste
         bag_tasks = bred.tasks + bag3.tasks
         bag_splits = bred.splits + bag3.splits
         rounds = bred.iters + bag3.iters
@@ -1805,7 +1903,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
     phase = jnp.asarray(phase, jnp.int32)
     fam_last2 = jnp.where(credit != 0.0, phase, fam_last)
 
-    stats = jnp.stack([
+    stats = jnp.concatenate([jnp.stack([
         bag_tasks + wt, bag_tasks, wt, ws, roots_taken,
         rounds, segs, wsteps, srows,
         maxd.astype(jnp.int64),
@@ -1815,7 +1913,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         # crounds: the single-chip cycle pays no collectives; the dd
         # stream fills this column host-side from its crounds delta
         jnp.zeros((), jnp.int64),
-    ])
+    ]), waste4])        # round-11 lane-waste tail columns
     next_bag = bag3._replace(
         acc=jnp.zeros_like(bag3.acc),
         tasks=jnp.zeros((), jnp.int64),
@@ -1907,9 +2005,30 @@ class WalkerResult:
     #                              collective_rounds / cycles is the
     #                              per-phase collective count the dd
     #                              refill mode is judged by
+    waste: Optional[np.ndarray] = None   # (4,) i64 lane-waste buckets
+    #                              (WASTE_FIELDS; device-counted; sums
+    #                              to kernel_steps * lanes — on dd runs
+    #                              the mesh aggregate of both sides)
+    waste_per_chip: Optional[np.ndarray] = None  # dd only: (n_dev, 4)
     # (The streaming engine's per-family done-mask / phase-counter
     # surface lives on runtime.stream.StreamResult, fed by this
     # module's run_stream_cycle / family_live_counts hooks.)
+
+    def attribution(self) -> Optional[dict]:
+        """Round-11 lane-waste attribution: where every kernel
+        lane-cycle went, device-counted (the decomposition
+        ``tools/analyze_occupancy.py --attribution`` prints and the
+        bench occupancy block carries). ``dominant_waste`` names the
+        biggest non-useful bucket — the one the next perf round should
+        attack. ``reconciles`` asserts the invariant
+        sum(buckets) == lanes x kernel steps."""
+        if self.waste is None:
+            return None
+        from ppls_tpu.obs.telemetry import build_attribution
+        return build_attribution(
+            dict(zip(WASTE_FIELDS, np.asarray(self.waste,
+                                              dtype=np.int64))),
+            int(self.kernel_steps) * int(self.lanes))
 
     @property
     def collective_rounds_per_cycle(self) -> float:
@@ -2167,7 +2286,7 @@ def integrate_family_walker(
                                          m, theta, bounds)
         tot = dict(tasks=0, splits=0, btasks=0, wtasks=0, wsplits=0,
                    roots=0, rounds=0, segs=0, wsteps=0, srows=0,
-                   max_depth=0, cycles=0)
+                   max_depth=0, cycles=0, waste=[0, 0, 0, 0])
         if _totals_override is not None:
             # the accumulator re-enters the DEVICE addition chain via
             # acc0, so legging/resuming reassociates nothing
@@ -2185,12 +2304,12 @@ def integrate_family_walker(
                               max_cycles=int(checkpoint_every), **kw)
             (l_tasks, l_splits, l_bt, l_wt, l_ws, l_roots,
              l_rounds, l_segs, l_wst, l_srows, l_maxd, l_cycles, l_ovf,
-             left, l_seg_stats, l_cyc_stats) = jax.device_get(
+             left, l_seg_stats, l_cyc_stats, l_waste) = jax.device_get(
                  (out.tasks, out.splits, out.btasks, out.wtasks,
                   out.wsplits, out.roots, out.rounds, out.segs,
                   out.wsteps, out.srows, out.maxd,
                   out.cycles, out.overflow, out.bag.count,
-                  out.seg_stats, out.cyc_stats))
+                  out.seg_stats, out.cyc_stats, out.waste))
             leg_seg_stats.append(
                 np.asarray(l_seg_stats)[:min(int(l_segs), S_CAP)])
             leg_cyc_stats.append(
@@ -2203,6 +2322,8 @@ def integrate_family_walker(
                          ("wsteps", l_wst), ("srows", l_srows),
                          ("cycles", l_cycles)):
                 tot[k] += int(v)
+            tot["waste"] = [a + int(b) for a, b
+                            in zip(tot["waste"], l_waste)]
             tot["max_depth"] = max(tot["max_depth"], int(l_maxd))
             overflow = bool(l_ovf)
             if overflow or int(left) == 0:
@@ -2323,6 +2444,7 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         metrics.per_round = round_stats_from_rows(
             cyc_stats, CYCLE_STAT_FIELDS, padded_width=int(lanes))
     denom = int(tot["wsteps"]) * lanes
+    waste = np.asarray(tot.get("waste", [0, 0, 0, 0]), dtype=np.int64)
     res = WalkerResult(
         areas=acc,
         metrics=metrics,
@@ -2334,15 +2456,19 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         lanes=int(lanes),
         kernel_steps=int(tot["wsteps"]),
         refill_slots=int(refill_slots),
+        waste=waste,
     )
     # run-completion telemetry boundary (host values already in hand —
     # no extra device fetch; the registry is the process default, so
     # benches/CLIs read one cumulative surface across runs)
     from ppls_tpu.obs.telemetry import default_telemetry
-    default_telemetry().publish_run(
+    tel = default_telemetry()
+    tel.publish_run(
         "walker", metrics, cycles=res.cycles,
         lane_efficiency=res.lane_efficiency,
-        walker_fraction=res.walker_fraction)
+        walker_fraction=res.walker_fraction,
+        waste=waste)
+    tel.publish_compile("walker", _run_cycles._cache_size())
     return res
 
 
@@ -2352,18 +2478,19 @@ def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
     out = d.out
     (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
      wsteps, srows, maxd, cycles, overflow, left, seg_stats_np,
-     cyc_stats_np) = jax.device_get(
+     cyc_stats_np, waste_np) = jax.device_get(
          (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
           out.wsplits, out.roots, out.rounds, out.segs, out.wsteps,
           out.srows, out.maxd, out.cycles, out.overflow, out.bag.count,
-          out.seg_stats, out.cyc_stats))
+          out.seg_stats, out.cyc_stats, out.waste))
     seg_stats_np = np.asarray(seg_stats_np)[:min(int(segs), S_CAP)]
     cyc_stats_np = np.asarray(cyc_stats_np)[:min(int(cycles), C_CAP)]
     return _assemble_result(
         np.asarray(acc),
         dict(tasks=tasks, splits=splits, btasks=btasks, wtasks=wtasks,
              wsplits=wsplits, roots=roots, rounds=rounds, segs=segs,
-             wsteps=wsteps, srows=srows, max_depth=maxd, cycles=cycles),
+             wsteps=wsteps, srows=srows, max_depth=maxd, cycles=cycles,
+             waste=[int(v) for v in np.asarray(waste_np)]),
         left=left, overflow=overflow,
         wall=time.perf_counter() - d.t0, lanes=d.lanes, rule=d.rule,
         refill_slots=d.refill_slots,
@@ -2441,6 +2568,9 @@ def resume_family_walker(
     # snapshots from before the device-counted sort accounting lack
     # "srows"; 0 keeps the evals estimate conservative for old legs.
     totals.setdefault("srows", 0)
+    # ... and pre-round-11 snapshots lack the lane-waste buckets: zeros
+    # keep the attribution honest-empty instead of failing the resume
+    totals.setdefault("waste", [0, 0, 0, 0])
     totals["acc"] = acc
     return integrate_family_walker(
         f_theta, f_ds, theta, bounds, eps, chunk=chunk, capacity=capacity,

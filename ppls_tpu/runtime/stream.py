@@ -71,6 +71,7 @@ import numpy as np
 from jax import lax
 
 from ppls_tpu.config import Rule
+from ppls_tpu.obs.flight import ChipFlightRecorder
 from ppls_tpu.obs.telemetry import Telemetry
 from ppls_tpu.parallel.bag_engine import DEPTH_BITS, BagState
 from ppls_tpu.parallel.walker import (
@@ -196,6 +197,7 @@ class StreamResult:
 
     def occupancy_summary(self, lanes: int) -> dict:
         """Steady-state occupancy from the device-counted phase rows."""
+        from ppls_tpu.parallel.walker import WASTE_FIELDS
         t = self.totals
         wsteps = int(t.get("wsteps", 0))
         out = {
@@ -204,6 +206,11 @@ class StreamResult:
             "walker_fraction": (int(t["wtasks"]) / int(t["tasks"])
                                 if t.get("tasks") else 0.0),
         }
+        buckets = {k: int(t.get(k, 0)) for k in WASTE_FIELDS}
+        if any(buckets.values()):
+            from ppls_tpu.obs.telemetry import build_attribution
+            out["attribution"] = build_attribution(buckets,
+                                                   wsteps * lanes)
         ps = self.phase_stats
         if ps is not None and len(ps):
             j = STREAM_STAT_FIELDS.index("live_families")
@@ -407,13 +414,6 @@ class StreamEngine:
                 raise ValueError(
                     "walker-dd streaming requires refill_slots > 0 "
                     "(admission rides the refill mode's phase reshard)")
-            if checkpoint_path:
-                # fail at construction, not mid-serve at the first
-                # snapshot boundary after real work has accumulated
-                raise NotImplementedError(
-                    "stream snapshots cover the single-chip engine; "
-                    "run the dd stream without a checkpoint path (its "
-                    "per-chip state snapshot is future work)")
             self._mesh = mesh if mesh is not None else make_mesh(
                 n_devices)
             self._dd = None          # built lazily with the fill point
@@ -523,14 +523,28 @@ class StreamEngine:
             jnp.zeros(n_dev, jnp.int32),
             jnp.zeros((n_dev, self.slots), jnp.float64))
         self._dd_counters = tuple(z64 for _ in range(11)) + (
+            jnp.zeros((n_dev, 4), jnp.int64),
             jnp.zeros(n_dev, jnp.int32),
             jnp.zeros(n_dev, jnp.int32),
             jnp.zeros(n_dev, dtype=bool))
         self._dd_prev = np.zeros(11, dtype=np.int64)
+        self._dd_prev_waste = np.zeros(4, dtype=np.int64)
         self._dd_prev_acc = np.zeros(self.slots)
         self._dd_fam_last = np.full(self.slots, -1, np.int32)
         self._dd_rr = 0
         self._dd_admit = None
+        # per-chip flight recorder (round 11): previous-phase per-chip
+        # cumulative counters so each phase's chip spans carry DELTAS,
+        # and per-chip live-row counts for the bank-occupancy deltas
+        self._dd_prev_chip = {
+            "wsteps": np.zeros(n_dev, np.int64),
+            "tasks": np.zeros(n_dev, np.int64),
+            "crounds": np.zeros(n_dev, np.int64),
+            "waste": np.zeros((n_dev, 4), np.int64),
+        }
+        self._dd_prev_count = np.zeros(n_dev, np.int64)
+        self._flight = ChipFlightRecorder(
+            self.telemetry, n_dev, engine=f"{self.engine}-stream")
         self._dev = True        # marks state as built
 
     def _build_store(self):
@@ -682,37 +696,68 @@ class StreamEngine:
         self._dd_admit = None
         out = self._dd_run(*self._dd_state, *self._dd_counters, *adm)
         state = out[:4] + (out[4], out[5])
-        counters = out[6:20]
-        fam_live_c = out[20]
-        (count_c, acc_c2, ctr_h, maxd_c, ovf_c, fam_live) = \
+        fam_live_c = out[21]
+        (count_c, acc_c2, ctr_h, waste_h, maxd_c, ovf_c, fam_live) = \
             jax.device_get((out[4], out[5], out[6:17], out[17],
-                            out[19], fam_live_c))
+                            out[18], out[20], fam_live_c))
         self._dd_state = state
         # cycles counter resets each phase call (max_cycles=1): pass
         # zeros back in, like the leg loop does between legs
-        self._dd_counters = counters[:11] + (
-            out[17], jnp.zeros(n_dev, jnp.int32), out[19])
-        totals = np.array([int(np.sum(np.asarray(c))) for c in ctr_h],
+        self._dd_counters = out[6:17] + (
+            out[17], out[18], jnp.zeros(n_dev, jnp.int32), out[20])
+        chip = {k: np.asarray(v, dtype=np.int64)
+                for k, v in zip(
+                    ("tasks", "splits", "btasks", "wtasks", "wsplits",
+                     "roots", "rounds", "segs", "wsteps", "srows",
+                     "crounds"), ctr_h)}
+        chip["waste"] = np.asarray(waste_h, dtype=np.int64)
+        totals = np.array([int(np.sum(chip[k])) for k in
+                           ("tasks", "splits", "btasks", "wtasks",
+                            "wsplits", "roots", "rounds", "segs",
+                            "wsteps", "srows", "crounds")],
                           dtype=np.int64)
         delta = totals - self._dd_prev
         self._dd_prev = totals
+        waste_tot = chip["waste"].sum(axis=0)
+        waste_delta = waste_tot - self._dd_prev_waste
+        self._dd_prev_waste = waste_tot
+        # per-chip flight-recorder deltas (round 11): same fetch, host
+        # subtraction — step() hands these to ChipFlightRecorder while
+        # the phase span is still open
+        count_pc = np.asarray(count_c, dtype=np.int64)
+        self._chip_phase_rec = {
+            "wsteps": chip["wsteps"] - self._dd_prev_chip["wsteps"],
+            "tasks": chip["tasks"] - self._dd_prev_chip["tasks"],
+            "waste": chip["waste"] - self._dd_prev_chip["waste"],
+            "live_rows": count_pc,
+            "bank_delta": count_pc - self._dd_prev_count,
+            # crounds is replicated (every chip counts the same
+            # lockstep boundaries): the scalar per-phase delta
+            "crounds": int(chip["crounds"].max(initial=0)
+                           - self._dd_prev_chip["crounds"]
+                           .max(initial=0)),
+        }
+        self._dd_prev_chip = {k: chip[k].copy() for k in
+                              ("wsteps", "tasks", "crounds", "waste")}
+        self._dd_prev_count = count_pc
         acc = np.sum(np.asarray(acc_c2), axis=0)      # fixed chip order
         credited = acc != self._dd_prev_acc
         self._dd_fam_last = np.where(credited, self.phase,
                                      self._dd_fam_last).astype(np.int32)
         self._dd_prev_acc = acc
         fam_live_tot = np.sum(np.asarray(fam_live), axis=0)
-        count = int(np.sum(np.asarray(count_c)))
+        count = int(np.sum(count_pc))
         # CTR64 order: tasks, splits, btasks, wtasks, wsplits, roots,
         # rounds, segs, wsteps, srows, crounds -> STREAM_STAT_FIELDS
         # (splits and crounds land in the round-10 tail columns; the dd
-        # stream is the one engine with a nonzero per-phase crounds)
-        stats = np.array([
+        # stream is the one engine with a nonzero per-phase crounds;
+        # round 11 appends the lane-waste bucket deltas)
+        stats = np.concatenate([np.array([
             delta[0], delta[2], delta[3], delta[4], delta[5],
             delta[6], delta[7], delta[8], delta[9],
             int(np.max(np.asarray(maxd_c))),
             count, int(np.sum(fam_live_tot > 0)),
-            delta[1], delta[10]], dtype=np.int64)
+            delta[1], delta[10]], dtype=np.int64), waste_delta])
         return (fam_live_tot, acc, np.zeros_like(acc),
                 self._dd_fam_last, count, bool(np.any(np.asarray(ovf_c))),
                 stats)
@@ -728,7 +773,7 @@ class StreamEngine:
         self._g_live_tasks.set(vals["live_tasks"])
         return vals
 
-    def _publish_gauges(self) -> None:
+    def _publish_gauges(self, step_wall_s: float = 0.0) -> None:
         self._g_queue.set(len(self._pending))
         self._g_resident.set(len(self._slot_req))
         self._g_free.set(len(self._free))
@@ -743,13 +788,19 @@ class StreamEngine:
               else getattr(self, "_dd_run", None))
         cache_size = getattr(fn, "_cache_size", None)
         if callable(cache_size):
-            self.telemetry.publish_compile_cache(
-                f"{self.engine}-stream", int(cache_size()))
+            # compile observability (round 11): cache growth during
+            # this step is a recompile — publish_compile emits the
+            # jit_cache_entry event / recompile counter and attributes
+            # this step's wall to the compile-wall counter
+            self.telemetry.publish_compile(
+                f"{self.engine}-stream", int(cache_size()),
+                wall_s=step_wall_s)
 
     def step(self) -> List[CompletedRequest]:
         """One phase: admit -> cycle -> retire. Returns the requests
         retired this phase (empty when idle)."""
         tel = self.telemetry
+        t_step0 = time.perf_counter()
         span = tel.span("phase", phase=self.phase)
         self._admit()
         if self._count == 0 and not self._slot_req:
@@ -763,6 +814,18 @@ class StreamEngine:
             return []
         (fam_live, acc, acc_c, fam_last, count, overflow,
          stats) = self._cycle_and_pull()
+        if self.engine == "walker-dd" and \
+                getattr(self, "_chip_phase_rec", None) is not None:
+            # per-chip flight recorder (round 11): chip child spans +
+            # collective-boundary event under the still-open phase
+            # span, from the deltas the pull above already computed
+            rec = self._chip_phase_rec
+            self._chip_phase_rec = None
+            self._flight.record_phase(
+                self.phase, wsteps=rec["wsteps"], tasks=rec["tasks"],
+                live_rows=rec["live_rows"],
+                bank_delta=rec["bank_delta"], waste=rec["waste"],
+                crounds=rec["crounds"])
         self._last_fam_live = fam_live
         self._last_fam_last = np.asarray(fam_last, dtype=np.int32)
         if overflow:
@@ -817,7 +880,7 @@ class StreamEngine:
         self._free.sort()
         self.completed.extend(retired)
         self.phase += 1
-        self._publish_gauges()
+        self._publish_gauges(step_wall_s=time.perf_counter() - t_step0)
         # the phase span closes carrying the phase's device-counter
         # delta row — the timeline IS the per-phase stats trail
         span.close(retired=len(retired), **vals)
@@ -915,20 +978,23 @@ class StreamEngine:
     # ------------------------------------------------------------------
 
     def snapshot(self):
-        """Atomically write queue + walker state to checkpoint_path."""
+        """Atomically write queue + walker state to checkpoint_path.
+        Covers BOTH engines since round 11: the dd branch snapshots
+        every chip's live bag prefix + per-chip counters + the host
+        delta trackers, so a resumed dd stream replays the identical
+        per-phase computation on the same mesh."""
         if not self.checkpoint_path:
             raise ValueError("no checkpoint_path configured")
-        if self.engine == "walker-dd":
-            raise NotImplementedError(
-                "stream snapshots cover the single-chip engine; run "
-                "the dd stream without --checkpoint (its per-chip "
-                "state snapshot is future work)")
         from ppls_tpu.runtime.checkpoint import save_family_checkpoint
         if self._dev is None:
             bag_cols = {}
             acc_pair = np.zeros((2, self.slots))
-            fam_last = np.full(self.slots, -1, np.int64)
+            fam_last = [-1] * self.slots
             count = 0
+            extra = {}
+        elif self.engine == "walker-dd":
+            bag_cols, count, acc_pair, fam_last, extra = \
+                self._snapshot_dd_state()
         else:
             count, overflow = jax.device_get(
                 (self._dev["bag"].count, self._dev["bag"].overflow))
@@ -945,13 +1011,14 @@ class StreamEngine:
                         "th": np.asarray(bth)[:count],
                         "meta": np.asarray(bmeta)[:count]}
             acc_pair = np.stack([np.asarray(acc), np.asarray(acc_c)])
+            fam_last = np.asarray(fam_last).tolist()
+            extra = {}
         totals = {
             "phase": self.phase,
             "next_rid": self._next_rid,
             "fill": self._fill,
             "fam_first": self._fam_first.tolist(),
-            "fam_last": np.asarray(fam_last).tolist()
-            if self._dev is not None else [-1] * self.slots,
+            "fam_last": fam_last,
             "phase_rows": [r.tolist() for r in self._phase_rows],
             "pending": [dataclasses.asdict(r) for r in self._pending],
             "resident": {
@@ -961,6 +1028,7 @@ class StreamEngine:
             "completed": [dataclasses.asdict(c)
                           for c in self.completed],
         }
+        totals.update(extra)
         save_family_checkpoint(
             self.checkpoint_path, identity=self._identity(),
             bag_cols=bag_cols, count=count, acc=acc_pair,
@@ -969,6 +1037,45 @@ class StreamEngine:
             "checkpoint", phase=self.phase, count=count,
             pending=len(self._pending), resident=len(self._slot_req),
             completed=len(self.completed))
+
+    def _snapshot_dd_state(self):
+        """Per-chip device state for a dd-stream snapshot: live bag
+        prefixes (2D, one row per chip, like the batch dd engine's leg
+        snapshot), the per-chip accumulator, the cumulative device
+        counters, and the host-side delta trackers the phase loop needs
+        to keep producing exact deltas after resume."""
+        n_dev, store = self._dd_n_dev, self._dd_store
+        bl, br, bth, bmeta, count_c, acc = self._dd_state
+        counts = np.asarray(jax.device_get(count_c), dtype=np.int32)
+        b = max(int(counts.max(initial=0)), 1)
+        cols = {}
+        for k, col in (("l", bl), ("r", br), ("th", bth),
+                       ("meta", bmeta)):
+            cols[k] = np.asarray(jax.device_get(
+                col.reshape(n_dev, store)[:, :b]))
+        cols["counts"] = counts
+        acc_h = np.asarray(jax.device_get(acc))     # (n_dev, slots)
+        ctr_h = jax.device_get(self._dd_counters)
+        extra = {"dd": {
+            # 11 cumulative CTR64 counters + waste/maxd/ovf (the
+            # zeroed cycles slot is rebuilt fresh on resume)
+            "ctr": [np.asarray(c).tolist() for c in ctr_h[:11]],
+            "waste": np.asarray(ctr_h[11]).tolist(),
+            "maxd": np.asarray(ctr_h[12]).tolist(),
+            "ovf": np.asarray(ctr_h[14]).tolist(),
+            "prev": self._dd_prev.tolist(),
+            "prev_waste": self._dd_prev_waste.tolist(),
+            "prev_acc": self._dd_prev_acc.tolist(),
+            "prev_chip": {k: v.tolist()
+                          for k, v in self._dd_prev_chip.items()},
+            "prev_count": self._dd_prev_count.tolist(),
+            "rr": self._dd_rr,
+            # straggler streak state: persisted so a resume cannot
+            # forget (or double-fire) an in-progress streak
+            "flight_streak": list(self._flight._streak),
+        }}
+        return (cols, int(counts.sum()), acc_h,
+                self._dd_fam_last.tolist(), extra)
 
     @classmethod
     def resume(cls, checkpoint_path: str, family: str, eps: float,
@@ -1011,9 +1118,13 @@ class StreamEngine:
         if totals["fill"] is not None:
             eng._fill = tuple(totals["fill"])
             eng._build_store()
-            eng._restore_device(bag_cols, count, acc_pair,
-                                np.asarray(totals["fam_last"],
-                                           dtype=np.int32))
+            if eng.engine == "walker-dd":
+                eng._restore_device_dd(bag_cols, totals,
+                                       np.asarray(acc_pair))
+            else:
+                eng._restore_device(bag_cols, count, acc_pair,
+                                    np.asarray(totals["fam_last"],
+                                               dtype=np.int32))
         eng._replay_registry()
         eng.telemetry.event(
             "resume", phase=eng.phase, count=eng._count,
@@ -1036,6 +1147,62 @@ class StreamEngine:
             self._h_lat_phases.observe(c.latency_phases)
             self._h_lat_seconds.observe(c.latency_s)
         self._publish_gauges()
+
+    def _restore_device_dd(self, bag_cols, totals, acc):
+        """Rebuild the per-chip stores around the saved live prefixes
+        (device-side overlay, same scheme as
+        ``sharded_walker.resume_family_walker_dd``) and restore the
+        cumulative counters + host delta trackers exactly, so the
+        continued stream's phase rows and flight-recorder deltas are
+        bit-identical to the undisturbed run's."""
+        from ppls_tpu.parallel.mesh import device_store
+        n_dev, store = self._dd_n_dev, self._dd_store
+        dd = totals["dd"]
+        fill_x, fill_th = self._fill
+        counts = np.asarray(bag_cols.get("counts",
+                                         np.zeros(n_dev, np.int32)),
+                            dtype=np.int32)
+        if bag_cols:
+            bl = device_store(n_dev, store, fill_x, bag_cols["l"])
+            br = device_store(n_dev, store, fill_x, bag_cols["r"])
+            bth = device_store(n_dev, store, fill_th, bag_cols["th"])
+            bm = device_store(n_dev, store, 0, bag_cols["meta"],
+                              jnp.int32)
+        else:
+            bl = jnp.full((n_dev, store), fill_x, jnp.float64)
+            br = jnp.full((n_dev, store), fill_x, jnp.float64)
+            bth = jnp.full((n_dev, store), fill_th, jnp.float64)
+            bm = jnp.zeros((n_dev, store), jnp.int32)
+        self._dd_state = (
+            jnp.asarray(bl).reshape(-1), jnp.asarray(br).reshape(-1),
+            jnp.asarray(bth).reshape(-1), jnp.asarray(bm).reshape(-1),
+            jnp.asarray(counts, dtype=jnp.int32),
+            jnp.asarray(np.asarray(acc, dtype=np.float64)
+                        .reshape(n_dev, self.slots)))
+        self._dd_counters = tuple(
+            jnp.asarray(np.asarray(v, dtype=np.int64))
+            for v in dd["ctr"]) + (
+            jnp.asarray(np.asarray(dd["waste"], dtype=np.int64)
+                        .reshape(n_dev, 4)),
+            jnp.asarray(np.asarray(dd["maxd"], dtype=np.int32)),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.asarray(np.asarray(dd["ovf"], dtype=bool)))
+        self._dd_prev = np.asarray(dd["prev"], dtype=np.int64)
+        self._dd_prev_waste = np.asarray(dd["prev_waste"],
+                                         dtype=np.int64)
+        self._dd_prev_acc = np.asarray(dd["prev_acc"],
+                                       dtype=np.float64)
+        self._dd_prev_chip = {
+            k: np.asarray(v, dtype=np.int64)
+            for k, v in dd["prev_chip"].items()}
+        self._dd_prev_count = np.asarray(dd["prev_count"],
+                                         dtype=np.int64)
+        self._dd_fam_last = np.asarray(totals["fam_last"],
+                                       dtype=np.int32)
+        self._dd_rr = int(dd["rr"])
+        if "flight_streak" in dd:
+            self._flight._streak = [int(v)
+                                    for v in dd["flight_streak"]]
 
     def _restore_device(self, bag_cols, count, acc_pair, fam_last):
         d = self._dev

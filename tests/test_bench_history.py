@@ -1,0 +1,140 @@
+"""Bench observatory (round 11): trajectory normalization and the
+quick-proxy regression gate.
+
+The acceptance fixture: a record with an injected 2x slowdown (doubled
+kernel_steps) MUST trip the gate; the committed reference passes
+against itself; a workload-identity drift refuses to compare instead
+of silently passing.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.bench_history import (  # noqa: E402
+    check_trajectory,
+    gate_record,
+    load_trajectory,
+)
+
+REF_PATH = os.path.join(REPO, "tools", "bench_quick_ref.json")
+
+
+def _ref():
+    with open(REF_PATH, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def test_committed_reference_exists_and_reconciles():
+    ref = _ref()
+    w = ref["walker"]
+    assert w["tasks"] > 0 and w["kernel_steps"] > 0
+    a = w["attribution"]
+    assert a["reconciles"] is True
+    assert sum(a["buckets"].values()) == a["lane_cycles"]
+
+
+def test_committed_artifacts_pass_check():
+    traj = load_trajectory()
+    assert check_trajectory(traj) == []
+    bench = [r for r in traj["rounds"] if r["kind"] == "bench"]
+    assert len(bench) >= 5
+    # the trajectory is normalized: every non-error round carries the
+    # primary metric with a finite positive value
+    for r in bench:
+        assert r["primary"] is not None
+        if "error" not in r["primary"]:
+            assert r["primary"]["value"] > 0
+
+
+def test_check_flags_malformed_rounds(tmp_path):
+    good = tmp_path / "BENCH_r90.json"
+    good.write_text(json.dumps({
+        "n": 90, "tail": json.dumps(
+            {"metric": "subintervals evaluated/sec/chip",
+             "value": 1.0, "unit": "x", "vs_baseline": 1.0})}))
+    empty = tmp_path / "BENCH_r91.json"
+    empty.write_text(json.dumps({"n": 91, "tail": "no records here"}))
+    traj = load_trajectory([str(good), str(empty)])
+    probs = check_trajectory(traj)
+    assert any("silent-drop" in p for p in probs)
+    # duplicate/regressing round index flagged too
+    dup = tmp_path / "BENCH_r90b.json"   # also parses round 90
+    dup.write_text(good.read_text())
+    traj2 = load_trajectory([str(good), str(dup)])
+    assert any("strictly increasing" in p
+               for p in check_trajectory(traj2))
+
+
+def test_gate_passes_reference_against_itself():
+    ref = _ref()
+    assert gate_record(copy.deepcopy(ref), ref) == []
+
+
+def test_gate_trips_on_injected_2x_slowdown():
+    """THE acceptance fixture: double the device-counted kernel steps
+    (a 2x slowdown at identical work) and the gate must fail."""
+    ref = _ref()
+    bad = copy.deepcopy(ref)
+    bad["walker"]["kernel_steps"] *= 2
+    fails = gate_record(bad, ref)
+    assert any("kernel_steps" in f for f in fails), fails
+
+
+def test_gate_trips_on_efficiency_drop_and_boundary_growth():
+    ref = _ref()
+    bad = copy.deepcopy(ref)
+    bad["walker"]["lane_efficiency"] = \
+        ref["walker"]["lane_efficiency"] * 0.5
+    assert any("lane_efficiency" in f for f in gate_record(bad, ref))
+    bad2 = copy.deepcopy(ref)
+    bad2["walker"]["boundaries_rounds_plus_segs"] = \
+        ref["walker"]["boundaries_rounds_plus_segs"] * 3
+    assert any("boundaries" in f for f in gate_record(bad2, ref))
+
+
+def test_gate_refuses_workload_drift():
+    ref = _ref()
+    drifted = copy.deepcopy(ref)
+    drifted["walker"]["tasks"] = int(ref["walker"]["tasks"] * 2)
+    fails = gate_record(drifted, ref)
+    assert len(fails) == 1 and "workload drifted" in fails[0]
+
+
+def test_gate_trips_on_broken_reconciliation():
+    ref = _ref()
+    bad = copy.deepcopy(ref)
+    bad["walker"]["attribution"]["reconciles"] = False
+    assert any("reconcile" in f for f in gate_record(bad, ref))
+
+
+@pytest.mark.parametrize("inject,expect_rc", [(False, 0), (True, 1)])
+def test_gate_cli_level(tmp_path, inject, expect_rc):
+    """CLI-level twin of the fixture test: the exact invocation ci.sh
+    runs, against a good and an injected-slowdown record file. (The
+    --gate path reads JSON only — no engine import, subprocess-cheap.)"""
+    rec = _ref()
+    if inject:
+        rec["walker"]["kernel_steps"] *= 2
+    p = tmp_path / "rec.json"
+    p.write_text(json.dumps(rec))
+    r = subprocess.run(
+        [sys.executable, "tools/bench_history.py", "--gate", str(p)],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode == expect_rc, r.stdout + r.stderr
+    assert ("TRIPPED" if inject else "passed") in r.stdout
+
+
+def test_check_cli_level():
+    r = subprocess.run(
+        [sys.executable, "tools/bench_history.py", "--check"],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "problem(s)" in r.stdout

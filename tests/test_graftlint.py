@@ -422,6 +422,50 @@ def test_gl06_module_alias_calls_flagged(tmp_path):
     assert [v.symbol for v in got] == ["entry:t.default_telemetry"]
 
 
+GL06_CHIP_SPANS = """
+    import functools
+    import jax
+    from pkg.obs.flight import ChipFlightRecorder
+
+    def emit_chips(tel, fr, rows):
+        # per-chip flight-recorder emit: sanctioned ONLY as a host
+        # boundary hook
+        fr.record_phase(0, wsteps=rows, tasks=rows, live_rows=rows,
+                        bank_delta=rows)
+        for chip, r in enumerate(rows):
+            tel.span("chip", chip=chip).close(wsteps=r)
+
+    @functools.partial(jax.jit, static_argnames=())
+    def cycle(x, tel, fr):
+        emit_chips(tel, fr, [x])        # traced path: must be flagged
+        return x
+
+    def boundary_hook(tel, fr, rows):
+        # the fixed shape: the same emits, unreachable from any root
+        emit_chips(tel, fr, rows)
+"""
+
+
+def test_gl06_flags_per_chip_span_emits_in_traced_path(tmp_path):
+    """Round-11 fixture: the flight recorder's per-chip span emit
+    sites (record_phase, .span('chip')) obey the boundary-hook-only
+    rule — inside a jit-reachable function they are violations."""
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": GL06_CHIP_SPANS})
+    got = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL06")
+    assert "emit_chips:record_phase" in got, got
+    assert "emit_chips:span" in got, got
+
+
+def test_gl06_per_chip_span_boundary_hook_clean(tmp_path):
+    # the fixed twin: drop the traced call — the boundary hook's
+    # identical emits stay silent (0 new baseline entries)
+    fixed = GL06_CHIP_SPANS.replace(
+        "emit_chips(tel, fr, [x])        # traced path: must be flagged",
+        "pass")
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL06"] == []
+
+
 def test_gl06_real_package_clean():
     # the package-level acceptance: all telemetry publishes live in
     # boundary hooks (zero new baseline entries for GL06)

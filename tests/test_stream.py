@@ -182,6 +182,89 @@ def test_stream_dd_requires_refill():
                             n_devices=8))
 
 
+def _dd_events_surface(path):
+    """Deterministic comparison surface of a dd timeline: retire
+    records (minus wall latency), phase delta rows, and the round-11
+    per-chip flight-recorder span attrs — all device-counted."""
+    import json as _json
+    retires, phases, chips = [], [], []
+    for ln in open(path):
+        r = _json.loads(ln)
+        if r["ev"] == "event" and r.get("name") == "retire":
+            a = dict(r["attrs"])
+            a.pop("latency_s", None)
+            retires.append(a)
+        elif r["ev"] == "span_close":
+            a = r.get("attrs") or {}
+            if "wsteps" in a and "live_rows" in a:
+                chips.append(a)                  # chip child span
+            elif a.get("tasks") is not None:
+                phases.append(a)                 # phase span
+    return sorted(retires, key=lambda a: a["rid"]), phases, chips
+
+
+def test_stream_dd_kill_and_resume_with_flight_recorder(tmp_path):
+    """Round-11 acceptance: the dd stream snapshots/resumes on the
+    virtual 8-mesh, and the per-chip flight-recorder events file
+    validates and is BIT-FOR-BIT identical (device-counted surface)
+    between the undisturbed run and the crashed-prefix + resumed-tail
+    union — chip spans, phase rows, and retire records alike."""
+    from ppls_tpu.obs import Telemetry
+    from ppls_tpu.utils.artifact_schema import validate_events_text
+
+    kw = dict(KW, chunk=1 << 8, engine="walker-dd", n_devices=8)
+    reqs = [(float(t), (1e-3, 1.0)) for t in THETA]
+    arr = [0, 0, 1, 2, 3, 4]
+
+    base_ev = str(tmp_path / "base.jsonl")
+    tel = Telemetry(events_path=base_ev)
+    base = StreamEngine("sin_recip_scaled", 1e-9, telemetry=tel,
+                        **kw).run(reqs, arrival_phase=arr)
+    tel.close()
+    assert validate_events_text(open(base_ev).read()) == []
+    base_r, base_p, base_c = _dd_events_surface(base_ev)
+    assert base_c, "no per-chip flight-recorder spans in the timeline"
+    assert len(base_c) % 8 == 0         # 8 chips per recorded phase
+
+    ck = str(tmp_path / "dd.ckpt")
+    crash_ev = str(tmp_path / "crash.jsonl")
+    tel2 = Telemetry(events_path=crash_ev)
+    eng = StreamEngine("sin_recip_scaled", 1e-9, telemetry=tel2,
+                       checkpoint_path=ck, checkpoint_every=1, **kw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(reqs, arrival_phase=arr, _crash_after_phases=3)
+    tel2.close()
+    assert validate_events_text(open(crash_ev).read(),
+                                require_balanced=False) == []
+
+    resume_ev = str(tmp_path / "resume.jsonl")
+    tel3 = Telemetry(events_path=resume_ev)
+    eng2 = StreamEngine.resume(ck, "sin_recip_scaled", 1e-9,
+                               telemetry=tel3, checkpoint_every=1,
+                               **kw)
+    assert eng2.phase == 3
+    k = eng2.next_rid
+    while not eng2.idle or k < len(reqs):
+        while k < len(reqs) and arr[k] <= eng2.phase:
+            eng2.submit(*reqs[k])
+            k += 1
+        eng2.step()
+    res2 = eng2.result()
+    tel3.close()
+
+    # areas, registry totals (lane-waste buckets included), and phase
+    # count replay bit-for-bit
+    assert np.array_equal(res2.areas, base.areas)
+    assert res2.totals == base.totals
+    assert res2.phases == base.phases
+    # the timeline union equals the undisturbed run's, chip spans too
+    crash_r, crash_p, crash_c = _dd_events_surface(crash_ev)
+    res_r, res_p, res_c = _dd_events_surface(resume_ev)
+    assert sorted(crash_r + res_r, key=lambda a: a["rid"]) == base_r
+    assert crash_p + res_p == base_p
+    assert crash_c + res_c == base_c
+
+
 def test_stream_beats_cold_calls_device_proxies():
     """The >= 3x acceptance for K small requests, in its CPU-
     assertable device-counted form: K cold per-request walker calls
@@ -272,6 +355,56 @@ def test_serve_cli_events_and_metrics_port(tmp_path, capsys):
                     if not r.get("summary")}
     areas_events = {a["rid"]: a["area"] for a in surface(e1)[0]}
     assert areas_stream == areas_events
+
+
+def test_serve_cli_metrics_port_zero_binds_free_port(tmp_path):
+    """Satellite: ``--metrics-port 0`` must bind an ephemeral port,
+    announce it on stderr BEFORE the run starts (the only usable
+    configuration on shared CI hosts), serve parseable exposition
+    while the run is live, and repeat the bound port on the summary
+    line. Run at true CLI level (subprocess) so the announcement
+    ordering is the real one."""
+    import json
+    import os
+    import re
+    import subprocess
+    import sys as _sys
+    import urllib.request
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "ppls_tpu", "serve",
+         "--slots", "8", "--chunk", "512", "--capacity", "65536",
+         "--lanes", "256", "--refill-slots", "2",
+         "--synthetic", "3", "--arrival-rate", "2", "--seed", "3",
+         "--eps", "1e-5", "-a", "1e-2", "-b", "1.0",
+         "--metrics-port", "0"],
+        cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        # the announcement is printed before the first phase (and
+        # before the engine compiles), so it arrives well before exit
+        line = proc.stderr.readline()
+        m = re.search(r"metrics on (http://127\.0\.0\.1:(\d+)/metrics)",
+                      line)
+        assert m, f"no metrics announcement, got {line!r}"
+        url, port = m.group(1), int(m.group(2))
+        assert port != 0
+        # scrape while the run is live (compile alone takes seconds)
+        text = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert text.endswith("\n")
+        out, err = proc.communicate(timeout=600)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, err
+    summary = [json.loads(ln) for ln in out.splitlines()
+               if ln.startswith("{")][-1]
+    assert summary.get("summary") is True
+    assert summary["metrics_port"] == port
+    assert summary["metrics_url"] == url
 
 
 def test_serve_cli_synthetic(capsys):

@@ -29,6 +29,15 @@ through the shared histogram (identical numbers to the serve summary
 by construction). This is the post-mortem path the CPU-only blocker
 makes essential: a TPU-attached serve round is diagnosable from its
 timeline alone.
+
+Round 11: ``python tools/analyze_occupancy.py --attribution`` runs the
+LANE-WASTE ATTRIBUTION decomposition — the four device-counted buckets
+(eval_active / masked_dead / refill_stall / drain_tail) that partition
+every kernel lane-cycle, in both refill modes, with the reconciliation
+invariant checked and the dominant waste bucket named (the number the
+ceiling-hunt work is judged against). Offline too: ``--from-events``
+prints the same decomposition from the waste tail columns the phase
+spans now carry.
 """
 
 import json
@@ -133,7 +142,36 @@ def main_from_events(path: str, lanes: int = 0) -> int:
         print(f"retire latency (phases): p50={h.quantile(0.5)} "
               f"p99={h.quantile(0.99)} (shared histogram quantile — "
               f"identical to the serve summary)")
+    # round-11 lane-waste attribution from the phase rows' tail columns
+    from ppls_tpu.obs.telemetry import WASTE_BUCKETS
+    if phase_rows and any(b in r for r in phase_rows
+                          for b in WASTE_BUCKETS):
+        buckets = {b: tot(b) for b in WASTE_BUCKETS}
+        print_attribution(buckets, tot("wsteps"), lanes)
     return 1 if problems else 0
+
+
+def print_attribution(buckets: dict, wsteps: int, lanes: int) -> None:
+    """Attribution printer over the SHARED record builder
+    (``obs.telemetry.build_attribution`` — the same dominant-bucket /
+    reconciliation definitions bench and serve report)."""
+    from ppls_tpu.obs.telemetry import build_attribution
+    total = sum(buckets.values())
+    a = build_attribution(buckets,
+                          int(wsteps) * int(lanes) if lanes else total)
+    print("=== lane-waste attribution ===")
+    for k, v in a["buckets"].items():
+        print(f"  {k:13s} {v:12d}  ({a['fractions'][k]:7.2%})")
+    print(f"  reconciliation: sum={total} vs lanes x steps="
+          f"{a['lane_cycles'] if lanes else 'unknown (pass --lanes)'} "
+          f"-> {'OK' if a['reconciles'] and lanes else ('FAIL' if lanes else '?')}")
+    dom = a["dominant_waste"]
+    if dom is not None:
+        print(f"  dominant waste bucket: {dom} "
+              f"({a['fractions'][dom]:.2%} of lane-cycles) — attack "
+              f"this one first")
+    else:
+        print("  dominant waste bucket: none (fully eval-active)")
 
 
 if "--from-events" in sys.argv:
@@ -228,6 +266,53 @@ def main_dd():
     else:
         print("no ceiling (off-TPU and no PPLS_CEILING_GSTEPS); "
               "skipping the split")
+
+
+def main_attribution():
+    """Round-11 tentpole decomposition (``--attribution``): run the
+    walker in BOTH refill modes and print where every kernel
+    lane-cycle went — the four device-counted waste buckets, the
+    reconciliation invariant, and the dominant bucket by name. Sized
+    for the flagship configuration on a TPU backend and for interpret
+    mode elsewhere (the buckets are device-counted either way)."""
+    from ppls_tpu.parallel.walker import WASTE_FIELDS
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        m, eps, bounds = M, EPS, BOUNDS
+        kw = dict(capacity=1 << 23)
+        modes = ((8, "in-kernel refill (flagship R=8)"),
+                 (0, "legacy XLA-boundary"))
+        lanes = DEFAULT_LANES
+    else:
+        m, eps, bounds = 64, 1e-7, (1e-2, 1.0)
+        kw = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
+                  seg_iters=32, min_active_frac=0.05)
+        modes = ((2, "in-kernel refill (quick R=2)"),
+                 (0, "legacy XLA-boundary"))
+        lanes = 256
+    theta = 1.0 + np.arange(m) / m
+    f_theta = get_family("sin_recip_scaled")
+    f_ds = get_family_ds("sin_recip_scaled")
+    for refill, label in modes:
+        sec(f"attribution: {label}")
+        r = integrate_family_walker(f_theta, f_ds, theta, bounds, eps,
+                                    refill_slots=refill, **kw)
+        a = r.attribution()
+        print_attribution(a["buckets"], r.kernel_steps, lanes)
+        print(f"  lane_efficiency={r.lane_efficiency:.4f} "
+              f"(tasks/lane-cycles; structural max ~2/3 trapezoid), "
+              f"cycles={r.cycles}")
+        assert a["reconciles"], "device-counted buckets failed to " \
+            "reconcile — the accounting plumbing is broken"
+        cs = r.cycle_stats
+        if cs is not None and len(cs):
+            iw = [CYCLE_STAT_FIELDS.index(k) for k in WASTE_FIELDS]
+            istep = CYCLE_STAT_FIELDS.index("walker_steps")
+            print("  per-cycle [steps, eval_active, masked_dead, "
+                  "refill_stall, drain_tail]:")
+            for row in cs.tolist():
+                print(f"    {[row[istep]] + [row[i] for i in iw]}")
 
 
 def main():
@@ -429,5 +514,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "dd":
         main_dd()
+    elif "--attribution" in sys.argv:
+        main_attribution()
     else:
         main()

@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Bench observatory (round 11): the committed round artifacts as ONE
+normalized trajectory, plus a device-counted regression gate.
+
+The BENCH_r*/MULTICHIP_r* wrappers each hold a round's bench stdout in
+a ``tail`` string; diffing rounds means eyeballing JSON lines buried in
+five different files, and NOTHING fails when a round silently regresses
+— the artifact schema check only proves records parse. This tool makes
+the trajectory a first-class object:
+
+* ``python tools/bench_history.py`` — print the normalized trajectory
+  (``ppls-bench-history-v1``): one record per round with the primary
+  metric, the device-counted proxy fields (lane_efficiency, occupancy,
+  the round-11 lane-waste attribution when present), and the
+  secondaries.
+* ``python tools/bench_history.py --check`` — trajectory
+  well-formedness over the committed artifacts: every BENCH round
+  parses, carries a primary record with a finite value, rounds are
+  strictly increasing, and error rounds (value 0 + error string) are
+  reported as GAPS rather than silently blending into the curve.
+* ``python tools/bench_history.py --gate RECORD.json`` — the
+  REGRESSION GATE: compare a quick-proxy record (the ``bench.py
+  quick`` walker block) against the committed reference
+  ``tools/bench_quick_ref.json``. Device-counted proxies are
+  bit-stable in interpret mode, so the gate can be tight on a CPU-only
+  container where wall clocks measure the interpreter:
+    - kernel_steps and boundary count must not grow past
+      ``1 + tolerance`` (default 0.5: a 2x slowdown record trips);
+    - lane_efficiency must not drop below ``1 - eff_tolerance``
+      (default 0.15) of the reference;
+    - the lane-waste attribution must reconcile;
+    - tasks must stay within 20% of the reference — further drift
+      means the workload itself changed and the reference must be
+      re-recorded, not silently compared.
+* ``python tools/bench_history.py --gate-run`` — run the quick walker
+  proxy leg fresh (the exact ``bench.py quick`` walker configuration)
+  and gate it; ``--update-ref`` records it as the new reference. This
+  pair is the ci.sh step: committed ref vs fresh run must pass.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_REF = os.path.join(REPO, "tools", "bench_quick_ref.json")
+
+# the bench.py quick walker leg's exact configuration (device-counted
+# proxies are deterministic per jax version/backend at this sizing)
+QUICK_WALKER_KW = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
+                       refill_slots=2, seg_iters=32,
+                       min_active_frac=0.05)
+QUICK_M = 8
+QUICK_EPS = 1e-7
+QUICK_BOUNDS = (1e-2, 1.0)
+
+# gate tolerances (the "stated tolerance" of the round-11 acceptance)
+GATE_STEP_TOL = 0.5      # kernel_steps / boundaries may grow <= 1.5x
+GATE_EFF_TOL = 0.15      # lane_efficiency may drop <= 15%
+GATE_TASK_TOL = 0.2      # beyond this the workload itself changed
+
+
+def _records_from_wrapper(text: str) -> List[dict]:
+    """Bench records inside a round wrapper (or a raw line stream)."""
+    try:
+        wrapper = json.loads(text)
+    except json.JSONDecodeError:
+        wrapper = None
+    if isinstance(wrapper, dict) and "tail" in wrapper:
+        lines = str(wrapper.get("tail", "")).splitlines()
+    else:
+        lines = text.splitlines()
+    out = []
+    for ln in lines:
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            out.append(obj)
+    return out
+
+
+def _round_index(path: str) -> Optional[int]:
+    base = os.path.basename(path)
+    digits = "".join(ch for ch in base if ch.isdigit())
+    return int(digits) if digits else None
+
+
+def load_trajectory(paths: Optional[List[str]] = None) -> dict:
+    """Normalize the committed round artifacts into one trajectory."""
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))) \
+            + sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
+    rounds = []
+    for p in paths:
+        base = os.path.basename(p)
+        kind = "bench" if base.startswith("BENCH") else "multichip"
+        entry = {"round": _round_index(p), "source": base,
+                 "kind": kind, "records": [], "primary": None}
+        try:
+            with open(p, encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as e:
+            entry["error"] = f"unreadable: {e}"
+            rounds.append(entry)
+            continue
+        recs = _records_from_wrapper(text)
+        entry["records"] = [
+            {k: r.get(k) for k in ("metric", "value", "unit",
+                                   "vs_baseline", "error")
+             if k in r} for r in recs]
+        if recs:
+            prim = recs[0]
+            entry["primary"] = {
+                "metric": prim.get("metric"),
+                "value": prim.get("value"),
+                "unit": prim.get("unit"),
+                "vs_baseline": prim.get("vs_baseline"),
+            }
+            if "error" in prim:
+                entry["primary"]["error"] = prim["error"]
+            for k in ("lane_efficiency", "walker_fraction",
+                      "occupancy", "attribution", "interpret_mode",
+                      "interpret_mode_quick", "interpret_mode_smoke"):
+                if k in prim:
+                    entry[k] = prim[k]
+            sec = prim.get("secondary")
+            if isinstance(sec, dict):
+                entry["secondary"] = {
+                    name: {k: sub.get(k)
+                           for k in ("metric", "value", "unit", "error",
+                                     "skipped") if k in sub}
+                    for name, sub in sec.items()
+                    if isinstance(sub, dict)}
+        rounds.append(entry)
+    return {"schema": "ppls-bench-history-v1", "rounds": rounds}
+
+
+def check_trajectory(traj: dict) -> List[str]:
+    """Well-formedness problems in the committed trajectory."""
+    problems: List[str] = []
+    bench = [r for r in traj["rounds"] if r["kind"] == "bench"]
+    if not bench:
+        problems.append("no BENCH_r* artifacts found")
+        return problems
+    last = None
+    for r in bench:
+        where = r["source"]
+        if r.get("error"):
+            problems.append(f"{where}: {r['error']}")
+            continue
+        if r["round"] is None:
+            problems.append(f"{where}: no round index in filename")
+        elif last is not None and r["round"] <= last:
+            problems.append(f"{where}: round {r['round']} not "
+                            f"strictly increasing (prev {last})")
+        last = r["round"] if r["round"] is not None else last
+        if not r["records"]:
+            problems.append(f"{where}: no bench records (silent-drop "
+                            f"round)")
+            continue
+        prim = r["primary"]
+        v = prim.get("value")
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not math.isfinite(v):
+            problems.append(f"{where}: primary value not finite: {v!r}")
+        elif v <= 0 and "error" not in prim:
+            problems.append(f"{where}: primary value {v} is "
+                            f"non-positive without an error record")
+    for r in traj["rounds"]:
+        if r["kind"] == "multichip" and r.get("error"):
+            problems.append(f"{r['source']}: {r['error']}")
+    return problems
+
+
+def gaps(traj: dict) -> List[str]:
+    """Error rounds — visible gaps in the curve, not failures."""
+    out = []
+    for r in traj["rounds"]:
+        if r["kind"] == "bench" and r.get("primary") \
+                and "error" in (r["primary"] or {}):
+            out.append(f"{r['source']}: error round "
+                       f"({r['primary'].get('error', '')[:60]})")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# quick-proxy regression gate
+# ---------------------------------------------------------------------------
+
+
+def run_quick_proxies() -> dict:
+    """The ``bench.py quick`` walker leg, standalone: a small
+    interpret-mode walker run whose DEVICE-COUNTED proxies (tasks,
+    kernel steps, boundaries, lane efficiency, lane-waste attribution)
+    are deterministic on a given jax version/backend."""
+    import numpy as np
+
+    from ppls_tpu.models.integrands import get_family, get_family_ds
+    from ppls_tpu.parallel.walker import integrate_family_walker
+
+    theta = 1.0 + np.arange(QUICK_M) / float(QUICK_M)
+    r = integrate_family_walker(
+        get_family("sin_recip_scaled"),
+        get_family_ds("sin_recip_scaled"),
+        theta, QUICK_BOUNDS, QUICK_EPS, **QUICK_WALKER_KW)
+    # this dict IS bench.py quick's walker block (bench_quick imports
+    # this function) — one definition, so the CI gate and the committed
+    # quick records can never measure different workloads
+    return {
+        "metric": "interpret-mode quick proxies",
+        "walker": {
+            "tasks": int(r.metrics.tasks),
+            "cycles": int(r.cycles),
+            "kernel_steps": int(r.kernel_steps),
+            "boundaries_rounds_plus_segs": int(r.metrics.rounds),
+            "lane_efficiency": round(r.lane_efficiency, 4),
+            "walker_fraction": round(r.walker_fraction, 4),
+            "occupancy": r.occupancy_summary(),
+            "attribution": r.attribution(),
+        },
+    }
+
+
+def gate_record(cur: dict, ref: dict,
+                tolerance: float = GATE_STEP_TOL,
+                eff_tolerance: float = GATE_EFF_TOL) -> List[str]:
+    """Compare a quick-proxy record against the reference; returns the
+    list of regression messages (empty = gate passes)."""
+    fails: List[str] = []
+    cw, rw = cur.get("walker") or {}, ref.get("walker") or {}
+    if not cw or not rw:
+        return ["record/reference missing the 'walker' proxy block"]
+
+    def _num(d, k):
+        v = d.get(k)
+        return float(v) if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+
+    ct, rt = _num(cw, "tasks"), _num(rw, "tasks")
+    if not ct or not rt:
+        return ["record/reference missing device-counted 'tasks'"]
+    if abs(ct / rt - 1.0) > GATE_TASK_TOL:
+        fails.append(
+            f"workload drifted: tasks {int(ct)} vs reference "
+            f"{int(rt)} (>{GATE_TASK_TOL:.0%}); re-record the "
+            f"reference (--update-ref) if the change is intended")
+        return fails
+    for key in ("kernel_steps", "boundaries_rounds_plus_segs"):
+        c, rv = _num(cw, key), _num(rw, key)
+        if c is None or rv is None:
+            fails.append(f"missing proxy {key!r}")
+        elif c > rv * (1.0 + tolerance):
+            fails.append(
+                f"REGRESSION {key}: {int(c)} vs reference {int(rv)} "
+                f"(> {1.0 + tolerance:.2f}x)")
+    ce, re_ = _num(cw, "lane_efficiency"), _num(rw, "lane_efficiency")
+    if ce is None or re_ is None:
+        fails.append("missing proxy 'lane_efficiency'")
+    elif ce < re_ * (1.0 - eff_tolerance):
+        fails.append(
+            f"REGRESSION lane_efficiency: {ce:.4f} vs reference "
+            f"{re_:.4f} (< {1.0 - eff_tolerance:.2f}x)")
+    attr = cw.get("attribution")
+    if isinstance(attr, dict) and attr.get("reconciles") is False:
+        fails.append("lane-waste attribution does not reconcile "
+                     "(buckets != lanes x kernel steps)")
+    return fails
+
+
+def main(argv: List[str]) -> int:
+    args = list(argv[1:])
+
+    def flag_value(name, default=None):
+        if name in args:
+            i = args.index(name)
+            if i + 1 >= len(args):
+                print(f"bench_history: {name} requires a value",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            v = args[i + 1]
+            del args[i:i + 2]
+            return v
+        return default
+
+    tolerance = float(flag_value("--tolerance", GATE_STEP_TOL))
+    eff_tol = float(flag_value("--eff-tolerance", GATE_EFF_TOL))
+    ref_path = flag_value("--ref", DEFAULT_REF)
+    gate_path = flag_value("--gate")
+    do_check = "--check" in args
+    if do_check:
+        args.remove("--check")
+    do_gate_run = "--gate-run" in args
+    if do_gate_run:
+        args.remove("--gate-run")
+    do_update = "--update-ref" in args
+    if do_update:
+        args.remove("--update-ref")
+    paths = [a for a in args if not a.startswith("-")]
+
+    if do_update:
+        rec = run_quick_proxies()
+        with open(ref_path, "w", encoding="utf-8") as fh:
+            json.dump(rec, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"bench_history: reference recorded -> {ref_path}")
+        print(json.dumps(rec["walker"]))
+        return 0
+
+    if gate_path or do_gate_run:
+        try:
+            with open(ref_path, encoding="utf-8") as fh:
+                ref = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_history: cannot read reference "
+                  f"{ref_path}: {e}", file=sys.stderr)
+            return 1
+        if gate_path:
+            with open(gate_path, encoding="utf-8") as fh:
+                cur = json.load(fh)
+        else:
+            cur = run_quick_proxies()
+        fails = gate_record(cur, ref, tolerance=tolerance,
+                            eff_tolerance=eff_tol)
+        for msg in fails:
+            print(f"bench_history: GATE {msg}", file=sys.stderr)
+        verdict = "TRIPPED" if fails else "passed"
+        print(f"bench_history: quick-proxy regression gate {verdict} "
+              f"({len(fails)} finding(s); tolerance {tolerance}, "
+              f"eff {eff_tol})")
+        return 1 if fails else 0
+
+    traj = load_trajectory(paths or None)
+    if do_check:
+        problems = check_trajectory(traj)
+        for msg in problems:
+            print(f"bench_history: {msg}", file=sys.stderr)
+        for g in gaps(traj):
+            print(f"bench_history: gap: {g}")
+        n = len([r for r in traj["rounds"] if r["kind"] == "bench"])
+        print(f"bench_history: {n} bench round(s), "
+              f"{len(problems)} problem(s), {len(gaps(traj))} gap(s)")
+        return 1 if problems else 0
+    print(json.dumps(traj, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -8,7 +8,10 @@
 #   5. serve telemetry smoke: a short seeded synthetic Poisson load
 #      through `ppls-tpu serve --events`, then the event-log schema
 #      check (the round-10 timeline artifact must stay valid end-to-end)
-#   6. C hygiene smoke: csrc compiles under -Wall -Wextra -Werror
+#   6. bench observatory: tools/bench_history.py --check over the
+#      committed round artifacts + the quick-proxy regression gate
+#      (device-counted proxies vs tools/bench_quick_ref.json)
+#   7. C hygiene smoke: csrc compiles under -Wall -Wextra -Werror
 #      (skipped with a visible notice when no compiler is present)
 #
 # Usage: bash tools/ci.sh            # from anywhere inside the repo
@@ -100,7 +103,22 @@ else
 fi
 rm -f "$EV_FILE"
 
-# --- 6. C hygiene: csrc must compile warning-free ---
+# --- 6. bench observatory: trajectory check + quick-proxy gate ---
+# tools/bench_history.py --check normalizes the committed
+# BENCH_r*/MULTICHIP_r* wrappers into one trajectory and fails on
+# malformed rounds; --gate-run re-measures the quick walker proxy leg
+# (device-counted, deterministic in interpret mode) and fails when it
+# regresses past the stated tolerance vs tools/bench_quick_ref.json.
+step "bench history check + quick-proxy regression gate"
+if JAX_PLATFORMS=cpu python tools/bench_history.py --check \
+        && JAX_PLATFORMS=cpu python tools/bench_history.py --gate-run; then
+    echo "ci: bench history + gate OK"
+else
+    echo "ci: bench history / regression gate FAILED"
+    FAILURES=$((FAILURES + 1))
+fi
+
+# --- 7. C hygiene: csrc must compile warning-free ---
 # The stub-linked MPI binary is part of the tier-1 surface
 # (test_backend.py runs the real farmer/worker protocol through it),
 # so warnings in csrc are latent test-lane breakage.

@@ -795,6 +795,7 @@ def rule_gl05(modules: List[LintModule]) -> Iterator[Violation]:
 # already covers.
 _GL06_API = {"inc", "set_max", "observe", "event", "span",
              "publish_run", "publish_phase", "publish_compile_cache",
+             "publish_compile", "publish_chip_balance", "record_phase",
              "stream_counter", "stream_gauge", "emit_event"}
 
 
