@@ -108,6 +108,14 @@ REFILL_SLOTS = 8   # flagship runs with IN-KERNEL refill: R private
                    # back to the legacy XLA-boundary engine and records
                    # the fallback in the JSON (never a zero round for a
                    # config regression).
+SCOUT_DTYPE = "f32"   # round 12: the flagship runs the TWO-PASS
+                      # precision-scouting kernel (f32 scout test +
+                      # in-step ds confirm; walker.make_walk_kernel)
+                      # with DOUBLE-BUFFERED rolling half-bank deals.
+                      # Both are flag-gated; a kernel failure degrades
+                      # to plain refill first, then to legacy, with
+                      # each fallback recorded in the JSON.
+DOUBLE_BUFFER = True
 REPEATS = 16       # pipelined runs; the pipeline's fixed ~0.25 s of
                    # tunnel overhead (final RTT + collect chain) is
                    # ~19% of a 10-run pipeline at ~0.13 s/run — 16
@@ -292,7 +300,8 @@ def main():
     # Round 6 adds in-kernel refill (refill_slots=REFILL_SLOTS): the
     # whole phase runs out of a per-lane VMEM root bank with zero
     # boundary sorts.
-    kw = dict(capacity=1 << 23, refill_slots=REFILL_SLOTS)
+    kw = dict(capacity=1 << 23, refill_slots=REFILL_SLOTS,
+              scout_dtype=SCOUT_DTYPE, double_buffer=DOUBLE_BUFFER)
     refill_fallback = None
 
     log("[bench] TPU warmup/compile ...")
@@ -313,18 +322,51 @@ def main():
                 # — falling back would silently publish the legacy
                 # engine's number for an infra failure. Fail the round.
                 raise
-            # A refill-kernel failure (e.g. Mosaic can't lower a
-            # construct on this toolchain) must degrade to the legacy
-            # boundary engine, not zero the round: record the fallback
-            # so the artifact shows WHICH engine produced the number.
-            refill_fallback = msg[:300]
-            log(f"[bench] in-kernel refill failed ({refill_fallback}); "
-                f"falling back to the XLA-boundary engine")
-            kw["refill_slots"] = 0
-            res = with_retry(
-                lambda: integrate_family_walker(f_theta, f_ds, theta,
-                                                BOUNDS, EPS, **kw),
-                attempts_log, what="warmup (fallback)")
+            # Kernel failures degrade one mode at a time, each recorded
+            # so the artifact shows WHICH engine produced the number:
+            # scout/double-buffer off first (round 12), then the legacy
+            # XLA-boundary engine.
+            if kw.get("scout_dtype") == "f32" or kw.get("double_buffer"):
+                refill_fallback = f"scout/double-buffer off: {msg[:250]}"
+                log(f"[bench] scout/double-buffer kernel failed "
+                    f"({msg[:200]}); retrying with plain refill")
+                kw["scout_dtype"] = "f64"
+                kw["double_buffer"] = False
+                try:
+                    res = with_retry(
+                        lambda: integrate_family_walker(
+                            f_theta, f_ds, theta, BOUNDS, EPS, **kw),
+                        attempts_log, what="warmup (plain refill)")
+                except FloatingPointError:
+                    raise
+                except Exception as e2:  # noqa: BLE001 — last fallback
+                    msg = f"{type(e2).__name__}: {e2}"
+                    if is_transient(msg):
+                        raise
+                    # append: the artifact must show the WHOLE fallback
+                    # chain (the scout failure is the round-12 signal)
+                    refill_fallback = (f"{refill_fallback} ; then "
+                                       f"plain refill failed: "
+                                       f"{msg[:250]}")
+                    log(f"[bench] in-kernel refill failed "
+                        f"({msg[:250]}); falling back to the "
+                        f"XLA-boundary engine")
+                    kw["refill_slots"] = 0
+                    res = with_retry(
+                        lambda: integrate_family_walker(
+                            f_theta, f_ds, theta, BOUNDS, EPS, **kw),
+                        attempts_log, what="warmup (fallback)")
+            else:
+                refill_fallback = msg[:300]
+                log(f"[bench] in-kernel refill failed "
+                    f"({refill_fallback}); falling back to the "
+                    f"XLA-boundary engine")
+                kw["refill_slots"] = 0
+                res = with_retry(
+                    lambda: integrate_family_walker(f_theta, f_ds,
+                                                    theta, BOUNDS, EPS,
+                                                    **kw),
+                    attempts_log, what="warmup (fallback)")
     except Exception as e:      # noqa: BLE001 — one JSON line always
         # The engine raises on non-finite areas / overflow; keep the
         # one-JSON-line contract so the driver records the failure
@@ -461,13 +503,21 @@ def main():
         "abs_error": abs_err,
         "eps": EPS,
         "integrand_evals_per_sec": round(total_evals / total_wall, 1),
-        # walker eval counts are DERIVED from task/split/root counters
-        # (exact per the kernel's caching discipline except suspended
-        # roots: overstated by <= 1 eval per suspended lane, ~1e-4 rel);
-        # the C side's are exact. Labeled so nobody mixes the bases.
-        "integrand_evals_estimated": True,
+        # round 12: walker eval counts are DEVICE-COUNTED (the
+        # scout/confirm SMEM counters, or the eval_active waste bucket)
+        # — the flag only flips back to True on resumed pre-counter
+        # snapshots, where the host-side model fills in
+        # (walker._assemble_result).
+        "integrand_evals_estimated": bool(r.evals_estimated),
         "evals_per_task_tpu": round(
             r.metrics.integrand_evals / r.metrics.tasks, 3),
+        # the device-counted eval split behind that number: f32 scout
+        # evals vs full-ds evals (confirm pass, or every live lane-step
+        # with scouting off)
+        "scout_evals": int(r.scout_evals),
+        "confirm_evals": int(r.confirm_evals),
+        "scout_dtype": kw.get("scout_dtype") or "f64",
+        "double_buffer": bool(kw.get("double_buffer", False)),
         "engine": "walker",
         "refill_slots": kw.get("refill_slots", 0),
         "walker_fraction": round(r.walker_fraction, 4),
@@ -519,9 +569,13 @@ def main():
     # one interface). A failure here must not zero the primary.
     def bench_simpson():
         from ppls_tpu.config import Rule
+        # the Simpson walker has no scout step (walker.resolve_scout_
+        # dtype): run it with scouting off, double-buffer kept
+        skw = {k2: v2 for k2, v2 in kw.items() if k2 != "scout_dtype"}
+        skw["scout_dtype"] = "f64"
         t1 = time.perf_counter()
         rs = integrate_family_walker(f_theta, f_ds, theta, BOUNDS, EPS,
-                                     rule=Rule.SIMPSON, **kw)
+                                     rule=Rule.SIMPSON, **skw)
         wall_s = time.perf_counter() - t1
         err_s = (float(np.max(np.abs(rs.areas - np.asarray(exact))))
                  if abs_err is not None else None)
@@ -905,8 +959,11 @@ def bench_dd(m: int = 64, eps: float = 1e-10) -> dict:
     else:
         lanes = 1 << 12
     theta = 1.0 + np.arange(m) / m
+    # round 12: the dd flagship leg runs scout + double-buffer too
+    # (the modes thread through the shared kernel surface)
     dkw = dict(chunk=1 << 12, capacity=1 << 20, lanes=lanes,
-               roots_per_lane=12, mesh=mesh)
+               roots_per_lane=12, mesh=mesh,
+               scout_dtype=SCOUT_DTYPE, double_buffer=DOUBLE_BUFFER)
 
     log(f"[bench-dd] warmup/compile (refill, {n_dev} chip(s)) ...")
     integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS, eps,
@@ -916,8 +973,10 @@ def bench_dd(m: int = 64, eps: float = 1e-10) -> dict:
                                     eps, refill_slots=8, **dkw)
     wall = time.perf_counter() - t0
     log("[bench-dd] legacy comparison run ...")
+    # legacy = no refill, so no bank to double-buffer and no scout
+    lkw = dict(dkw, scout_dtype="f64", double_buffer=False)
     lg = integrate_family_walker_dd("sin_recip_scaled", theta, BOUNDS,
-                                    eps, **dkw)
+                                    eps, **lkw)
     value = rf.metrics.tasks / wall / n_dev
 
     # per-chip headroom at the dd operating point (lanes=2^12): the
@@ -1031,14 +1090,18 @@ def bench_stream(k: int = 24, quick=None) -> dict:
         k = min(k, 12)
         eps, bounds = 1e-7, (1e-2, 1.0)
         small = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
-                     refill_slots=2, seg_iters=32, min_active_frac=0.05)
+                     refill_slots=2, seg_iters=32, min_active_frac=0.05,
+                     scout_dtype=SCOUT_DTYPE,
+                     double_buffer=DOUBLE_BUFFER)
         ekw = dict(slots=16, chunk=1 << 10, **small)
         wkw = dict(small)
     else:
         eps, bounds = EPS, BOUNDS
         ekw = dict(slots=64, chunk=1 << 13, capacity=1 << 22,
-                   refill_slots=REFILL_SLOTS)
-        wkw = dict(capacity=1 << 23, refill_slots=REFILL_SLOTS)
+                   refill_slots=REFILL_SLOTS, scout_dtype=SCOUT_DTYPE,
+                   double_buffer=DOUBLE_BUFFER)
+        wkw = dict(capacity=1 << 23, refill_slots=REFILL_SLOTS,
+                   scout_dtype=SCOUT_DTYPE, double_buffer=DOUBLE_BUFFER)
     family = "sin_recip_scaled"
     theta = 1.0 + np.arange(k) / k
     reqs = [(float(t), bounds) for t in theta]

@@ -96,6 +96,27 @@ def build_parser() -> argparse.ArgumentParser:
                           "collective breed chain to one phase-granular "
                           "rebalance (the flagship bench config uses "
                           "8); 0 = legacy XLA-boundary refill")
+    fam.add_argument("--scout-dtype", choices=["f64", "f32"],
+                     default=None, dest="scout_dtype",
+                     help="walker engines, trapezoid rule: 'f32' "
+                          "enables round-12 mixed-precision scouting "
+                          "(f32 scout test with a conservative guard "
+                          "band; accepts re-confirmed in full ds); "
+                          "'f64' forces it off; default defers to the "
+                          "PPLS_SCOUT=1 environment lane")
+    fam.add_argument("--double-buffer", action="store_true",
+                     dest="double_buffer",
+                     help="walker engines with --refill-slots (even, "
+                          ">= 2): rolling half-bank deals — one walk "
+                          "phase consumes the whole work-sorted queue "
+                          "instead of at most R*lanes roots")
+    fam.add_argument("--reduced-integrands", action="store_true",
+                     dest="reduced_integrands",
+                     help="prefer the range-reduced ds twin of the "
+                          "family in the kernel (cosh^4 even-symmetry "
+                          "exp form, one-polynomial pi-reduced sin); "
+                          "families without one keep the reference "
+                          "twin")
     fam.add_argument("--n-devices", type=int, default=None)
     fam.add_argument("--checkpoint", default=None,
                      help="snapshot path (bag, walker, sharded-bag, and "
@@ -162,6 +183,18 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--lanes", type=int, default=None,
                      help="walker lanes (default: engine default)")
     srv.add_argument("--refill-slots", type=int, default=8)
+    srv.add_argument("--scout-dtype", choices=["f64", "f32"],
+                     default=None, dest="scout_dtype",
+                     help="per-engine compile static: 'f32' = round-12 "
+                          "mixed-precision scouting (see the family "
+                          "subcommand's flag)")
+    srv.add_argument("--double-buffer", action="store_true",
+                     dest="double_buffer",
+                     help="rolling half-bank refill deals (even "
+                          "--refill-slots >= 2)")
+    srv.add_argument("--reduced-integrands", action="store_true",
+                     dest="reduced_integrands",
+                     help="prefer the family's range-reduced ds twin")
     srv.add_argument("--n-devices", type=int, default=None)
     srv.add_argument("--requests", default=None, metavar="FILE",
                      help="JSONL request stream: one "
@@ -266,10 +299,13 @@ def _main_family(args) -> int:
         from ppls_tpu.config import Rule
         from ppls_tpu.parallel.walker import (integrate_family_walker,
                                               resume_family_walker)
-        fds = get_family_ds(args.family)
+        fds = get_family_ds(args.family,
+                            reduced=args.reduced_integrands)
         wkw = dict(chunk=args.chunk, capacity=args.capacity,
                    rule=Rule(args.rule),
-                   refill_slots=args.refill_slots)
+                   refill_slots=args.refill_slots,
+                   scout_dtype=args.scout_dtype,
+                   double_buffer=args.double_buffer)
 
         def engine_call():
             if args.checkpoint and os.path.exists(args.checkpoint):
@@ -287,7 +323,10 @@ def _main_family(args) -> int:
             integrate_family_walker_dd, resume_family_walker_dd)
         dkw = dict(chunk=args.chunk, capacity=args.capacity,
                    n_devices=args.n_devices, rule=Rule(args.rule),
-                   refill_slots=args.refill_slots)
+                   refill_slots=args.refill_slots,
+                   scout_dtype=args.scout_dtype,
+                   double_buffer=args.double_buffer,
+                   reduced_integrands=args.reduced_integrands)
 
         def engine_call():
             if args.checkpoint and os.path.exists(args.checkpoint):
@@ -414,6 +453,9 @@ def _main_serve(args) -> int:
 
     kw = dict(rule=Rule(args.rule), slots=args.slots, chunk=args.chunk,
               capacity=args.capacity, refill_slots=args.refill_slots,
+              scout_dtype=args.scout_dtype,
+              double_buffer=args.double_buffer,
+              reduced_integrands=args.reduced_integrands,
               engine=args.engine, n_devices=args.n_devices,
               checkpoint_every=args.checkpoint_every)
     if args.lanes:

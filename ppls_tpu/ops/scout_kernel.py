@@ -1,0 +1,211 @@
+"""THE DECLARED SCOUT-DTYPE SURFACE: single-precision (plain f32)
+arithmetic behind the ds-module API, for the walker's two-pass
+precision-scouting mode ONLY.
+
+Round 12 (mixed-precision scouting): the walker's split/accept error
+test does not need ds precision — it needs a DECISION, and any decision
+whose f32 error could flip it falls inside the guard band and is
+re-taken in full ds anyway (``walker.make_walk_kernel``, scout mode).
+This module lets the registered ds integrand twins
+(``models.integrands.DS_FAMILIES``, all of which take a ``dsm=`` module
+parameter) evaluate in plain f32: the (hi, lo) pair API is preserved so
+one twin serves both passes, but every ``lo`` limb is identically zero
+and every transform is a single rounding — roughly half the VPU ops of
+a fence-free ds transform and none of the Dekker splits.
+
+Accuracy contract: results carry ~2^-24 relative error plus the
+reduction error documented per function below. The walker's guard band
+(``walker.SCOUT_GUARD_ULPS``) is sized against these bounds; see
+BASELINE.md "Mixed-precision scouting methodology (round 12)".
+
+GL02 NOTE: f32 here is the entire point of the module. graftlint's
+f64-discipline rule carves this surface out via the DECLARED allowlist
+in ``tools/graftlint/rules.py`` (``GL02_SCOUT_SURFACE`` — module +
+symbol list, per-entry reason); f32 outside that declaration still
+fails the lint. Do NOT import this module anywhere except the walker's
+scout pass and its tests.
+
+Like ``ops/ds_kernel.py`` this module is written for Pallas kernel
+interiors (Mosaic-lowerable ops only: no int64 promotion, no library
+transcendentals — sin/exp are built from the same Cody-Waite skeleton
+as the ds twins, minus the low-limb bookkeeping).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ppls_tpu.ops.pow2 import pow2_f32
+from ppls_tpu.ops.ds_kernel import (
+    _LN2_1, _LN2_2, _LOG2E, _PIO2_1, _PIO2_2, _TWO_OVER_PI, two_prod,
+)
+
+DS = Tuple[jnp.ndarray, jnp.ndarray]
+
+_F32 = jnp.float32
+
+
+def _z(x):
+    return jnp.zeros_like(x)
+
+
+def ds(hi, lo=None) -> DS:
+    if lo is None:
+        lo = jnp.zeros_like(hi)
+    return hi, lo
+
+
+def ds_neg(x: DS) -> DS:
+    return -x[0], _z(x[0])
+
+
+def ds_add(x: DS, y: DS) -> DS:
+    s = x[0] + y[0]
+    return s, _z(s)
+
+
+def ds_sub(x: DS, y: DS) -> DS:
+    s = x[0] - y[0]
+    return s, _z(s)
+
+
+def ds_add_f32(x: DS, b) -> DS:
+    s = x[0] + b
+    return s, _z(s)
+
+
+def ds_mul(x: DS, y: DS) -> DS:
+    p = x[0] * y[0]
+    return p, _z(p)
+
+
+def ds_mul_f32(x: DS, b) -> DS:
+    p = x[0] * b
+    return p, _z(p)
+
+
+def ds_mul_pow2(x: DS, k: float) -> DS:
+    return x[0] * _F32(k), _z(x[0])
+
+
+def ds_div(x: DS, y: DS) -> DS:
+    q = x[0] / y[0]
+    return q, _z(q)
+
+
+def ds_abs(x: DS) -> DS:
+    return jnp.abs(x[0]), _z(x[0])
+
+
+def ds_where(c, x: DS, y: DS) -> DS:
+    return jnp.where(c, x[0], y[0]), jnp.where(c, x[1], y[1])
+
+
+def ds_f64ish(x: DS):
+    return x[0] + x[1]
+
+
+# --- f32 sin: two-limb Cody-Waite + 5-term Taylor ------------------------
+#
+# The hi-limb product k * PIO2_1 still goes through ONE Dekker two_prod:
+# without the captured rounding error the reduced argument would carry
+# ~6e-8 * |x| absolute error — at |x| ~ 2^22 that is worse than useless.
+# With it, the reduction error is ~|k| * ulp(PIO2_2) ~ 4e-16 * |x|,
+# i.e. <= ~2e-9 absolute over the ds_sin validity range (|x| <= 2^22),
+# far below the f32 polynomial's own 2^-24-level rounding.
+
+_S3 = np.float32(-1.0 / 6.0)
+_S5 = np.float32(1.0 / 120.0)
+_S7 = np.float32(-1.0 / 5040.0)
+_S9 = np.float32(1.0 / 362880.0)
+_S11 = np.float32(-1.0 / 39916800.0)
+
+_C2 = np.float32(-0.5)
+_C4 = np.float32(1.0 / 24.0)
+_C6 = np.float32(-1.0 / 720.0)
+_C8 = np.float32(1.0 / 40320.0)
+_C10 = np.float32(-1.0 / 3628800.0)
+
+
+def ds_sin(x: DS) -> DS:
+    """sin(x) in f32, |x| <= ~2^22 (same validity as the ds twin)."""
+    xv = x[0]
+    k = jnp.round(xv * _TWO_OVER_PI)
+    t1, e1 = two_prod(k, _PIO2_1)
+    y = (xv - t1) - (e1 + k * _PIO2_2)
+
+    y2 = y * y
+    sp = _S9 + y2 * _S11
+    sp = _S7 + y2 * sp
+    sp = _S5 + y2 * sp
+    sp = _S3 + y2 * sp
+    sin_y = y + y * y2 * sp
+    cp = _C8 + y2 * _C10
+    cp = _C6 + y2 * cp
+    cp = _C4 + y2 * cp
+    cp = _C2 + y2 * cp
+    cos_y = 1.0 + y2 * cp
+
+    q = k.astype(jnp.int32) & 3
+    use_cos = (q & 1) == 1
+    negate = q >= 2
+    res = jnp.where(use_cos, cos_y, sin_y)
+    res = jnp.where(negate, -res, res)
+    return res, _z(res)
+
+
+# --- f32 reduced sin: pi-reduction, one polynomial (round 12) ------------
+
+_PI_1 = np.float32(3.141592653589793)
+_PI_2 = np.float32(3.141592653589793 - float(_PI_1))
+_INV_PI = np.float32(0.3183098861837907)
+_S13 = np.float32(1.0 / 6227020800.0)
+
+
+def ds_sin_pi(x: DS) -> DS:
+    """sin(x) in f32 via pi-reduction + one polynomial (|x| <= ~2^22):
+    the scout twin of ``ds_kernel.ds_sin_pi``."""
+    xv = x[0]
+    k = jnp.round(xv * _INV_PI)
+    t1, e1 = two_prod(k, _PI_1)
+    y = (xv - t1) - (e1 + k * _PI_2)
+    y2 = y * y
+    p = _S11 + y2 * _S13
+    p = _S9 + y2 * p
+    p = _S7 + y2 * p
+    p = _S5 + y2 * p
+    p = _S3 + y2 * p
+    res = y + y * y2 * p
+    negate = (k.astype(jnp.int32) & 1) == 1
+    res = jnp.where(negate, -res, res)
+    return res, _z(res)
+
+
+# --- f32 exp: two-limb Cody-Waite ln2 reduction + 6-term Taylor ----------
+
+_E2 = np.float32(0.5)
+_E3 = np.float32(1.0 / 6.0)
+_E4 = np.float32(1.0 / 24.0)
+_E5 = np.float32(1.0 / 120.0)
+_E6 = np.float32(1.0 / 720.0)
+_E7 = np.float32(1.0 / 5040.0)
+
+
+def ds_exp(x: DS) -> DS:
+    """exp(x) in f32; deep underflow flushes to 0 (|x| <= ~88)."""
+    xv = x[0]
+    k = jnp.round(xv * _LOG2E)
+    t1, e1 = two_prod(k, _LN2_1)
+    r = (xv - t1) - (e1 + k * _LN2_2)
+    p = _E6 + r * _E7
+    p = _E5 + r * p
+    p = _E4 + r * p
+    p = _E3 + r * p
+    p = _E2 + r * p
+    e = 1.0 + r * (1.0 + r * p)
+    s = pow2_f32(k)
+    res = e * s
+    return res, _z(res)

@@ -86,8 +86,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ppls_tpu.config import Rule
-from ppls_tpu.models.integrands import (DS_FAMILIES, FAMILIES,
-                                        check_ds_domain)
+from ppls_tpu.models.integrands import FAMILIES, check_ds_domain
 from ppls_tpu.parallel.bag_engine import (
     DEPTH_BITS,
     DEPTH_MASK,
@@ -139,6 +138,8 @@ class _DDCarry(NamedTuple):
     waste: jnp.ndarray      # (4,) i64 per-chip lane-waste buckets
     #                         (walker.WASTE_FIELDS; reconcile to
     #                         lanes x wsteps per chip)
+    evals: jnp.ndarray      # (2,) i64 per-chip scout/confirm kernel
+    #                         evals (walker.EVAL_FIELDS)
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32 (replicated by construction)
     overflow: jnp.ndarray   # bool (replicated via psum)
@@ -171,7 +172,10 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                         sort_skip_ratio: float = 8.0,
                         refill_slots: int = 0,
                         reshard_window: int = 0,
-                        admit_window: int = 0):
+                        admit_window: int = 0,
+                        scout: bool = False,
+                        double_buffer: bool = False,
+                        reduced: bool = False):
     """Jitted demand-driven walker leg, memoized per configuration.
 
     Runs up to ``max_cycles`` cycles (a checkpoint leg passes a smaller
@@ -208,7 +212,8 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                              "(admission rides the refill mode's "
                              "phase-granular reshard)")
     f_theta = FAMILIES[family]
-    f_ds = DS_FAMILIES[family]
+    from ppls_tpu.models.integrands import get_family_ds
+    f_ds = get_family_ds(family, reduced=reduced)
     axis = FRONTIER_AXIS
     n_dev = mesh.devices.size
     target_global = n_dev * target_local
@@ -343,14 +348,15 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             gsegs0=jnp.int32(0),
             seg_stats0=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)),
                                  jnp.int32),
-            rule=rule)
+            rule=rule, scout=scout)
         if refill_slots:
             # in-kernel refill: the chip deals its work-sorted queue
             # top into the per-lane VMEM bank once and the kernel
             # refills its own lanes — zero boundary sorts, zero
             # per-segment XLA routing (walker.make_walk_kernel)
             walk, kx = _run_walk_kernel_refill(
-                local, refill_slots=refill_slots, **wkw)
+                local, refill_slots=refill_slots,
+                double_buffer=double_buffer, **wkw)
             roots_taken = kx.taken.astype(jnp.int64)
         else:
             walk = _run_walk(local, **wkw)
@@ -429,6 +435,7 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             srows=c.srows + srows_d,
             crounds=bred.crounds + d_crounds,
             waste=c.waste + walk.waste,
+            evals=c.evals + walk.evals,
             maxd=jnp.maximum(jnp.maximum(bred.maxd, bag3.max_depth),
                              jnp.max(walk.lanes.maxd)),
             cycles=c.cycles + 1,
@@ -464,14 +471,15 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
     def shard_body(bag_l, bag_r, bag_th, bag_meta, count, acc, tasks,
                    splits, btasks, wtasks, wsplits, roots, rounds, segs,
-                   wsteps, srows, crounds, waste, maxd, cycles, overflow,
-                   *admit_args):
+                   wsteps, srows, crounds, waste, evals, maxd, cycles,
+                   overflow, *admit_args):
         c = _DDCarry(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
                      bag_meta=bag_meta, count=count[0], acc=acc[0],
                      tasks=tasks[0], splits=splits[0], btasks=btasks[0],
                      wtasks=wtasks[0], wsplits=wsplits[0], roots=roots[0],
                      rounds=rounds[0], segs=segs[0], wsteps=wsteps[0],
                      srows=srows[0], crounds=crounds[0], waste=waste[0],
+                     evals=evals[0],
                      maxd=maxd[0], cycles=cycles[0], overflow=overflow[0])
         if admit_window:
             adm_l, adm_r, adm_th, adm_meta, adm_n, adm_clear = admit_args
@@ -483,14 +491,14 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                out.splits[None], out.btasks[None], out.wtasks[None],
                out.wsplits[None], out.roots[None], out.rounds[None],
                out.segs[None], out.wsteps[None], out.srows[None],
-               out.crounds[None], out.waste[None],
+               out.crounds[None], out.waste[None], out.evals[None],
                out.maxd[None], out.cycles[None], out.overflow[None])
         if admit_window:
             res = res + (_fam_live_local(out)[None],)
         return res
 
     sh = P(axis)
-    n_state = 21
+    n_state = 22
     n_in = n_state + (6 if admit_window else 0)
     n_out = n_state + (1 if admit_window else 0)
     # check_vma=False: the Pallas segment kernel's out_shape carries no
@@ -550,8 +558,8 @@ def integrate_family_walker_dd(
         seg_iters: int = 2048,  # see walker.py
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
-        exit_frac: float = 0.80,   # r5: see integrate_family_walker
-        suspend_frac: float = 0.5,
+        exit_frac: Optional[float] = None,   # see walker.resolve_cadence
+        suspend_frac: Optional[float] = None,
         max_cycles: int = 64,
         rule: Rule = Rule.TRAPEZOID,
         sort_roots: bool = True,
@@ -565,6 +573,17 @@ def integrate_family_walker_dd(
         #                             of per-cycle breed-round chains
         #                             (module docstring). Requires
         #                             refill_slots <= roots_per_lane.
+        scout_dtype: Optional[str] = None,   # round 12: "f32" = mixed-
+        #                             precision scouting per chip
+        #                             (walker.resolve_scout_dtype;
+        #                             None defers to PPLS_SCOUT=1)
+        double_buffer: bool = False,    # round 12: rolling half-bank
+        #                             deal per chip (requires an even
+        #                             refill_slots >= 2)
+        reduced_integrands: bool = False,   # round 12: prefer the
+        #                             range-reduced ds twin of the
+        #                             family (falls back to the
+        #                             reference twin when none exists)
         interpret: Optional[bool] = None,
         mesh: Optional[Mesh] = None,
         n_devices: Optional[int] = None,
@@ -591,6 +610,13 @@ def integrate_family_walker_dd(
         raise ValueError(
             f"refill_slots must be in [0, roots_per_lane={roots_per_lane}]"
             f", got {refill_slots}")
+    from ppls_tpu.parallel.walker import (resolve_cadence,
+                                          resolve_scout_dtype,
+                                          validate_double_buffer)
+    scout = resolve_scout_dtype(scout_dtype, rule)
+    validate_double_buffer(double_buffer, refill_slots)
+    exit_frac, suspend_frac = resolve_cadence(exit_frac, suspend_frac,
+                                              scout, refill_slots)
     if mesh is None:
         mesh = make_mesh(n_devices)
     n_dev = mesh.devices.size
@@ -600,7 +626,9 @@ def integrate_family_walker_dd(
     bounds = np.asarray(bounds, dtype=np.float64)
     if bounds.ndim == 1:
         bounds = np.tile(bounds.reshape(1, 2), (m, 1))
-    check_ds_domain(DS_FAMILIES[family], bounds, theta)
+    from ppls_tpu.models.integrands import get_family_ds
+    check_ds_domain(get_family_ds(family, reduced=reduced_integrands),
+                    bounds, theta)
 
     target_local, breed_chunk, store, reshard_window = _dd_sizing(
         lanes, capacity, chunk, roots_per_lane)
@@ -614,7 +642,9 @@ def integrate_family_walker_dd(
         int(target_local), bool(interpret),
         int(checkpoint_every if checkpoint_path else max_cycles),
         fill_l, fill_th, Rule(rule), bool(sort_roots),
-        float(sort_skip_ratio), int(refill_slots), int(reshard_window))
+        float(sort_skip_ratio), int(refill_slots), int(reshard_window),
+        scout=bool(scout), double_buffer=bool(double_buffer),
+        reduced=bool(reduced_integrands))
 
     if _state_override is not None:
         bag_l, bag_r, bag_th, bag_meta, count0 = _state_override
@@ -633,8 +663,11 @@ def integrate_family_walker_dd(
     # scalar CTR64 counters, so the flight recorder can attribute
     # straggler wsteps chip by chip
     per_chip["waste"] = np.zeros((n_dev, 4), dtype=np.int64)
+    # round-12 per-chip (scout, confirm) kernel-eval counters
+    per_chip["evals"] = np.zeros((n_dev, 2), dtype=np.int64)
     acc0 = np.zeros((n_dev, m), dtype=np.float64)
     cycles_done = 0
+    est_kevals = 0
     if _totals_override is not None:
         acc0 = np.asarray(_totals_override["acc_per_chip"])
         for k in CTR64:
@@ -648,6 +681,10 @@ def integrate_family_walker_dd(
         per_chip["waste"] = np.asarray(
             _totals_override.get("waste", per_chip["waste"]),
             dtype=np.int64).reshape(n_dev, 4)
+        per_chip["evals"] = np.asarray(
+            _totals_override.get("evals", per_chip["evals"]),
+            dtype=np.int64).reshape(n_dev, 2)
+        est_kevals = int(_totals_override.get("est_kevals", 0))
         cycles_done = int(_totals_override["cycles"])
 
     t0 = time.perf_counter()
@@ -658,6 +695,7 @@ def integrate_family_walker_dd(
              jnp.asarray(acc0))
     counters = tuple(jnp.asarray(per_chip[k]) for k in CTR64) + (
         jnp.asarray(per_chip["waste"]),
+        jnp.asarray(per_chip["evals"]),
         jnp.asarray(per_chip["maxd"]),
         jnp.zeros(n_dev, dtype=jnp.int32),
         jnp.zeros(n_dev, dtype=bool))
@@ -667,13 +705,13 @@ def integrate_family_walker_dd(
         out = run(*state, *counters)
         (bl, br, bth, bmeta, count, acc, tasks_c, splits_c, bt_c, wt_c,
          ws_c, roots_c, rounds_c, segs_c, wsteps_c, srows_c, crounds_c,
-         waste_c, maxd_c, cycles_c, ovf_c) = out
+         waste_c, evals_c, maxd_c, cycles_c, ovf_c) = out
         (count_h, tasks_h, splits_h, bt_h, wt_h, ws_h, roots_h, rounds_h,
-         segs_h, wsteps_h, srows_h, crounds_h, waste_h, maxd_h, cycles_h,
-         ovf_h) = jax.device_get(
+         segs_h, wsteps_h, srows_h, crounds_h, waste_h, evals_h, maxd_h,
+         cycles_h, ovf_h) = jax.device_get(
              (count, tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
               rounds_c, segs_c, wsteps_c, srows_c, crounds_c, waste_c,
-              maxd_c, cycles_c, ovf_c))
+              evals_c, maxd_c, cycles_c, ovf_c))
         left = int(np.sum(count_h))
         overflow = bool(np.any(ovf_h))
         for k, v in zip(CTR64, (tasks_h, splits_h, bt_h, wt_h, ws_h,
@@ -682,6 +720,7 @@ def integrate_family_walker_dd(
             per_chip[k] = np.asarray(v, dtype=np.int64)
         per_chip["maxd"] = np.asarray(maxd_h, dtype=np.int32)
         per_chip["waste"] = np.asarray(waste_h, dtype=np.int64)
+        per_chip["evals"] = np.asarray(evals_h, dtype=np.int64)
         cycles_done += int(np.max(cycles_h))
         if checkpoint_path is None or overflow or left == 0:
             break
@@ -693,7 +732,9 @@ def integrate_family_walker_dd(
         from ppls_tpu.runtime.checkpoint import save_family_checkpoint
         identity = _dd_ckpt_identity(family, float(eps), m, theta, bounds,
                                      n_dev, Rule(rule),
-                                     int(refill_slots))
+                                     int(refill_slots), scout=scout,
+                                     double_buffer=double_buffer,
+                                     reduced=reduced_integrands)
         counts = np.asarray(count_h, dtype=np.int32)
         b = min(1 << int(max(int(counts.max()), 1)).bit_length(), store)
         bl2 = np.asarray(jax.device_get(bl.reshape(n_dev, store)[:, :b]))
@@ -705,6 +746,8 @@ def integrate_family_walker_dd(
         totals = {"pc_" + k: per_chip[k].tolist() for k in CTR64}
         totals["pc_maxd"] = per_chip["maxd"].tolist()
         totals["waste"] = per_chip["waste"].tolist()
+        totals["evals"] = per_chip["evals"].tolist()
+        totals["est_kevals"] = est_kevals
         totals["cycles"] = cycles_done
         totals["acc_per_chip"] = acc_h.tolist()
         save_family_checkpoint(
@@ -721,7 +764,7 @@ def integrate_family_walker_dd(
         state = (bl, br, bth, bmeta, count, acc)
         counters = (tasks_c, splits_c, bt_c, wt_c, ws_c, roots_c,
                     rounds_c, segs_c, wsteps_c, srows_c, crounds_c,
-                    waste_c, maxd_c,
+                    waste_c, evals_c, maxd_c,
                     jnp.zeros(n_dev, dtype=jnp.int32), ovf_c)
     acc_h = np.asarray(jax.device_get(acc))
     wall = time.perf_counter() - t0
@@ -752,29 +795,38 @@ def integrate_family_walker_dd(
     tasks_per_chip = [int(t) for t in per_chip["tasks"]]
     tasks = tot["tasks"]
     wtasks = tot["wtasks"]
+    waste_pc = np.asarray(per_chip["waste"], dtype=np.int64)
+    waste_tot = waste_pc.sum(axis=0)
+    evals_pc = np.asarray(per_chip["evals"], dtype=np.int64)
+    evals_tot = evals_pc.sum(axis=0)
+    sevals, cevals = int(evals_tot[0]), int(evals_tot[1])
+    # round 12: the kernel eval share is DEVICE-COUNTED (scout+confirm
+    # counters, or the eval_active bucket — each live lane-step is
+    # exactly one real eval); bag phases and the sort pass evaluate a
+    # fixed per-row count by construction. A resumed pre-round-11
+    # snapshot's share arrives flagged through est_kevals — the SAME
+    # shared derivation as walker._assemble_result, so the engines
+    # cannot drift.
+    from ppls_tpu.parallel.walker import derive_kernel_evals
+    kernel_evals, evals_estimated = derive_kernel_evals(
+        sevals, cevals, int(waste_tot[0]), wtasks,
+        int(tot["wsplits"]), int(tot["roots"]), Rule(rule),
+        est_kevals=est_kevals)
     metrics = RunMetrics(
         tasks=tasks,
         splits=tot["splits"],
         leaves=tasks - tot["splits"],
         rounds=tot["rounds"] + tot["segs"],
         max_depth=tot["max_depth"],
-        # sort-pass cost from the DEVICE-COUNTED live-row score count
-        # (srows), not the consumed-root proxy (ADVICE r5 #4: the proxy
-        # undercounted re-scored remainders and overcounted roots the
-        # window never reached)
         integrand_evals=(
-            3 * tot["btasks"] + 2 * wtasks - tot["wsplits"]
-            + tot["roots"] + 3 * tot["srows"]
+            3 * tot["btasks"] + kernel_evals + 3 * tot["srows"]
             if Rule(rule) == Rule.TRAPEZOID else
-            5 * tot["btasks"] + 4 * wtasks - 2 * tot["wsplits"]
-            + tot["roots"] + 5 * tot["srows"]),
+            5 * tot["btasks"] + kernel_evals + 5 * tot["srows"]),
         wall_time_s=wall,
         n_chips=n_dev,
         tasks_per_chip=tasks_per_chip,
     )
     denom = tot["wsteps"] * lanes
-    waste_pc = np.asarray(per_chip["waste"], dtype=np.int64)
-    waste_tot = waste_pc.sum(axis=0)
     # run-completion telemetry boundary (round 10): the per-chip
     # counters were already pulled once at the leg boundary above —
     # publishing is host dict arithmetic, no extra device fetch
@@ -804,13 +856,18 @@ def integrate_family_walker_dd(
         collective_rounds=tot["crounds"],
         waste=waste_tot,
         waste_per_chip=waste_pc,
+        scout_evals=sevals,
+        confirm_evals=cevals if sevals else int(waste_tot[0]),
+        evals_estimated=evals_estimated,
     )
 
 
 def _dd_ckpt_identity(family: str, eps: float, m: int, theta: np.ndarray,
                       bounds: np.ndarray, n_dev: int,
                       rule: Rule = Rule.TRAPEZOID,
-                      refill_slots: int = 0) -> dict:
+                      refill_slots: int = 0, scout: bool = False,
+                      double_buffer: bool = False,
+                      reduced: bool = False) -> dict:
     from ppls_tpu.runtime.checkpoint import _family_identity, engine_name
     ident = _family_identity(engine_name("walker-dd", rule), family, eps,
                              m, theta, bounds)
@@ -821,6 +878,14 @@ def _dd_ckpt_identity(family: str, eps: float, m: int, theta: np.ndarray,
         # in legacy mode would not replay bit-identically — the mode is
         # identity. Legacy keeps the bare dict for snapshot back-compat.
         ident["refill_slots"] = int(refill_slots)
+    # round 12: scout/double-buffer schedules are identity for the same
+    # reason (conditional keys preserve pre-round-12 snapshot compat)
+    if scout:
+        ident["scout"] = True
+    if double_buffer:
+        ident["double_buffer"] = True
+    if reduced:
+        ident["reduced"] = True
     return ident
 
 
@@ -840,10 +905,16 @@ def resume_family_walker_dd(
     kwargs["mesh"] = mesh
     kwargs.pop("n_devices", None)
     n_dev = mesh.devices.size
-    identity = _dd_ckpt_identity(family, float(eps), m, theta_np,
-                                 bounds_np, n_dev,
-                                 Rule(kwargs.get("rule", Rule.TRAPEZOID)),
-                                 int(kwargs.get("refill_slots", 0)))
+    from ppls_tpu.parallel.walker import resolve_scout_dtype
+    identity = _dd_ckpt_identity(
+        family, float(eps), m, theta_np, bounds_np, n_dev,
+        Rule(kwargs.get("rule", Rule.TRAPEZOID)),
+        int(kwargs.get("refill_slots", 0)),
+        scout=resolve_scout_dtype(
+            kwargs.get("scout_dtype"),
+            Rule(kwargs.get("rule", Rule.TRAPEZOID))),
+        double_buffer=bool(kwargs.get("double_buffer", False)),
+        reduced=bool(kwargs.get("reduced_integrands", False)))
     bag_cols, _count, acc, totals = load_family_checkpoint(path, identity)
 
     # rebuild full-width per-chip stores around the saved live prefixes
@@ -877,6 +948,21 @@ def resume_family_walker_dd(
     totals = dict(totals)
     # prefer the binary-exact npz accumulator over the JSON round-trip
     totals["acc_per_chip"] = np.asarray(acc)
+    # pre-round-11 dd snapshots banked no counters: estimate the
+    # pre-resume kernel share now, flagged through est_kevals (the
+    # shared walker.derive_kernel_evals contract)
+    from ppls_tpu.parallel.walker import estimate_legacy_kernel_evals
+    totals.setdefault("est_kevals", estimate_legacy_kernel_evals(
+        {"waste": totals.get("waste", [0, 0, 0, 0]),
+         "sevals": int(np.sum(np.asarray(
+             totals.get("evals", 0), dtype=np.int64))),
+         "wtasks": int(np.sum(np.asarray(
+             totals.get("pc_wtasks", [0]), dtype=np.int64))),
+         "wsplits": int(np.sum(np.asarray(
+             totals.get("pc_wsplits", [0]), dtype=np.int64))),
+         "roots": int(np.sum(np.asarray(
+             totals.get("pc_roots", [0]), dtype=np.int64)))},
+        Rule(kwargs.get("rule", Rule.TRAPEZOID))))
     return integrate_family_walker_dd(
         family, theta, bounds, eps,
         checkpoint_path=path,

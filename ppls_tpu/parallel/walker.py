@@ -74,6 +74,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import inspect
+import os
 import time
 from typing import Callable, NamedTuple, Optional, Sequence
 
@@ -126,6 +128,158 @@ _MODE_INIT = 16             # freshly refilled root: next eval is f(left)
 _MODE_LOADM = 32            # Simpson only: next eval loads f(mid)
 _MODE_TESTB = 64            # Simpson only: q1 is stashed, next eval is
                             # q3 and the split decision fires
+
+# --- round-12 mixed-precision scouting --------------------------------------
+# Guard band of the f32 scout test, in units of 2^-23 (f32 ulp) times
+# the magnitude sum |la| + |ra| + |lr| of the trapezoid test's three
+# area terms. A decisive SPLIT fires only when the scouted error
+# exceeds eps by more than the band — i.e. only when no accumulation of
+# f32 rounding across the scout eval + the 6-op test chain could have
+# pushed it over; everything else (potential accepts AND the uncertain
+# zone) re-takes the decision in full ds during the same step's confirm
+# pass. 64 ulps is conservative against the scout transcendentals'
+# documented error (~8 ulps worst-case incl. reduction; ops/
+# scout_kernel.py) with >4x margin for the test chain's cancellation.
+SCOUT_GUARD_ULPS = 64.0
+_SCOUT_BAND = np.float32(SCOUT_GUARD_ULPS * 2.0 ** -23)
+
+
+@functools.lru_cache(maxsize=None)
+def scout_twin(f_ds: Callable) -> Callable:
+    """The f32 scout evaluator of a registered ds twin: the same
+    integrand routed through the declared scout-dtype surface
+    (``ops/scout_kernel.py``). Cached per ds twin so the returned
+    callable has a STABLE identity — it participates in jit static
+    arguments, and a fresh closure per call would defeat the
+    compile-once guard."""
+    if "dsm" not in inspect.signature(f_ds).parameters:
+        raise ValueError(
+            "scout mode requires a dsm-parameterized ds twin "
+            "(register_family_ds style: f_ds(x, th, dsm=...)); "
+            f"{getattr(f_ds, '__name__', f_ds)!r} takes no dsm")
+    from ppls_tpu.ops import scout_kernel
+
+    def f_scout(x, th):
+        return f_ds(x, th, dsm=scout_kernel)
+
+    return f_scout
+
+
+def resolve_scout_dtype(scout_dtype: Optional[str], rule: Rule) -> bool:
+    """Resolve the engines' ``scout_dtype`` parameter to the kernel's
+    boolean static. ``None`` defers to the ``PPLS_SCOUT=1`` environment
+    lane (the ci.sh f32-rot guard), which force-enables scouting on
+    every TRAPEZOID walker run; an EXPLICIT "f32" with the Simpson rule
+    is a hard error (the 5-phase Simpson chain has no scout step yet),
+    while the env lane silently skips Simpson runs so the whole tier-1
+    suite can run under PPLS_SCOUT=1."""
+    if scout_dtype is None:
+        if os.environ.get("PPLS_SCOUT", "") == "1" \
+                and Rule(rule) == Rule.TRAPEZOID:
+            return True
+        return False
+    if scout_dtype not in ("f64", "f32"):
+        raise ValueError(
+            f"scout_dtype must be 'f64' (off) or 'f32', got "
+            f"{scout_dtype!r}")
+    if scout_dtype == "f32" and Rule(rule) != Rule.TRAPEZOID:
+        raise ValueError(
+            "scout_dtype='f32' supports Rule.TRAPEZOID only (the "
+            "Simpson walker's 5-phase mode chain has no scout step)")
+    return scout_dtype == "f32"
+
+
+def derive_kernel_evals(sevals: int, cevals: int, eval_active: int,
+                        wtasks: int, wsplits: int, roots: int,
+                        rule: Rule, est_kevals: int = 0):
+    """The ONE derivation of the walker kernel's integrand-eval count
+    (shared by the single-chip and dd result assembly, so the two
+    engines cannot drift): device-counted scout+confirm counters in
+    scout mode, the eval_active waste bucket otherwise (each live
+    lane-step evaluates exactly one real point), PLUS ``est_kevals`` —
+    the host-model estimate of any PRE-COUNTER share (a resumed
+    pre-round-11 snapshot's legs, estimated at resume time where the
+    restored totals are in hand). Returns ``(kernel_evals,
+    evals_estimated)``: the count is flagged estimated whenever any
+    model share is mixed in."""
+    counted = (sevals + cevals) if sevals else int(eval_active)
+    estimated = est_kevals > 0
+    if counted == 0 and wtasks > 0 and not estimated:
+        # whole-run fallback (no counters anywhere): the pre-round-12
+        # host model
+        est_kevals = (2 * wtasks - wsplits + roots
+                      if Rule(rule) == Rule.TRAPEZOID else
+                      4 * wtasks - 2 * wsplits + roots)
+        estimated = True
+    return counted + int(est_kevals), estimated
+
+
+def estimate_legacy_kernel_evals(totals: dict, rule: Rule) -> int:
+    """Host-model estimate of a restored snapshot's kernel evals when
+    (and only when) its totals predate the device counters — the
+    ``est_kevals`` input of :func:`derive_kernel_evals`, computed at
+    RESUME time where the pre-resume share is still separable from the
+    legs the resumed run will add."""
+    waste = totals.get("waste") or [0, 0, 0, 0]
+    wtasks = int(totals.get("wtasks", 0))
+    if any(int(v) for v in np.asarray(waste).reshape(-1)) \
+            or int(totals.get("sevals", 0)) or wtasks == 0:
+        return 0
+    wsplits = int(totals.get("wsplits", 0))
+    roots = int(totals.get("roots", 0))
+    return (2 * wtasks - wsplits + roots
+            if Rule(rule) == Rule.TRAPEZOID else
+            4 * wtasks - 2 * wsplits + roots)
+
+
+def validate_double_buffer(double_buffer: bool,
+                           refill_slots: int) -> None:
+    """The ONE precondition check for the rolling half-bank deal,
+    shared by every engine entry (walker/dd/stream) so the constraint
+    cannot drift."""
+    if double_buffer and (refill_slots < 2 or refill_slots % 2):
+        raise ValueError(
+            f"double_buffer requires an even refill_slots >= 2, got "
+            f"{refill_slots}")
+
+
+def _is_reduced_twin(f_ds: Callable) -> bool:
+    """Whether ``f_ds`` is a REGISTERED range-reduced ds twin — the
+    reduced schedule is checkpoint identity (a snapshot recorded
+    through a reduced twin must not silently resume through the
+    reference twin, or vice versa; the dd/stream engines key the same
+    flag from their explicit ``reduced_integrands`` parameter, but the
+    single-chip walker receives the twin itself, so membership in the
+    registry is the detection)."""
+    from ppls_tpu.models.integrands import DS_FAMILIES_REDUCED
+    return any(f_ds is v for v in DS_FAMILIES_REDUCED.values())
+
+
+def resolve_cadence(exit_frac: Optional[float],
+                    suspend_frac: Optional[float], scout: bool,
+                    refill_slots: int = 0):
+    """Mode-aware refill-cadence defaults (round 12).
+
+    The r5-tuned defaults (exit 0.80 / suspend 0.5) balanced occupancy
+    against BOUNDARY COST — each legacy refill event paid XLA sorts and
+    each suspended tail re-bred through a whole extra cycle. The scout
+    + IN-KERNEL-REFILL combination changes the economics: refill events
+    are in-kernel masked selects and every live lane-step is a test, so
+    a tighter cadence (refill at 5% parked instead of 20%, suspend the
+    dry tail at 65% occupancy instead of 50%) converts refill_stall and
+    drain_tail lane-steps into eval_active nearly for free — measured
+    on the flagship interpret proxy: lane_efficiency 0.80 -> 0.89,
+    task count unchanged. The tightening applies ONLY with in-kernel
+    refill: on the legacy XLA-boundary engine the higher suspension
+    floor just multiplies expensive boundary cycles (measured on the
+    16-mesh dry run: the legacy walk phase can stop engaging at all).
+    Callers that pass explicit fractions keep them in every mode."""
+    tight = scout and refill_slots > 0
+    if exit_frac is None:
+        exit_frac = 0.95 if tight else 0.80
+    if suspend_frac is None:
+        suspend_frac = 0.65 if tight else 0.5
+    return float(exit_frac), float(suspend_frac)
 
 
 class WalkState(NamedTuple):
@@ -188,9 +342,31 @@ def _ctz(k):
 
 def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                      interpret: bool = False, early_exit: bool = False,
-                     rule: Rule = Rule.TRAPEZOID, refill_slots: int = 0):
+                     rule: Rule = Rule.TRAPEZOID, refill_slots: int = 0,
+                     scout: bool = False):
     """Build the segment kernel: up to seg_iters walker steps over all
     lanes.
+
+    With ``scout`` (round 12, TRAPEZOID only) the step machine is the
+    TWO-PASS PRECISION-SCOUTING variant: every live lane tests its
+    current node every step — the split/accept error test is scored in
+    plain f32 through the declared scout-dtype surface
+    (``ops/scout_kernel.py``), with pending endpoint loads fused INLINE
+    into the same step (a scout eval costs ~half a ds eval, so
+    evaluating mid + the pending endpoints together is still cheaper
+    than one ds step, and the separate LOAD/INIT steps — ~1/3 of all
+    baseline steps — disappear entirely). Decisive splits (scout error
+    above eps by more than the guard band, ``SCOUT_GUARD_ULPS``) take
+    the split immediately with NO ds work; every potential accept and
+    every guard-band-uncertain decision is re-taken in full ds by an
+    in-step CONFIRM pass (three fence-free ds evals of the node's
+    endpoints + midpoint under one lax.cond, skipped on steps with no
+    confirming lane), so accepted-leaf credit is ALWAYS full-precision
+    and a scout value never reaches the accumulator. The scout/confirm
+    eval split is device-counted (two extra SMEM scalars per launch:
+    useful scout evals, ds confirm evals) — the counters behind the
+    bench's ``evals_per_task_tpu`` and the attribution of the f32
+    saving.
 
     ``f_ds((hi, lo) x, (hi, lo) theta) -> (hi, lo)`` is the ds integrand.
 
@@ -430,8 +606,168 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                 testing, s.base_d + s.d, jnp.int32(0))),
         )
 
+    def step_scout(s: WalkState):
+        """Round-12 scouting step (trapezoid): one fused scout test per
+        live lane per step, ds confirm for non-decisive decisions.
+
+        Mode bits are reinterpreted as CACHE-VALIDITY markers serviced
+        inline instead of step-consuming phases: _MODE_INIT = both
+        endpoint caches invalid (fresh root; scout-evaluate x0 AND x1
+        this step), _MODE_LOAD = f(right) invalid (post-advance;
+        scout-evaluate x1 this step). Either way the midpoint test
+        fires in the SAME step, so every live lane-step is a test —
+        lane_efficiency's structural cap rises from ~2/3 (1 test per
+        ~1.5 steps) to ~1 (1 test per step), which is where the
+        interpret-mode >=0.85 proxy comes from. Caches hold scout
+        (f32) values throughout; they only ever feed scout tests —
+        the confirm pass re-evaluates all three points in ds, so
+        credited values never inherit f32 error.
+
+        HONEST COST MODEL (device-counted; see BASELINE.md round 12):
+        the win is STEPS and occupancy, not total ds-eval count. The
+        3-point confirm keeps full-ds evals near the baseline's total
+        (concentrated into ~1/3 of the steps, 3-way ILP) while every
+        other step's eval is f32 — per-task step count drops ~33% and
+        the step's critical path is the cheap scout chain. Caching the
+        confirm's ds endpoint values in the trapezoid-idle fm/fq VMEM
+        slots would cut confirms to ~1 ds eval per accept; that is the
+        named follow-up once the TPU round measures the real ratio.
+        Returns ``(state, scout_evals, confirm_evals)`` step counts."""
+        parked = (s.flags & _PARKED) != 0
+        mode_load = (s.flags & _MODE_LOAD) != 0
+        mode_init = (s.flags & _MODE_INIT) != 0
+        live = jnp.logical_not(parked)
+
+        w, x0, x1 = _node_geometry(s)
+        mid = dsk.ds_add(x0, dsk.ds_mul_pow2(w, 0.5))
+        benign = (jnp.ones_like(s.fl_h), jnp.zeros_like(s.fl_h))
+
+        # scout evals (f32). Lanes not needing a point get the benign
+        # substitute (same convention as the baseline step's parked
+        # eval); the SIMD grid evaluates all three every step, but only
+        # the useful ones are counted (the engine-wide padding
+        # convention).
+        need_l = jnp.logical_and(live, mode_init)
+        need_r = jnp.logical_and(live,
+                                 jnp.logical_or(mode_init, mode_load))
+        f_m = f_scout(dsk.ds_where(parked, benign, mid),
+                      (s.th_h, s.th_l))
+        f_l = f_scout(dsk.ds_where(need_l, x0, benign),
+                      (s.th_h, s.th_l))
+        f_r = f_scout(dsk.ds_where(need_r, x1, benign),
+                      (s.th_h, s.th_l))
+        fl_eff = dsk.ds_where(mode_init, f_l, (s.fl_h, s.fl_l))
+        fr_eff = dsk.ds_where(need_r, f_r, (s.fr_h, s.fr_l))
+
+        # f32 scout trapezoid test (hi limbs; the scout module's lo
+        # limbs are identically zero)
+        qw = w[0]
+        la32 = (fl_eff[0] + f_m[0]) * (qw * np.float32(0.25))
+        ra32 = (f_m[0] + fr_eff[0]) * (qw * np.float32(0.25))
+        lr32 = (fl_eff[0] + fr_eff[0]) * (qw * np.float32(0.5))
+        err32 = jnp.abs((la32 + ra32) - lr32)
+        band = _SCOUT_BAND * (jnp.abs(la32) + jnp.abs(ra32)
+                              + jnp.abs(lr32))
+
+        testing = live
+        decisive = jnp.logical_and(testing, err32 > eps32 + band)
+        need_conf = jnp.logical_and(testing,
+                                    jnp.logical_not(decisive))
+        n_conf = dsk.mask_count(need_conf)
+
+        z32 = jnp.zeros_like(s.fl_h)
+
+        def do_confirm(_):
+            # full-ds re-evaluation of the tested node: endpoints +
+            # midpoint fresh from the dyadic geometry (the scout caches
+            # never touch the credit path)
+            g0 = f_ds(dsk.ds_where(need_conf, x0, benign),
+                      (s.th_h, s.th_l))
+            gm = f_ds(dsk.ds_where(need_conf, mid, benign),
+                      (s.th_h, s.th_l))
+            g1 = f_ds(dsk.ds_where(need_conf, x1, benign),
+                      (s.th_h, s.th_l))
+            quarter = dsk.ds_mul_pow2(w, 0.25)
+            la = dsk.ds_mul(dsk.ds_add(g0, gm), quarter)
+            ra = dsk.ds_mul(dsk.ds_add(gm, g1), quarter)
+            val = dsk.ds_add(la, ra)
+            lr = dsk.ds_mul(dsk.ds_add(g0, g1), dsk.ds_mul_pow2(w, 0.5))
+            errd = dsk.ds_abs(dsk.ds_sub(val, lr))
+            return val[0], val[1], (errd[0] + errd[1]) > eps32
+
+        def no_confirm(_):
+            return z32, z32, jnp.zeros_like(parked)
+
+        vh, vl, split_ds = lax.cond(n_conf > 0, do_confirm, no_confirm,
+                                    0)
+        val = (vh, vl)
+        split = jnp.where(need_conf, split_ds, decisive)
+
+        do_split = jnp.logical_and(testing, split)
+        ovf = jnp.logical_and(do_split, s.d >= MAX_REL_DEPTH)
+        do_split = jnp.logical_and(do_split, jnp.logical_not(ovf))
+        # an accept is only ever a confirmed (ds) accept: decisive
+        # lanes split, so do_accept implies need_conf and `val` is the
+        # full-ds leaf value
+        do_accept = jnp.logical_and(testing, jnp.logical_not(split))
+
+        acc = dsk.ds_add((s.acc_h, s.acc_l), dsk.ds_where(
+            do_accept, val, (z32, z32)))
+        t = _ctz(s.i + 1)
+        fin = jnp.logical_and(do_accept, t >= s.d)
+        adv = jnp.logical_and(do_accept, jnp.logical_not(fin))
+        i_next = jnp.where(do_split, s.i * 2,
+                           jnp.where(adv, (s.i >> t) + 1, s.i))
+        d_next = jnp.where(do_split, s.d + 1,
+                           jnp.where(adv, s.d - t, s.d))
+        # caches (scout precision, test-only): descend keeps f(left),
+        # f(mid) becomes f(right); advance shifts f(right) to f(left)
+        # and marks f(right) for an inline reload next step.
+        new_fl = dsk.ds_where(adv, fr_eff, fl_eff)
+        new_fr = dsk.ds_where(do_split, f_m, fr_eff)
+
+        flags = s.flags & ~jnp.int32(_MODE_INIT | _MODE_LOAD)
+        flags = jnp.where(adv, flags | _MODE_LOAD, flags)
+        flags = jnp.where(fin, flags | _PARKED, flags)
+        flags = jnp.where(ovf, flags | (_PARKED | _OVF), flags)
+
+        # device-counted eval split: useful scout evals this step (mid
+        # per live lane + the fused endpoint loads) and ds confirm
+        # evals (3 per confirming lane; 0 when the cond skipped)
+        sc_n = (dsk.mask_count(live) + dsk.mask_count(need_l)
+                + dsk.mask_count(need_r))
+        cf_n = 3 * n_conf
+
+        s2 = WalkState(
+            a_h=s.a_h, a_l=s.a_l, w_h=s.w_h, w_l=s.w_l,
+            th_h=s.th_h, th_l=s.th_l,
+            fl_h=new_fl[0], fl_l=new_fl[1],
+            fr_h=new_fr[0], fr_l=new_fr[1],
+            fm_h=s.fm_h, fm_l=s.fm_l, fq_h=s.fq_h, fq_l=s.fq_l,
+            acc_h=acc[0], acc_l=acc[1],
+            i=i_next, d=d_next, base_d=s.base_d, fam=s.fam,
+            flags=flags,
+            tasks=s.tasks + testing.astype(jnp.int32),
+            splits=s.splits + do_split.astype(jnp.int32),
+            maxd=jnp.maximum(s.maxd, jnp.where(
+                testing, s.base_d + s.d, jnp.int32(0))),
+        )
+        return s2, sc_n, cf_n
+
     if rule == Rule.SIMPSON:
         step = step_simpson
+
+    if scout:
+        if rule != Rule.TRAPEZOID:
+            raise ValueError("scout mode supports Rule.TRAPEZOID only")
+        f_scout = scout_twin(f_ds)
+        step_fn = step_scout
+    else:
+        _base_step = step
+
+        def step_fn(s: WalkState):
+            zc = jnp.int32(0)
+            return _base_step(s), zc, zc
 
     n_fields = len(WalkState._fields)
 
@@ -444,18 +780,27 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             bank_refs = refs[4:11]      # a_h, a_l, w_h, w_l, th_h, th_l,
             #                             meta — each (R, rows, 128)
             slot_ref = refs[11]
-            in_refs = refs[12:12 + n_fields]
-            out_refs = refs[12 + n_fields:12 + 2 * n_fields]
-            slot_out_ref = refs[12 + 2 * n_fields]
-            resh_ref = refs[13 + 2 * n_fields]
-            resl_ref = refs[14 + 2 * n_fields]
-            steps_ref = refs[15 + 2 * n_fields]
+            # round-12 sentinel result row (double-buffer): a take at
+            # cursor 0 (prev == -1, only possible right after a swap
+            # shifted the lane off a retired half's in-flight root)
+            # banks here, keyed by the lane's pre-take family
+            resm_in = refs[12:15]       # resm_h, resm_l, resm_fam
+            in_refs = refs[15:15 + n_fields]
+            out_refs = refs[15 + n_fields:15 + 2 * n_fields]
+            slot_out_ref = refs[15 + 2 * n_fields]
+            resh_ref = refs[16 + 2 * n_fields]
+            resl_ref = refs[17 + 2 * n_fields]
+            resm_out = refs[18 + 2 * n_fields:21 + 2 * n_fields]
+            steps_ref = refs[21 + 2 * n_fields]
             # round-11 lane-waste accounting: one (1, 1) SMEM scalar per
             # bucket (eval_active, masked_dead, refill_stall, drain_tail)
-            waste_refs = refs[16 + 2 * n_fields:20 + 2 * n_fields]
+            waste_refs = refs[22 + 2 * n_fields:26 + 2 * n_fields]
+            # round-12 eval accounting: scout evals / ds confirm evals
+            eval_refs = refs[26 + 2 * n_fields:28 + 2 * n_fields]
 
             s0 = WalkState(*(r[:] for r in in_refs))
             slot0 = slot_ref[:]
+            resm0 = tuple(r[:] for r in resm_in)
             nslots = nslots_ref[:]
             thresh = thresh_ref[0, 0]
             cap = cap_ref[0, 0]
@@ -479,13 +824,27 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                 return live, nref
 
             def do_refill(op):
-                st, sl, resh, resl = op
+                st, sl, resh, resl, resm = op
                 parked = (st.flags & _PARKED) != 0
                 ovf = (st.flags & _OVF) != 0
                 take = jnp.logical_and(
                     jnp.logical_and(parked, jnp.logical_not(ovf)),
                     sl < nslots)
                 prev = sl - 1
+                # sentinel banking: prev == -1 happens on a lane's very
+                # first take (acc = 0, benign) and — in double-buffer
+                # mode — on the first take after a swap shifted the
+                # lane's cursor off a retired half whose result row is
+                # gone: the finished root's accumulator lands here,
+                # keyed by the PRE-TAKE family, and the XLA boundary
+                # credits + zeroes it at each swap and at phase end.
+                # At most one real banking per lane between credits
+                # (the cursor is monotone between swaps), so a single
+                # per-lane row cannot be overwritten while loaded.
+                bank_m1 = jnp.logical_and(take, prev == -1)
+                resm = (jnp.where(bank_m1, st.acc_h, resm[0]),
+                        jnp.where(bank_m1, st.acc_l, resm[1]),
+                        jnp.where(bank_m1, st.fam, resm[2]))
                 # per-lane indexed read of the private root bank and
                 # indexed write of the result bank, as static chains of
                 # R masked selects (Mosaic has no cross-lane gather;
@@ -529,7 +888,7 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                     tasks=st.tasks, splits=st.splits, maxd=st.maxd,
                 )
                 return st2, jnp.where(take, sl + 1, sl), \
-                    tuple(resh), tuple(resl)
+                    tuple(resh), tuple(resl), resm
 
             live0, nref0 = counts(s0, slot0)
             resh0 = tuple(z32 for _ in range(R))
@@ -546,15 +905,17 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                         jnp.logical_or(live > thresh, nref > 0)))
 
             def body(c):
-                k, st, sl, live, nref, resh, resl, wa, wd, ws, wt = c
+                (k, st, sl, live, nref, resh, resl, resm, wa, wd, ws,
+                 wt, se, ce) = c
                 # refill BEFORE the step: freshly parked lanes from the
                 # previous step join the candidate pool, and a fully
                 # parked start (phase seeding) refills on iteration 0
                 do = jnp.logical_and(
                     nref > 0,
                     jnp.logical_or(nref >= batch, live <= thresh))
-                st, sl, resh, resl = lax.cond(
-                    do, do_refill, lambda op: op, (st, sl, resh, resl))
+                st, sl, resh, resl, resm = lax.cond(
+                    do, do_refill, lambda op: op,
+                    (st, sl, resh, resl, resm))
                 # lane-waste classification of the state THIS step
                 # evaluates (post-refill): a live lane's eval is useful
                 # work; a parked lane's benign eval is wasted and splits
@@ -575,71 +936,97 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                 dead_n = dsk.mask_count(jnp.logical_and(
                     noroot, jnp.logical_not(takeable)))
                 tail_n = n_lanes - live_n - stall_n - dead_n
-                st = step(st)
+                st, sc_n, cf_n = step_fn(st)
                 live, nref = counts(st, sl)
-                return (k + 1, st, sl, live, nref, resh, resl,
+                return (k + 1, st, sl, live, nref, resh, resl, resm,
                         wa + live_n, wd + dead_n, ws + stall_n,
-                        wt + tail_n)
+                        wt + tail_n, se + sc_n, ce + cf_n)
 
-            (k, out, slot_o, _, _, resh, resl, wa, wd, ws, wt) = \
-                lax.while_loop(
+            (k, out, slot_o, _, _, resh, resl, resm, wa, wd, ws, wt,
+             se, ce) = lax.while_loop(
                     cond, body,
                     (jnp.int32(0), s0, slot0, live0, nref0, resh0,
-                     resl0, zc, zc, zc, zc))
+                     resl0, resm0, zc, zc, zc, zc, zc, zc))
             for r, v in zip(out_refs, out):
                 r[:] = v
             slot_out_ref[:] = slot_o
             for kk in range(R):
                 resh_ref[kk] = resh[kk]
                 resl_ref[kk] = resl[kk]
+            for r, v in zip(resm_out, resm):
+                r[:] = v
             steps_ref[0, 0] = k
             for r, v in zip(waste_refs, (wa, wd, ws, wt)):
                 r[0, 0] = v
+            for r, v in zip(eval_refs, (se, ce)):
+                r[0, 0] = v
 
         def run_segment_rf(state: WalkState, slot, thresh, cap, batch,
-                           nslots, bank):
+                           nslots, bank, resm):
             """One refill-kernel launch. ``bank`` is the 7-tuple of
-            (R, rows, 128) dealt root arrays; returns (state, slot,
-            resbank_h, resbank_l, steps, waste4) where ``waste4`` is
-            the launch's device-counted lane-waste bucket 4-tuple."""
+            (R, rows, 128) dealt root arrays and ``resm`` the carried
+            (resm_h, resm_l, resm_fam) sentinel result row; returns
+            (state, slot, resbank_h, resbank_l, resm, steps, waste4,
+            evals2) where ``waste4`` is the launch's device-counted
+            lane-waste bucket 4-tuple and ``evals2`` the round-12
+            (scout, confirm) eval pair (zeros when scouting is off)."""
             shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
                            for x in state)
             bank_shape = (R,) + state.a_h.shape
+            lane_f32 = jax.ShapeDtypeStruct(state.a_h.shape, jnp.float32)
+            lane_i32 = jax.ShapeDtypeStruct(state.i.shape, jnp.int32)
             smem = pl.BlockSpec(memory_space=pltpu.SMEM)
             vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
             scalar = jax.ShapeDtypeStruct((1, 1), jnp.int32)
             out = pl.pallas_call(
                 kernel_rf,
                 out_shape=shapes + (
-                    jax.ShapeDtypeStruct(state.i.shape, jnp.int32),
+                    lane_i32,
                     jax.ShapeDtypeStruct(bank_shape, jnp.float32),
                     jax.ShapeDtypeStruct(bank_shape, jnp.float32),
-                    scalar, scalar, scalar, scalar, scalar),
+                    lane_f32, lane_f32, lane_i32,
+                    scalar, scalar, scalar, scalar, scalar, scalar,
+                    scalar),
                 in_specs=[smem, smem, smem]
-                + [vmem] * (1 + 7 + 1)
+                + [vmem] * (1 + 7 + 1 + 3)
                 + [vmem] * n_fields,
                 out_specs=(vmem,) * n_fields
-                + (vmem, vmem, vmem) + (smem,) * 5,
+                + (vmem,) * 6 + (smem,) * 7,
                 interpret=interpret,
             )(thresh.reshape(1, 1).astype(jnp.int32),
               cap.reshape(1, 1).astype(jnp.int32),
               batch.reshape(1, 1).astype(jnp.int32),
-              nslots, *bank, slot, *state)
+              nslots, *bank, slot, *resm, *state)
             return (WalkState(*out[:n_fields]), out[n_fields],
                     out[n_fields + 1], out[n_fields + 2],
-                    out[n_fields + 3][0, 0],
-                    tuple(out[n_fields + 4 + j][0, 0] for j in range(4)))
+                    tuple(out[n_fields + 3 + j] for j in range(3)),
+                    out[n_fields + 6][0, 0],
+                    tuple(out[n_fields + 7 + j][0, 0] for j in range(4)),
+                    tuple(out[n_fields + 11 + j][0, 0]
+                          for j in range(2)))
 
         return run_segment_rf
 
     if not early_exit:
+        if scout:
+            # the fixed-iteration kernel has no counter outputs: a
+            # scout build would silently drop the scout/confirm counts
+            # and flag a countable run as estimated downstream —
+            # refuse until a caller actually needs the combination
+            # (only tools/profile_walker.py uses this variant today,
+            # scout off)
+            raise ValueError(
+                "scout mode requires the early-exit or refill kernel "
+                "variants (the plain fixed-iteration kernel carries "
+                "no eval counters)")
+
         def kernel(*refs):
             in_refs = refs[:n_fields]
             out_refs = refs[n_fields:]
             s = WalkState(*(r[:] for r in in_refs))
 
             def body(_, s):
-                return step(s)
+                return step_fn(s)[0]
 
             out = lax.fori_loop(0, seg_iters, body, s)
             for r, v in zip(out_refs, out):
@@ -671,6 +1058,7 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         # was waiting for the segment's bank/refill boundary) vs
         # drain-tail (queue dry: nothing could have fed it).
         wa_ref, wd_ref, wr_ref = refs[3 + 2 * n_fields:6 + 2 * n_fields]
+        se_ref, ce_ref = refs[6 + 2 * n_fields:8 + 2 * n_fields]
         s = WalkState(*(r[:] for r in in_refs))
         thresh = thresh_ref[0, 0]
         cap = cap_ref[0, 0]
@@ -696,21 +1084,25 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             # while_loop's cond/body are separate programs with no
             # cross-CSE, so recomputing it would double the per-step
             # popcount cost)
-            k, st, live_n, wa, wd, wr = carry
+            k, st, live_n, wa, wd, wr, se, ce = carry
             dead_n = dsk.mask_count((st.flags & _NO_ROOT) != 0)
-            st2 = step(st)
+            st2, sc_n, cf_n = step_fn(st)
             return (k + 1, st2, live_count(st2), wa + live_n,
-                    wd + dead_n, wr + (n_lanes - live_n - dead_n))
+                    wd + dead_n, wr + (n_lanes - live_n - dead_n),
+                    se + sc_n, ce + cf_n)
 
         zc = jnp.int32(0)
-        k, out, _, wa, wd, wr = lax.while_loop(
-            cond, body, (jnp.int32(0), s, live_count(s), zc, zc, zc))
+        k, out, _, wa, wd, wr, se, ce = lax.while_loop(
+            cond, body, (jnp.int32(0), s, live_count(s), zc, zc, zc,
+                         zc, zc))
         for r, v in zip(out_refs, out):
             r[:] = v
         steps_ref[0, 0] = k
         wa_ref[0, 0] = wa
         wd_ref[0, 0] = wd
         wr_ref[0, 0] = wr
+        se_ref[0, 0] = se
+        ce_ref[0, 0] = ce
 
     def run_segment_ee(state: WalkState, thresh, cap):
         shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
@@ -719,16 +1111,17 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         scalar = jax.ShapeDtypeStruct((1, 1), jnp.int32)
         out = pl.pallas_call(
             kernel_ee,
-            out_shape=shapes + (scalar, scalar, scalar, scalar),
+            out_shape=shapes + (scalar,) * 6,
             in_specs=[smem, smem]
             + [pl.BlockSpec(memory_space=pltpu.VMEM)] * n_fields,
             out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * n_fields
-            + (smem,) * 4,
+            + (smem,) * 6,
             interpret=interpret,
         )(thresh.reshape(1, 1).astype(jnp.int32),
           cap.reshape(1, 1).astype(jnp.int32), *state)
         return (WalkState(*out[:n_fields]), out[n_fields][0, 0],
-                tuple(out[n_fields + 1 + j][0, 0] for j in range(3)))
+                tuple(out[n_fields + 1 + j][0, 0] for j in range(3)),
+                tuple(out[n_fields + 4 + j][0, 0] for j in range(2)))
 
     return run_segment_ee
 
@@ -759,6 +1152,16 @@ SEG_STAT_FIELDS = ("steps", "live_at_exit", "queue_left", "refilled")
 WASTE_FIELDS = ("eval_active", "masked_dead", "refill_stall",
                 "drain_tail")
 
+# Round-12 device-counted kernel eval split (tail columns after the
+# waste buckets): `scout_evals` = useful f32 scout-pass evals,
+# `confirm_evals` = full-ds confirm-pass evals. In scout mode their sum
+# is the kernel's exact integrand-eval count; with scouting off both
+# are zero and the exact count is the eval_active waste bucket (every
+# live lane-step evaluates exactly one real point). Either way the
+# bench's evals_per_task_tpu is now COUNTED, not modeled
+# (`integrand_evals_estimated` drops).
+EVAL_FIELDS = ("scout_evals", "confirm_evals")
+
 # column order of the per-cycle stats ring (one row per engine cycle).
 # `tasks`/`splits` (round 10) are the cycle's aggregate device counts —
 # the columns utils.metrics.round_stats_from_rows reads to give every
@@ -769,7 +1172,7 @@ WASTE_FIELDS = ("eval_active", "masked_dead", "refill_stall",
 CYCLE_STAT_FIELDS = ("bred_roots", "breed_iters", "roots_consumed",
                      "walker_tasks", "walker_steps", "segments",
                      "expand_tasks", "drain_tasks", "sort_rows",
-                     "tasks", "splits") + WASTE_FIELDS
+                     "tasks", "splits") + WASTE_FIELDS + EVAL_FIELDS
 
 
 class _WalkCarry(NamedTuple):
@@ -783,6 +1186,7 @@ class _WalkCarry(NamedTuple):
     gsegs: jnp.ndarray      # int32 global segment counter (ring index)
     seg_stats: jnp.ndarray  # (S_CAP, len(SEG_STAT_FIELDS)) int32 ring
     waste: jnp.ndarray      # (4,) i64 lane-waste buckets (WASTE_FIELDS)
+    evals: jnp.ndarray      # (2,) i64 scout/confirm evals (EVAL_FIELDS)
 
 
 def _breed(bag: BagState, *, f_theta: Callable, eps: float, chunk: int,
@@ -1060,7 +1464,7 @@ def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
                       cursor=c.cursor + n_taken, acc=acc,
                       segs=c.segs + 1, steps=c.steps,
                       gsegs=c.gsegs, seg_stats=c.seg_stats,
-                      waste=c.waste)
+                      waste=c.waste, evals=c.evals)
 
 
 def _idle_lanes(s: WalkState):
@@ -1072,7 +1476,8 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
               min_active_frac: float, exit_frac: float,
               suspend_frac: float, interpret: bool,
               lanes: int, gsegs0, seg_stats0,
-              rule: Rule = Rule.TRAPEZOID) -> _WalkCarry:
+              rule: Rule = Rule.TRAPEZOID,
+              scout: bool = False) -> _WalkCarry:
     """One walk phase (traced inline inside :func:`_run_cycles`).
 
     Occupancy-aware segments: each kernel launch runs until the live
@@ -1093,7 +1498,7 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
     """
     run_segment = make_walk_kernel(f_ds, eps, seg_iters,
                                    interpret=interpret, early_exit=True,
-                                   rule=rule)
+                                   rule=rule, scout=scout)
 
     rows = lanes // 128
     z32 = jnp.zeros((rows, 128), jnp.float32)
@@ -1115,7 +1520,8 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
                        steps=jnp.int32(0),
                        gsegs=jnp.asarray(gsegs0, jnp.int32),
                        seg_stats=seg_stats0,
-                       waste=jnp.zeros(4, jnp.int64))
+                       waste=jnp.zeros(4, jnp.int64),
+                       evals=jnp.zeros(2, jnp.int64))
     carry = _bank_and_refill(carry, m, lanes)   # initial seeding
     min_active = jnp.int32(int(lanes * min_active_frac))
     exit_thresh = jnp.int32(int(lanes * exit_frac))
@@ -1147,8 +1553,8 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
         thresh = jnp.where(queue_left > 0, exit_thresh,
                            jnp.maximum(min_active, suspend_thresh))
         cap = jnp.clip(step_budget - c.steps, 1, seg_iters)
-        new_lanes, si_used, (wa, wd, wr) = run_segment(c.lanes, thresh,
-                                                       cap)
+        new_lanes, si_used, (wa, wd, wr), (se, ce) = run_segment(
+            c.lanes, thresh, cap)
         live_exit = lanes - jnp.sum((new_lanes.flags & _PARKED) != 0,
                                     dtype=jnp.int32)
         out = _bank_and_refill(c._replace(lanes=new_lanes), m, lanes)
@@ -1169,7 +1575,9 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
             jnp.where(queue_left > 0, zq, wr)]).astype(jnp.int64)
         return out._replace(steps=out.steps + si_used,
                             gsegs=out.gsegs + 1, seg_stats=stats,
-                            waste=out.waste + waste_row)
+                            waste=out.waste + waste_row,
+                            evals=out.evals
+                            + jnp.stack([se, ce]).astype(jnp.int64))
 
     out = lax.while_loop(cond, body, carry)
     # Final credit: lanes still mid-walk (suspended) hold accepted-leaf
@@ -1219,7 +1627,7 @@ def _fresh_lanes(lanes: int) -> WalkState:
 
 
 def deal_root_bank(bag: BagState, *, refill_slots: int, lanes: int,
-                   min_active):
+                   min_active, offset=0):
     """Build the per-lane VMEM root bank from a work-sorted root queue:
     the SHARED bank builder of every in-kernel-refill walk phase (the
     single-chip :func:`_run_walk_kernel_refill` and the demand-driven
@@ -1238,11 +1646,18 @@ def deal_root_bank(bag: BagState, *, refill_slots: int, lanes: int,
     (R, rows, 128) bank arrays, the per-lane validity counts, the dealt
     root count, and the flat (R*lanes,) dealt columns ``(dl, dr, dth,
     dmeta)`` the phase-end credit and expand passes need.
+
+    ``offset`` (round 12, double-buffer mode) shifts the effective
+    queue top down by the given number of already-dealt roots, so the
+    rolling half-bank deals consume successive windows off the sorted
+    top — window g covers rows [count - offset - W, count - offset).
+    It may be a traced scalar (the in-loop shadow deal's cursor), as
+    may ``min_active``.
     """
     R = int(refill_slots)
     rows = lanes // 128
     cap_roots = R * lanes
-    top = bag.count
+    top = bag.count - jnp.asarray(offset, jnp.int32)
     navail = jnp.where(top >= min_active,
                        jnp.minimum(top, cap_roots), 0)
 
@@ -1290,7 +1705,8 @@ def _run_walk_kernel_refill(
         seg_iters: int, max_segments: int, min_active_frac: float,
         exit_frac: float, suspend_frac: float, interpret: bool,
         lanes: int, gsegs0, seg_stats0, rule: Rule = Rule.TRAPEZOID,
-        refill_slots: int = 8):
+        refill_slots: int = 8, scout: bool = False,
+        double_buffer: bool = False):
     """One walk phase with IN-KERNEL refill (traced inline inside
     :func:`_run_cycles` and, per chip, inside the demand-driven
     multi-chip engine's cycle body — ``sharded_walker.py``; the
@@ -1312,11 +1728,37 @@ def _run_walk_kernel_refill(
     the dealt-window width so the untouched queue remainder stays a
     reusable prefix) plus :class:`_KernelRefillExtras` for
     :func:`_expand_pending` to re-push untaken dealt roots.
+
+    DOUBLE-BUFFERED ROOT BANKS (round 12, ``double_buffer``): the
+    R-slot bank becomes TWO rolling half-banks of R/2 slots. The phase
+    deals the ACTIVE half (+ the first SHADOW half) at phase open and
+    then, at each segment boundary where every lane has consumed the
+    active half (min per-lane cursor >= R/2; a lane still WALKING the
+    half's last root is fine — its accumulator banks through the
+    kernel's sentinel result row after the shift) and the queue still
+    has roots, SWAPS: the retiring half's result bank is credited (one
+    segment-sum over R/2*lanes rows), the shadow half shifts down, and
+    a fresh shadow half is dealt from the sorted queue top — scheduled
+    by XLA with no data dependency on the in-flight kernel, so on TPU
+    the deal's HBM work overlaps the walk instead of serializing before
+    the phase. One phase now consumes the WHOLE work-sorted queue
+    instead of at most R*lanes roots: the bank-dry drain tail and the
+    per-cycle breed/sort/expand overhead amortize over the full queue,
+    which is where the drain_tail -> eval_active bucket conversion
+    comes from. Swaps only ever retire FULL half-windows (a partial
+    deal means the queue is exhausted, after which the phase drains
+    exactly like the single-deal mode), so retired halves are fully
+    consumed by construction and the untaken-root re-push contract
+    (:func:`_expand_pending`, at most R*lanes rows from the final two
+    halves) is unchanged. Requires an even ``refill_slots`` >= 2; the
+    checkpoint identity carries the flag (both half-banks and the
+    swap parity are intra-phase state, folded back into the bag at the
+    cycle edge like all walker lane state).
     """
     R = int(refill_slots)
     run_segment = make_walk_kernel(f_ds, eps, seg_iters,
                                    interpret=interpret, rule=rule,
-                                   refill_slots=R)
+                                   refill_slots=R, scout=scout)
     rows = lanes // 128
     cap_roots = R * lanes
     min_active = jnp.int32(int(lanes * min_active_frac))
@@ -1328,61 +1770,197 @@ def _run_walk_kernel_refill(
     step_budget = jnp.int32(max_segments * seg_iters)
 
     top = bag.count
-    # shared bank builder (engagement gate included: a queue below the
-    # min_active floor deals nothing and is left for the f64 drain)
-    bank, nslots, navail, (dl, dr, dth, dmeta) = deal_root_bank(
-        bag, refill_slots=R, lanes=lanes, min_active=min_active)
-
     lane0 = _fresh_lanes(lanes)
     slot0 = jnp.zeros((rows, 128), jnp.int32)
     resbank0 = jnp.zeros((R, rows, 128), jnp.float32)
+    resm0 = (jnp.zeros((rows, 128), jnp.float32),
+             jnp.zeros((rows, 128), jnp.float32),
+             jnp.zeros((rows, 128), jnp.int32))
 
-    def takeable_count(s: WalkState, slot):
+    def takeable_count(s: WalkState, slot, nslots):
+        # the ONE takeability rule of the refill-phase loop conditions
+        # (both deal modes): parked, not depth-overflowed, with an
+        # undealt private slot left
         parked = (s.flags & _PARKED) != 0
         ovf = (s.flags & _OVF) != 0
         return jnp.sum(jnp.logical_and(
             jnp.logical_and(parked, jnp.logical_not(ovf)),
             slot < nslots), dtype=jnp.int32)
 
-    def cond(c):
-        s, slot = c[0], c[1]
-        steps = c[4]
-        live = lanes - _idle_lanes(s)
-        return jnp.logical_and(
-            steps < step_budget,
-            jnp.logical_or(live > floor, takeable_count(s, slot) > 0))
+    if double_buffer:
+        validate_double_buffer(double_buffer, R)
+        Rh = R // 2
+        half_roots = Rh * lanes
+        # active half (engagement-gated like the single deal), then the
+        # first shadow half — dealt only behind a FULL active half so
+        # the combined per-lane cursor k -> bank[k] mapping never
+        # crosses an empty active slot
+        bank_a, nsl_a, navail_a, dealt_a = deal_root_bank(
+            bag, refill_slots=Rh, lanes=lanes, min_active=min_active)
+        gate_s = jnp.where(navail_a == half_roots, jnp.int32(1),
+                           jnp.int32(1 << 30))
+        bank_s, nsl_s, navail_s, dealt_s = deal_root_bank(
+            bag, refill_slots=Rh, lanes=lanes, min_active=gate_s,
+            offset=navail_a)
+        bank = tuple(jnp.concatenate([a, b])
+                     for a, b in zip(bank_a, bank_s))
+        nslots0 = nsl_a + nsl_s
+        dealt0 = tuple(jnp.concatenate([a, b])
+                       for a, b in zip(dealt_a, dealt_s))
+        consumed0 = navail_a + navail_s
 
-    def body(c):
-        (s, slot, resh, resl, steps, segs, gsegs, stats, taken,
-         waste) = c
-        cap = jnp.clip(step_budget - steps, 1, seg_iters)
-        s2, slot2, rh, rl, si, w4 = run_segment(s, slot, floor, cap,
-                                                batch, nslots, bank)
-        live_exit = lanes - _idle_lanes(s2)
-        taken2 = jnp.sum(slot2, dtype=jnp.int32)
-        row = jnp.stack([si, live_exit, top - taken,
-                         taken2 - taken]).astype(jnp.int32)
-        stats = lax.dynamic_update_slice(
-            stats, row[None, :],
-            (jnp.minimum(gsegs, S_CAP - 1), jnp.int32(0)))
-        # result-bank entries are written at most once per (slot, lane)
-        # across the whole phase (slot is monotone), so accumulating
-        # per-launch banks by plain addition is exact
-        return (s2, slot2, resh + rh, resl + rl, steps + si, segs + 1,
-                gsegs + 1, stats, taken2,
-                waste + jnp.stack(w4).astype(jnp.int64))
+        def cond(c):
+            s, slot = c[0], c[1]
+            steps = c[4]
+            nslots = c[12]
+            live = lanes - _idle_lanes(s)
+            return jnp.logical_and(
+                steps < step_budget,
+                jnp.logical_or(live > floor,
+                               takeable_count(s, slot, nslots) > 0))
 
-    (s, slot, resh, resl, steps, segs, gsegs, stats, taken, waste) = \
-        lax.while_loop(cond, body, (
-            lane0, slot0, resbank0, resbank0, jnp.int32(0),
+        def do_swap(op):
+            (bankc, nslots, dealt, slot, resh, resl, resm, consumed,
+             retired, acc_sw) = op
+            # credit the retiring half's result bank. Every lane's
+            # cursor is past the active half (>= Rh), so every
+            # active-half root was TAKEN; rows whose walk is still in
+            # flight (a lane at cursor exactly Rh) are zero here and
+            # their value flows through the kernel's sentinel row
+            # (resm) on the lane's next take, or through the lane
+            # accumulator at phase end — never lost, never doubled.
+            ids_a = dealt[3][:half_roots] >> DEPTH_BITS
+            contrib = (resh[:Rh].astype(jnp.float64)
+                       + resl[:Rh].astype(jnp.float64)).reshape(-1)
+            # ... plus any sentinel bankings accumulated since the last
+            # swap (keyed by the lane's pre-take family), then zeroed
+            ids = jnp.concatenate([ids_a, resm[2].reshape(-1)])
+            contrib = jnp.concatenate([
+                contrib,
+                resm[0].astype(jnp.float64).reshape(-1)
+                + resm[1].astype(jnp.float64).reshape(-1)])
+            acc_sw = acc_sw + segment_sum_auto(ids, contrib, m,
+                                               half_roots + lanes)
+            # deal the next shadow window off the sorted queue top
+            bank_n, nsl_n, navail_n, dealt_n = deal_root_bank(
+                bag, refill_slots=Rh, lanes=lanes,
+                min_active=jnp.int32(1), offset=consumed)
+            bankc = tuple(jnp.concatenate([b[Rh:], bn])
+                          for b, bn in zip(bankc, bank_n))
+            # the retiring half was full (swaps require queue
+            # remainder > 0, which implies both dealt halves were
+            # whole windows), so every lane held exactly Rh slots of it
+            nslots = (nslots - Rh) + nsl_n
+            dealt = tuple(jnp.concatenate([d[half_roots:], dn])
+                          for d, dn in zip(dealt, dealt_n))
+            slot = slot - Rh
+            zero_h = jnp.zeros((Rh, rows, 128), jnp.float32)
+            resh = jnp.concatenate([resh[Rh:], zero_h])
+            resl = jnp.concatenate([resl[Rh:], zero_h])
+            return (bankc, nslots, dealt, slot, resh, resl, resm0,
+                    consumed + navail_n, retired + half_roots, acc_sw)
+
+        def body(c):
+            (s, slot, resh, resl, steps, segs, gsegs, stats, taken,
+             waste, evals, bankc, nslots, dealt, consumed, retired,
+             acc_sw, resm) = c
+            cap = jnp.clip(step_budget - steps, 1, seg_iters)
+            s2, slot2, rh, rl, resm, si, w4, e2 = run_segment(
+                s, slot, floor, cap, batch, nslots, bankc, resm)
+            resh = resh + rh
+            resl = resl + rl
+            live_exit = lanes - _idle_lanes(s2)
+            # retired + current cursors is swap-shift invariant, so the
+            # running total is exact across swaps
+            taken2 = retired + jnp.sum(slot2, dtype=jnp.int32)
+            row = jnp.stack([si, live_exit, top - consumed,
+                             taken2 - taken]).astype(jnp.int32)
+            stats = lax.dynamic_update_slice(
+                stats, row[None, :],
+                (jnp.minimum(gsegs, S_CAP - 1), jnp.int32(0)))
+            swap_ready = jnp.logical_and(
+                jnp.min(slot2) >= Rh, (top - consumed) > 0)
+            (bankc, nslots, dealt, slot2, resh, resl, resm, consumed,
+             retired, acc_sw) = lax.cond(
+                 swap_ready, do_swap, lambda op: op,
+                 (bankc, nslots, dealt, slot2, resh, resl, resm,
+                  consumed, retired, acc_sw))
+            return (s2, slot2, resh, resl, steps + si, segs + 1,
+                    gsegs + 1, stats, taken2,
+                    waste + jnp.stack(w4).astype(jnp.int64),
+                    evals + jnp.stack(e2).astype(jnp.int64),
+                    bankc, nslots, dealt, consumed, retired, acc_sw,
+                    resm)
+
+        (s, slot, resh, resl, steps, segs, gsegs, stats, taken, waste,
+         evals, bank, nslots, dealt, consumed, retired, acc_sw,
+         resm) = lax.while_loop(cond, body, (
+                lane0, slot0, resbank0, resbank0, jnp.int32(0),
+                jnp.int32(0), jnp.asarray(gsegs0, jnp.int32),
+                seg_stats0, jnp.int32(0), jnp.zeros(4, jnp.int64),
+                jnp.zeros(2, jnp.int64), bank, nslots0, dealt0,
+                consumed0, jnp.int32(0), jnp.zeros(m, jnp.float64),
+                resm0))
+        dl, dr, dth, dmeta = dealt
+        navail = consumed
+        # fold the last uncredited sentinel bankings in with the
+        # retired-half credits
+        acc0_phase = acc_sw + segment_sum_auto(
+            resm[2].reshape(-1),
+            resm[0].astype(jnp.float64).reshape(-1)
+            + resm[1].astype(jnp.float64).reshape(-1), m, lanes)
+    else:
+        # shared bank builder (engagement gate included: a queue below
+        # the min_active floor deals nothing, left for the f64 drain)
+        bank, nslots, navail, (dl, dr, dth, dmeta) = deal_root_bank(
+            bag, refill_slots=R, lanes=lanes, min_active=min_active)
+
+        def cond(c):
+            s, slot = c[0], c[1]
+            steps = c[5]
+            live = lanes - _idle_lanes(s)
+            return jnp.logical_and(
+                steps < step_budget,
+                jnp.logical_or(live > floor,
+                               takeable_count(s, slot, nslots) > 0))
+
+        def body(c):
+            (s, slot, resh, resl, resm, steps, segs, gsegs, stats,
+             taken, waste, evals) = c
+            cap = jnp.clip(step_budget - steps, 1, seg_iters)
+            s2, slot2, rh, rl, resm, si, w4, e2 = run_segment(
+                s, slot, floor, cap, batch, nslots, bank, resm)
+            live_exit = lanes - _idle_lanes(s2)
+            taken2 = jnp.sum(slot2, dtype=jnp.int32)
+            row = jnp.stack([si, live_exit, top - taken,
+                             taken2 - taken]).astype(jnp.int32)
+            stats = lax.dynamic_update_slice(
+                stats, row[None, :],
+                (jnp.minimum(gsegs, S_CAP - 1), jnp.int32(0)))
+            # result-bank entries are written at most once per
+            # (slot, lane) across the whole phase (slot is monotone),
+            # so accumulating per-launch banks by plain addition is
+            # exact. resm only ever captures each lane's benign first
+            # take (acc = 0): cursors never shift in single-deal mode.
+            return (s2, slot2, resh + rh, resl + rl, resm, steps + si,
+                    segs + 1, gsegs + 1, stats, taken2,
+                    waste + jnp.stack(w4).astype(jnp.int64),
+                    evals + jnp.stack(e2).astype(jnp.int64))
+
+        (s, slot, resh, resl, resm, steps, segs, gsegs, stats, taken,
+         waste, evals) = lax.while_loop(cond, body, (
+            lane0, slot0, resbank0, resbank0, resm0, jnp.int32(0),
             jnp.int32(0), jnp.asarray(gsegs0, jnp.int32), seg_stats0,
-            jnp.int32(0), jnp.zeros(4, jnp.int64)))
+            jnp.int32(0), jnp.zeros(4, jnp.int64),
+            jnp.zeros(2, jnp.int64)))
+        acc0_phase = jnp.zeros(m, jnp.float64)
 
     # Phase-end credit, ONE exact segment-sum: completed-root results
-    # from the bank (ids from the dealt meta grid) + every lane's
-    # in-flight accumulator for its CURRENT root (finished-but-dry,
-    # suspended mid-walk, or depth-overflow lanes alike; never-fed
-    # lanes keep _NO_ROOT and a zero accumulator).
+    # from the (current) bank (ids from the dealt meta grid) + every
+    # lane's in-flight accumulator for its CURRENT root (finished-but-
+    # dry, suspended mid-walk, or depth-overflow lanes alike; never-fed
+    # lanes keep _NO_ROOT and a zero accumulator). Double-buffer mode
+    # adds the per-swap credits of the retired half-banks (acc0_phase).
     has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
     lane_contrib = jnp.where(
         has_root,
@@ -1393,11 +1971,12 @@ def _run_walk_kernel_refill(
                     + resl.astype(jnp.float64)).reshape(-1)
     ids = jnp.concatenate([s.fam.reshape(-1), dmeta >> DEPTH_BITS])
     contrib = jnp.concatenate([lane_contrib, grid_contrib])
-    acc = segment_sum_auto(ids, contrib, m, lanes + cap_roots)
+    acc = acc0_phase + segment_sum_auto(ids, contrib, m,
+                                        lanes + cap_roots)
 
     carry = _WalkCarry(lanes=s, bag=bag, cursor=navail, acc=acc,
                        segs=segs, steps=steps, gsegs=gsegs,
-                       seg_stats=stats, waste=waste)
+                       seg_stats=stats, waste=waste, evals=evals)
     extras = _KernelRefillExtras(slot=slot, nslots=nslots, dealt_l=dl,
                                  dealt_r=dr, dealt_th=dth,
                                  dealt_meta=dmeta, taken=taken)
@@ -1547,7 +2126,9 @@ def _cycle_once(bag: BagState, *, f_theta: Callable, f_ds: Callable,
                 suspend_frac: float, interpret: bool, lanes: int,
                 capacity: int, breed_chunk: int, target: int,
                 rule: Rule, sort_roots: bool, refill_slots: int,
-                sort_skip_ratio: float, gsegs0, seg_stats0) -> _CycleOut:
+                sort_skip_ratio: float, gsegs0, seg_stats0,
+                scout: bool = False,
+                double_buffer: bool = False) -> _CycleOut:
     """ONE engine cycle — breed (graduated f64 BFS) -> work-sort ->
     walk (Pallas, in-kernel refill when ``refill_slots`` > 0) ->
     expand -> gated drain — factored out of :func:`_run_cycles` so the
@@ -1580,10 +2161,12 @@ def _cycle_once(bag: BagState, *, f_theta: Callable, f_ds: Callable,
                min_active_frac=min_active_frac,
                exit_frac=exit_frac, suspend_frac=suspend_frac,
                interpret=interpret, lanes=lanes,
-               gsegs0=gsegs0, seg_stats0=seg_stats0, rule=rule)
+               gsegs0=gsegs0, seg_stats0=seg_stats0, rule=rule,
+               scout=scout)
     if refill_slots:
         walk, kx = _run_walk_kernel_refill(
-            bred, refill_slots=refill_slots, **wkw)
+            bred, refill_slots=refill_slots,
+            double_buffer=double_buffer, **wkw)
         roots_taken = kx.taken.astype(jnp.int64)
     else:
         walk = _run_walk(bred, **wkw)
@@ -1622,6 +2205,8 @@ class _CycleCarry(NamedTuple):
     wsteps: jnp.ndarray     # i64 walker kernel iterations
     srows: jnp.ndarray      # i64 live rows err-scored by the root sort
     waste: jnp.ndarray      # (4,) i64 lane-waste buckets (WASTE_FIELDS)
+    sevals: jnp.ndarray     # i64 scout-pass f32 evals (EVAL_FIELDS[0])
+    cevals: jnp.ndarray     # i64 confirm-pass ds evals (EVAL_FIELDS[1])
     maxd: jnp.ndarray       # i32
     cycles: jnp.ndarray     # i32
     overflow: jnp.ndarray   # bool
@@ -1636,7 +2221,7 @@ class _CycleCarry(NamedTuple):
                      "interpret",
                      "lanes", "capacity", "breed_chunk", "target",
                      "max_cycles", "rule", "sort_roots", "refill_slots",
-                     "sort_skip_ratio"))
+                     "sort_skip_ratio", "scout", "double_buffer"))
 def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 f_ds: Callable,
                 eps: float, m: int, seg_iters: int, max_segments: int,
@@ -1648,7 +2233,9 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 rule: Rule = Rule.TRAPEZOID,
                 sort_roots: bool = True,
                 refill_slots: int = 0,
-                sort_skip_ratio: float = 8.0) -> _CycleCarry:
+                sort_skip_ratio: float = 8.0,
+                scout: bool = False,
+                double_buffer: bool = False) -> _CycleCarry:
     """The full engine as ONE device program:
 
         while bag not empty:
@@ -1686,7 +2273,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             capacity=capacity, breed_chunk=breed_chunk, target=target,
             rule=rule, sort_roots=sort_roots, refill_slots=refill_slots,
             sort_skip_ratio=sort_skip_ratio,
-            gsegs0=c.segs.astype(jnp.int32), seg_stats0=c.seg_stats)
+            gsegs0=c.segs.astype(jnp.int32), seg_stats0=c.seg_stats,
+            scout=scout, double_buffer=double_buffer)
         bred, walk, bag3 = o.bred, o.walk, o.bag3
         roots_taken, srows_d = o.roots_taken, o.srows
 
@@ -1699,7 +2287,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             roots_taken, wt,
             walk.steps.astype(jnp.int64), walk.segs.astype(jnp.int64),
             o.bag2_count.astype(jnp.int64), bag3.tasks, srows_d,
-            bag_tasks + wt, bag_splits + ws]), walk.waste])
+            bag_tasks + wt, bag_splits + ws]), walk.waste,
+            walk.evals])
         cyc_stats = lax.dynamic_update_slice(
             c.cyc_stats, cyc_row[None, :],
             (jnp.minimum(c.cycles, C_CAP - 1), jnp.int32(0)))
@@ -1724,6 +2313,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             wsteps=c.wsteps + walk.steps.astype(jnp.int64),
             srows=c.srows + srows_d,
             waste=c.waste + walk.waste,
+            sevals=c.sevals + walk.evals[0],
+            cevals=c.cevals + walk.evals[1],
             maxd=jnp.maximum(
                 jnp.maximum(c.maxd, jnp.max(walk.lanes.maxd)),
                 jnp.maximum(bred.max_depth, bag3.max_depth)),
@@ -1743,6 +2334,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
         tasks=z64, splits=z64, btasks=z64, wtasks=z64, wsplits=z64,
         roots=z64, rounds=z64, segs=z64, wsteps=z64, srows=z64,
         waste=jnp.zeros(4, jnp.int64),
+        sevals=z64, cevals=z64,
         maxd=jnp.zeros((), jnp.int32), cycles=jnp.zeros((), jnp.int32),
         overflow=jnp.zeros((), bool),
         seg_stats=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)), jnp.int32),
@@ -1765,7 +2357,7 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
 STREAM_STAT_FIELDS = ("tasks", "btasks", "wtasks", "wsplits", "roots",
                       "rounds", "segs", "wsteps", "srows", "maxd",
                       "live_tasks", "live_families", "splits",
-                      "crounds") + WASTE_FIELDS
+                      "crounds") + WASTE_FIELDS + EVAL_FIELDS
 
 
 def family_live_counts_cols(bag_meta: jnp.ndarray, count, m: int
@@ -1811,7 +2403,8 @@ class StreamCycleOut(NamedTuple):
                      "max_segments", "min_active_frac", "exit_frac",
                      "suspend_frac", "interpret", "lanes", "capacity",
                      "breed_chunk", "target", "rule", "sort_roots",
-                     "refill_slots", "sort_skip_ratio", "f64_rounds"))
+                     "refill_slots", "sort_skip_ratio", "f64_rounds",
+                     "scout", "double_buffer"))
 def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
                      f_theta: Callable, f_ds: Callable, eps: float,
                      m: int, seg_iters: int, max_segments: int,
@@ -1821,7 +2414,8 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
                      rule: Rule = Rule.TRAPEZOID,
                      sort_roots: bool = True, refill_slots: int = 0,
                      sort_skip_ratio: float = 8.0,
-                     f64_rounds: int = 0) -> StreamCycleOut:
+                     f64_rounds: int = 0, scout: bool = False,
+                     double_buffer: bool = False) -> StreamCycleOut:
     """ONE phase of the streaming walker: the identical
     breed -> sort -> walk -> expand -> drain cycle of :func:`_run_cycles`
     (via the shared :func:`_cycle_once`), plus the streaming surface —
@@ -1860,6 +2454,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         wt, ws, roots_taken, srows = z64, z64, z64, z64
         segs, wsteps = z64, z64
         waste4 = jnp.zeros(4, jnp.int64)   # no kernel, no lane-cycles
+        evals2 = jnp.zeros(2, jnp.int64)
         bag_tasks = bag3.tasks
         bag_splits = bag3.splits
         rounds = bag3.iters
@@ -1877,7 +2472,8 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
             sort_skip_ratio=sort_skip_ratio,
             gsegs0=jnp.int32(0),
             seg_stats0=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)),
-                                 jnp.int32))
+                                 jnp.int32),
+            scout=scout, double_buffer=double_buffer)
         bred, walk, bag3 = o.bred, o.walk, o.bag3
         # this phase's exact per-family credit, folded into the running
         # compensated accumulator (never reassociated across phases)
@@ -1888,6 +2484,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         segs = walk.segs.astype(jnp.int64)
         wsteps = walk.steps.astype(jnp.int64)
         waste4 = walk.waste
+        evals2 = walk.evals
         bag_tasks = bred.tasks + bag3.tasks
         bag_splits = bred.splits + bag3.splits
         rounds = bred.iters + bag3.iters
@@ -1913,7 +2510,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
         # crounds: the single-chip cycle pays no collectives; the dd
         # stream fills this column host-side from its crounds delta
         jnp.zeros((), jnp.int64),
-    ]), waste4])        # round-11 lane-waste tail columns
+    ]), waste4, evals2])   # round-11 waste + round-12 eval tails
     next_bag = bag3._replace(
         acc=jnp.zeros_like(bag3.acc),
         tasks=jnp.zeros((), jnp.int64),
@@ -2010,6 +2607,16 @@ class WalkerResult:
     #                              to kernel_steps * lanes — on dd runs
     #                              the mesh aggregate of both sides)
     waste_per_chip: Optional[np.ndarray] = None  # dd only: (n_dev, 4)
+    scout_evals: int = 0         # round 12: device-counted f32 scout-
+    #                              pass evals (0 with scouting off)
+    confirm_evals: int = 0       # round 12: device-counted full-ds
+    #                              kernel evals — the confirm pass in
+    #                              scout mode, every live lane-step
+    #                              (the eval_active bucket) otherwise
+    evals_estimated: bool = False  # True only when the run predates
+    #                              the device counters (resumed old
+    #                              snapshot) and the eval numbers fall
+    #                              back to the host-side model
     # (The streaming engine's per-family done-mask / phase-counter
     # surface lives on runtime.stream.StreamResult, fed by this
     # module's run_stream_cycle / family_live_counts hooks.)
@@ -2144,13 +2751,14 @@ def integrate_family_walker(
         #                           showed 512's forced cap boundaries cost ~1%
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
-        exit_frac: float = 0.80,    # r5 sweep: with work-sorted root
-        #                             windows (sort_roots), lanes park
-        #                             together, so a higher exit keeps
-        #                             occupancy ~0.90 without boundary
-        #                             explosion: lane_eff 0.50 -> 0.60,
-        #                             kernel steps -17% vs r4's 0.65
-        suspend_frac: float = 0.5,
+        exit_frac: Optional[float] = None,  # None -> mode-aware default
+        #                             (resolve_cadence): 0.80 from the
+        #                             r5 sweep (work-sorted windows park
+        #                             lanes together), 0.95 in scout
+        #                             mode where refill events are
+        #                             in-kernel and near-free
+        suspend_frac: Optional[float] = None,   # None -> 0.5 / 0.65
+        #                             (scout), see resolve_cadence
         max_cycles: int = 64,
         rule: Rule = Rule.TRAPEZOID,
         sort_roots: bool = True,
@@ -2168,6 +2776,17 @@ def integrate_family_walker(
         #                             error spread is within this ratio
         #                             (~one refinement level); 0
         #                             disables the skip
+        scout_dtype: Optional[str] = None,   # round 12: "f32" enables
+        #                             two-pass precision scouting
+        #                             (f32 scout test + in-step ds
+        #                             confirm; TRAPEZOID only), "f64"
+        #                             disables it; None defers to the
+        #                             PPLS_SCOUT=1 environment lane
+        #                             (resolve_scout_dtype)
+        double_buffer: bool = False,    # round 12: rolling half-bank
+        #                             refill deal (_run_walk_kernel_
+        #                             refill docstring); requires an
+        #                             even refill_slots >= 2
         interpret: Optional[bool] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 1,
@@ -2217,6 +2836,10 @@ def integrate_family_walker(
         raise ValueError(
             f"refill_slots must be in [0, roots_per_lane={roots_per_lane}]"
             f", got {refill_slots}")
+    scout = resolve_scout_dtype(scout_dtype, rule)
+    validate_double_buffer(double_buffer, refill_slots)
+    exit_frac, suspend_frac = resolve_cadence(exit_frac, suspend_frac,
+                                              scout, refill_slots)
     theta = np.asarray(theta, dtype=np.float64)
     m = theta.shape[0]
     bounds = np.asarray(bounds, dtype=np.float64)
@@ -2269,7 +2892,8 @@ def integrate_family_walker(
               target=int(target), rule=Rule(rule),
               sort_roots=bool(sort_roots),
               refill_slots=int(refill_slots),
-              sort_skip_ratio=float(sort_skip_ratio))
+              sort_skip_ratio=float(sort_skip_ratio),
+              scout=bool(scout), double_buffer=bool(double_buffer))
     if checkpoint_path is None:
         out = _run_cycles(state, max_cycles=int(max_cycles), **kw)
         d = WalkerDispatch(out=out, t0=t0, lanes=int(lanes),
@@ -2284,9 +2908,22 @@ def integrate_family_walker(
         identity = _family_ckpt_identity(engine_name("walker", rule),
                                          f_theta, float(eps),
                                          m, theta, bounds)
+        # round 12: the scout/double-buffer/reduced-twin schedules
+        # differ from the plain refill schedule (different split
+        # decisions inside the guard band / different phase structure /
+        # different ds evaluations), so a snapshot from one mode must
+        # not silently resume in another. Conditional keys keep
+        # pre-round-12 snapshots loadable by default-mode runs.
+        if scout:
+            identity["scout"] = True
+        if double_buffer:
+            identity["double_buffer"] = True
+        if _is_reduced_twin(f_ds):
+            identity["reduced"] = True
         tot = dict(tasks=0, splits=0, btasks=0, wtasks=0, wsplits=0,
                    roots=0, rounds=0, segs=0, wsteps=0, srows=0,
-                   max_depth=0, cycles=0, waste=[0, 0, 0, 0])
+                   max_depth=0, cycles=0, waste=[0, 0, 0, 0],
+                   sevals=0, cevals=0)
         if _totals_override is not None:
             # the accumulator re-enters the DEVICE addition chain via
             # acc0, so legging/resuming reassociates nothing
@@ -2304,12 +2941,14 @@ def integrate_family_walker(
                               max_cycles=int(checkpoint_every), **kw)
             (l_tasks, l_splits, l_bt, l_wt, l_ws, l_roots,
              l_rounds, l_segs, l_wst, l_srows, l_maxd, l_cycles, l_ovf,
-             left, l_seg_stats, l_cyc_stats, l_waste) = jax.device_get(
+             left, l_seg_stats, l_cyc_stats, l_waste, l_se,
+             l_ce) = jax.device_get(
                  (out.tasks, out.splits, out.btasks, out.wtasks,
                   out.wsplits, out.roots, out.rounds, out.segs,
                   out.wsteps, out.srows, out.maxd,
                   out.cycles, out.overflow, out.bag.count,
-                  out.seg_stats, out.cyc_stats, out.waste))
+                  out.seg_stats, out.cyc_stats, out.waste,
+                  out.sevals, out.cevals))
             leg_seg_stats.append(
                 np.asarray(l_seg_stats)[:min(int(l_segs), S_CAP)])
             leg_cyc_stats.append(
@@ -2320,7 +2959,8 @@ def integrate_family_walker(
                          ("wsplits", l_ws), ("roots", l_roots),
                          ("rounds", l_rounds), ("segs", l_segs),
                          ("wsteps", l_wst), ("srows", l_srows),
-                         ("cycles", l_cycles)):
+                         ("cycles", l_cycles), ("sevals", l_se),
+                         ("cevals", l_ce)):
                 tot[k] += int(v)
             tot["waste"] = [a + int(b) for a, b
                             in zip(tot["waste"], l_waste)]
@@ -2389,39 +3029,41 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
     segs = int(tot["segs"])
     roots = int(tot["roots"])
     srows = int(tot.get("srows", 0))
+    waste_arr = np.asarray(tot.get("waste", [0, 0, 0, 0]),
+                           dtype=np.int64)
+    sevals = int(tot.get("sevals", 0))
+    cevals = int(tot.get("cevals", 0))
+    # Round 12: the walker's integrand-eval count is DEVICE-COUNTED —
+    # scout + confirm counters in scout mode; otherwise the eval_active
+    # waste bucket (each live lane-step evaluates exactly one real
+    # point, so the bucket IS the eval count). A resumed pre-round-11
+    # snapshot's share arrives as the est_kevals host-model estimate
+    # (computed at resume time) and flags the result estimated — the
+    # shared derivation, one definition for both engines.
+    kernel_evals, evals_estimated = derive_kernel_evals(
+        sevals, cevals, int(waste_arr[0]), wtasks,
+        int(tot["wsplits"]), roots, Rule(rule),
+        est_kevals=int(tot.get("est_kevals", 0)))
     metrics = RunMetrics(
         tasks=tasks,
         splits=int(tot["splits"]),
         leaves=tasks - int(tot["splits"]),
         rounds=int(tot["rounds"]) + segs,
         max_depth=int(tot["max_depth"]),
-        # Trapezoid: 1 eval per TEST step (= wtasks), 1 per ADVANCE
-        # reload — one per accepted leaf EXCEPT each root's final leaf
-        # (= leaves - roots) — and 2 root endpoints (INIT + LOAD kernel
-        # steps) per consumed root: 2*wtasks - wsplits + roots total;
-        # the f64 bag phases evaluate 3 per task. Simpson: 2 test evals
-        # per node (q1, q3), 2 reloads (fm, fr) per advance, 3 per root
-        # (INIT, LOADM, LOAD): 4*wtasks - 2*wsplits + roots; bag phases
-        # evaluate 5 per task. Suspended roots never reach their final
-        # leaf, so both overstate by at most one eval per lane suspended
-        # at phase end (~1e-4 relative).
-        # + the root-ordering pass: `srows` is the DEVICE-COUNTED number
-        # of live window rows err-scored by _order_roots_by_work across
-        # all cycles (3 f64 evals each, 5 for Simpson) — exact, unlike
-        # the old per-consumed-root proxy, which undercounted re-scored
-        # unconsumed remainders and overcounted never-scored roots
-        # whenever the window missed part of the queue (ADVICE r5 #4).
+        # Round 12: the kernel share is DEVICE-COUNTED (`kernel_evals`
+        # above — scout+confirm counters, or the eval_active bucket).
+        # The f64 bag phases evaluate exactly 3 points per task (5 for
+        # Simpson) by construction, and the root-ordering pass scores
+        # `srows` device-counted live rows at the same per-row cost —
+        # both exact, so the total is a counted number, not a model
+        # (ISSUE 8 satellite: integrand_evals_estimated drops).
         # Dead/padding window rows are still excluded, matching the
         # engine-wide convention (bag chunks and walker lanes also
         # evaluate padding without counting it).
         integrand_evals=(
-            3 * int(tot["btasks"])
-            + 2 * wtasks - int(tot["wsplits"]) + roots
-            + 3 * srows
+            3 * int(tot["btasks"]) + kernel_evals + 3 * srows
             if Rule(rule) == Rule.TRAPEZOID else
-            5 * int(tot["btasks"])
-            + 4 * wtasks - 2 * int(tot["wsplits"]) + roots
-            + 5 * srows),
+            5 * int(tot["btasks"]) + kernel_evals + 5 * srows),
         wall_time_s=wall,
         n_chips=1,
         tasks_per_chip=[tasks],
@@ -2444,7 +3086,7 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         metrics.per_round = round_stats_from_rows(
             cyc_stats, CYCLE_STAT_FIELDS, padded_width=int(lanes))
     denom = int(tot["wsteps"]) * lanes
-    waste = np.asarray(tot.get("waste", [0, 0, 0, 0]), dtype=np.int64)
+    waste = waste_arr
     res = WalkerResult(
         areas=acc,
         metrics=metrics,
@@ -2457,6 +3099,9 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         kernel_steps=int(tot["wsteps"]),
         refill_slots=int(refill_slots),
         waste=waste,
+        scout_evals=sevals,
+        confirm_evals=cevals if sevals else int(waste_arr[0]),
+        evals_estimated=evals_estimated,
     )
     # run-completion telemetry boundary (host values already in hand —
     # no extra device fetch; the registry is the process default, so
@@ -2478,11 +3123,12 @@ def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
     out = d.out
     (acc, tasks, splits, btasks, wtasks, wsplits, roots, rounds, segs,
      wsteps, srows, maxd, cycles, overflow, left, seg_stats_np,
-     cyc_stats_np, waste_np) = jax.device_get(
+     cyc_stats_np, waste_np, sevals, cevals) = jax.device_get(
          (out.acc, out.tasks, out.splits, out.btasks, out.wtasks,
           out.wsplits, out.roots, out.rounds, out.segs, out.wsteps,
           out.srows, out.maxd, out.cycles, out.overflow, out.bag.count,
-          out.seg_stats, out.cyc_stats, out.waste))
+          out.seg_stats, out.cyc_stats, out.waste, out.sevals,
+          out.cevals))
     seg_stats_np = np.asarray(seg_stats_np)[:min(int(segs), S_CAP)]
     cyc_stats_np = np.asarray(cyc_stats_np)[:min(int(cycles), C_CAP)]
     return _assemble_result(
@@ -2490,7 +3136,8 @@ def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
         dict(tasks=tasks, splits=splits, btasks=btasks, wtasks=wtasks,
              wsplits=wsplits, roots=roots, rounds=rounds, segs=segs,
              wsteps=wsteps, srows=srows, max_depth=maxd, cycles=cycles,
-             waste=[int(v) for v in np.asarray(waste_np)]),
+             waste=[int(v) for v in np.asarray(waste_np)],
+             sevals=int(sevals), cevals=int(cevals)),
         left=left, overflow=overflow,
         wall=time.perf_counter() - d.t0, lanes=d.lanes, rule=d.rule,
         refill_slots=d.refill_slots,
@@ -2527,13 +3174,15 @@ def resume_family_walker(
         #                           showed 512's forced cap boundaries cost ~1%
         max_segments: int = 1 << 18,
         min_active_frac: float = 0.1,
-        exit_frac: float = 0.80,   # r5: see integrate_family_walker
-        suspend_frac: float = 0.5,
+        exit_frac: Optional[float] = None,   # see resolve_cadence
+        suspend_frac: Optional[float] = None,
         max_cycles: int = 64,
         rule: Rule = Rule.TRAPEZOID,
         sort_roots: bool = True,
         refill_slots: int = 0,
         sort_skip_ratio: float = 8.0,
+        scout_dtype: Optional[str] = None,
+        double_buffer: bool = False,
         interpret: Optional[bool] = None,
         checkpoint_every: int = 1) -> WalkerResult:
     """Continue an interrupted checkpointed walker run from its last
@@ -2551,6 +3200,13 @@ def resume_family_walker(
     from ppls_tpu.runtime.checkpoint import engine_name
     identity = _family_ckpt_identity(engine_name("walker", rule), f_theta,
                                      float(eps), m, theta_np, bounds_np)
+    # mode keys mirror integrate_family_walker's snapshot identity
+    if resolve_scout_dtype(scout_dtype, rule):
+        identity["scout"] = True
+    if double_buffer:
+        identity["double_buffer"] = True
+    if _is_reduced_twin(f_ds):
+        identity["reduced"] = True
     bag_cols, count, acc, totals = load_family_checkpoint(path, identity)
 
     # same store sizing as integrate_family_walker
@@ -2571,6 +3227,16 @@ def resume_family_walker(
     # ... and pre-round-11 snapshots lack the lane-waste buckets: zeros
     # keep the attribution honest-empty instead of failing the resume
     totals.setdefault("waste", [0, 0, 0, 0])
+    # pre-round-12 snapshots lack the device eval counters: zeros make
+    # _assemble_result fall back to the flagged host-side estimate
+    totals.setdefault("sevals", 0)
+    totals.setdefault("cevals", 0)
+    # pre-round-11 snapshots banked NO counters at all, but the resumed
+    # run's new legs WILL count — estimate the pre-resume kernel share
+    # now (while it is separable) so the final number is the flagged
+    # sum instead of a silent undercount
+    totals.setdefault(
+        "est_kevals", estimate_legacy_kernel_evals(totals, Rule(rule)))
     totals["acc"] = acc
     return integrate_family_walker(
         f_theta, f_ds, theta, bounds, eps, chunk=chunk, capacity=capacity,
@@ -2579,6 +3245,7 @@ def resume_family_walker(
         exit_frac=exit_frac, suspend_frac=suspend_frac,
         max_cycles=max_cycles, rule=rule, sort_roots=sort_roots,
         refill_slots=refill_slots, sort_skip_ratio=sort_skip_ratio,
+        scout_dtype=scout_dtype, double_buffer=double_buffer,
         interpret=interpret,
         checkpoint_path=path, checkpoint_every=checkpoint_every,
         _state_override=state, _totals_override=totals)

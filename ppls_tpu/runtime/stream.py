@@ -290,11 +290,14 @@ class StreamEngine:
                  seg_iters: int = 2048,
                  max_segments: int = 1 << 18,
                  min_active_frac: float = 0.1,
-                 exit_frac: float = 0.80,
-                 suspend_frac: float = 0.5,
+                 exit_frac: Optional[float] = None,
+                 suspend_frac: Optional[float] = None,
                  sort_roots: bool = True,
                  sort_skip_ratio: float = 8.0,
                  f64_rounds: int = 0,
+                 scout_dtype: Optional[str] = None,
+                 double_buffer: bool = False,
+                 reduced_integrands: bool = False,
                  admit_window: Optional[int] = None,
                  interpret: Optional[bool] = None,
                  engine: str = "walker",
@@ -314,9 +317,31 @@ class StreamEngine:
                 f"{roots_per_lane}], got {refill_slots}")
         if engine not in ("walker", "walker-dd"):
             raise ValueError(f"unknown stream engine {engine!r}")
+        # round 12: scout/double-buffer are per-engine compile statics,
+        # like eps/rule — one engine per mode (compile-once holds)
+        from ppls_tpu.parallel.walker import resolve_scout_dtype
+        if scout_dtype == "f32" and f64_rounds:
+            # an EXPLICIT flag conflict is an error (same policy as
+            # explicit-f32-with-Simpson); only the None/env default is
+            # silently off in pure-f64 streaming mode
+            raise ValueError(
+                "scout_dtype='f32' is meaningless with f64_rounds > 0 "
+                "(the pure-f64 streaming mode runs no Pallas kernel)")
+        scout = resolve_scout_dtype(
+            scout_dtype, Rule(rule)) and not f64_rounds
+        from ppls_tpu.parallel.walker import validate_double_buffer
+        validate_double_buffer(double_buffer, refill_slots)
+        self._scout = bool(scout)
+        self._double_buffer = bool(double_buffer)
+        from ppls_tpu.parallel.walker import resolve_cadence
+        exit_frac, suspend_frac = resolve_cadence(
+            exit_frac, suspend_frac, self._scout, refill_slots)
         self.family = family
         self.f_theta = get_family(family)
-        self.f_ds = get_family_ds(family)
+        self.f_ds = get_family_ds(family,
+                                  reduced=bool(reduced_integrands))
+        self._reduced = bool(reduced_integrands) \
+            and self.f_ds is not get_family_ds(family)
         self.eps = float(eps)
         self.rule = Rule(rule)
         self.slots = int(slots)
@@ -343,7 +368,8 @@ class StreamEngine:
             sort_roots=bool(sort_roots),
             refill_slots=int(refill_slots),
             sort_skip_ratio=float(sort_skip_ratio),
-            f64_rounds=int(f64_rounds))
+            f64_rounds=int(f64_rounds),
+            scout=self._scout, double_buffer=self._double_buffer)
         # admit window: fixed seed-array width (one compiled admit
         # program); capped by the store slack so the push never clamps
         aw = slots if admit_window is None else int(admit_window)
@@ -426,10 +452,19 @@ class StreamEngine:
 
     def _identity(self) -> dict:
         n_dev = self._mesh.devices.size if self._mesh is not None else 1
-        return _stream_identity(
+        ident = _stream_identity(
             f"{self.engine}-stream", self.family, self.eps, self.rule,
             self.slots, self.lanes, self._chunk, self._capacity,
             self._roots_per_lane, self._refill_slots, n_dev)
+        # round 12: mode flags are identity (conditional keys keep
+        # pre-round-12 snapshots loadable by default-mode engines)
+        if self._scout:
+            ident["scout"] = True
+        if self._double_buffer:
+            ident["double_buffer"] = True
+        if self._reduced:
+            ident["reduced"] = True
+        return ident
 
     # ------------------------------------------------------------------
     # request intake
@@ -511,7 +546,9 @@ class StreamEngine:
             ck["min_active_frac"], ck["exit_frac"], ck["suspend_frac"],
             int(target_local), self.interpret, 1, fill_x, fill_th,
             self.rule, ck["sort_roots"], ck["sort_skip_ratio"],
-            self._refill_slots, int(reshard_window), admit_window=aw)
+            self._refill_slots, int(reshard_window), admit_window=aw,
+            scout=self._scout, double_buffer=self._double_buffer,
+            reduced=self._reduced)
         self._dd_store = store
         self._dd_n_dev = n_dev
         z64 = jnp.zeros(n_dev, jnp.int64)
@@ -524,11 +561,13 @@ class StreamEngine:
             jnp.zeros((n_dev, self.slots), jnp.float64))
         self._dd_counters = tuple(z64 for _ in range(11)) + (
             jnp.zeros((n_dev, 4), jnp.int64),
+            jnp.zeros((n_dev, 2), jnp.int64),
             jnp.zeros(n_dev, jnp.int32),
             jnp.zeros(n_dev, jnp.int32),
             jnp.zeros(n_dev, dtype=bool))
         self._dd_prev = np.zeros(11, dtype=np.int64)
         self._dd_prev_waste = np.zeros(4, dtype=np.int64)
+        self._dd_prev_evals = np.zeros(2, dtype=np.int64)
         self._dd_prev_acc = np.zeros(self.slots)
         self._dd_fam_last = np.full(self.slots, -1, np.int32)
         self._dd_rr = 0
@@ -696,15 +735,17 @@ class StreamEngine:
         self._dd_admit = None
         out = self._dd_run(*self._dd_state, *self._dd_counters, *adm)
         state = out[:4] + (out[4], out[5])
-        fam_live_c = out[21]
-        (count_c, acc_c2, ctr_h, waste_h, maxd_c, ovf_c, fam_live) = \
-            jax.device_get((out[4], out[5], out[6:17], out[17],
-                            out[18], out[20], fam_live_c))
+        fam_live_c = out[22]
+        (count_c, acc_c2, ctr_h, waste_h, evals_h, maxd_c, ovf_c,
+         fam_live) = jax.device_get(
+            (out[4], out[5], out[6:17], out[17], out[18],
+             out[19], out[21], fam_live_c))
         self._dd_state = state
         # cycles counter resets each phase call (max_cycles=1): pass
         # zeros back in, like the leg loop does between legs
         self._dd_counters = out[6:17] + (
-            out[17], out[18], jnp.zeros(n_dev, jnp.int32), out[20])
+            out[17], out[18], out[19], jnp.zeros(n_dev, jnp.int32),
+            out[21])
         chip = {k: np.asarray(v, dtype=np.int64)
                 for k, v in zip(
                     ("tasks", "splits", "btasks", "wtasks", "wsplits",
@@ -721,6 +762,9 @@ class StreamEngine:
         waste_tot = chip["waste"].sum(axis=0)
         waste_delta = waste_tot - self._dd_prev_waste
         self._dd_prev_waste = waste_tot
+        evals_tot = np.asarray(evals_h, dtype=np.int64).sum(axis=0)
+        evals_delta = evals_tot - self._dd_prev_evals
+        self._dd_prev_evals = evals_tot
         # per-chip flight-recorder deltas (round 11): same fetch, host
         # subtraction — step() hands these to ChipFlightRecorder while
         # the phase span is still open
@@ -757,7 +801,9 @@ class StreamEngine:
             delta[6], delta[7], delta[8], delta[9],
             int(np.max(np.asarray(maxd_c))),
             count, int(np.sum(fam_live_tot > 0)),
-            delta[1], delta[10]], dtype=np.int64), waste_delta])
+            delta[1], delta[10]], dtype=np.int64), waste_delta,
+            evals_delta])
+
         return (fam_live_tot, acc, np.zeros_like(acc),
                 self._dd_fam_last, count, bool(np.any(np.asarray(ovf_c))),
                 stats)
@@ -1057,14 +1103,16 @@ class StreamEngine:
         acc_h = np.asarray(jax.device_get(acc))     # (n_dev, slots)
         ctr_h = jax.device_get(self._dd_counters)
         extra = {"dd": {
-            # 11 cumulative CTR64 counters + waste/maxd/ovf (the
+            # 11 cumulative CTR64 counters + waste/evals/maxd/ovf (the
             # zeroed cycles slot is rebuilt fresh on resume)
             "ctr": [np.asarray(c).tolist() for c in ctr_h[:11]],
             "waste": np.asarray(ctr_h[11]).tolist(),
-            "maxd": np.asarray(ctr_h[12]).tolist(),
-            "ovf": np.asarray(ctr_h[14]).tolist(),
+            "evals": np.asarray(ctr_h[12]).tolist(),
+            "maxd": np.asarray(ctr_h[13]).tolist(),
+            "ovf": np.asarray(ctr_h[15]).tolist(),
             "prev": self._dd_prev.tolist(),
             "prev_waste": self._dd_prev_waste.tolist(),
+            "prev_evals": self._dd_prev_evals.tolist(),
             "prev_acc": self._dd_prev_acc.tolist(),
             "prev_chip": {k: v.tolist()
                           for k, v in self._dd_prev_chip.items()},
@@ -1093,8 +1141,21 @@ class StreamEngine:
         eng._next_rid = int(totals["next_rid"])
         eng._fam_first = np.asarray(totals["fam_first"],
                                     dtype=np.int32)
-        eng._phase_rows = [np.asarray(r, dtype=np.int64)
-                           for r in totals["phase_rows"]]
+
+        def _pad_row(r):
+            # phase rows from snapshots that predate appended tail
+            # columns (round 11's waste, round 12's eval split) pad
+            # with zeros: STREAM_STAT_FIELDS only ever grows at the
+            # tail, so positional replay stays correct and the
+            # registry/result paths see uniform row widths
+            row = np.asarray(r, dtype=np.int64)
+            want = len(STREAM_STAT_FIELDS)
+            if row.shape[0] < want:
+                row = np.concatenate(
+                    [row, np.zeros(want - row.shape[0], np.int64)])
+            return row
+
+        eng._phase_rows = [_pad_row(r) for r in totals["phase_rows"]]
         eng._pending = [StreamRequest(
             rid=d["rid"], theta=d["theta"],
             bounds=tuple(d["bounds"]),
@@ -1184,12 +1245,17 @@ class StreamEngine:
             for v in dd["ctr"]) + (
             jnp.asarray(np.asarray(dd["waste"], dtype=np.int64)
                         .reshape(n_dev, 4)),
+            jnp.asarray(np.asarray(dd.get(
+                "evals", np.zeros((n_dev, 2))), dtype=np.int64)
+                .reshape(n_dev, 2)),
             jnp.asarray(np.asarray(dd["maxd"], dtype=np.int32)),
             jnp.zeros(n_dev, jnp.int32),
             jnp.asarray(np.asarray(dd["ovf"], dtype=bool)))
         self._dd_prev = np.asarray(dd["prev"], dtype=np.int64)
         self._dd_prev_waste = np.asarray(dd["prev_waste"],
                                          dtype=np.int64)
+        self._dd_prev_evals = np.asarray(
+            dd.get("prev_evals", np.zeros(2)), dtype=np.int64)
         self._dd_prev_acc = np.asarray(dd["prev_acc"],
                                        dtype=np.float64)
         self._dd_prev_chip = {

@@ -137,6 +137,40 @@ def test_gl02_ds_limb_modules_exempt_from_f32(tmp_path):
     assert syms == ["seed:dtype-less-asarray", "seed:dtype-less-zeros"]
 
 
+def test_gl02_scout_surface_declared_module_carved_out(tmp_path):
+    # round 12: ops/scout_kernel.py is on the DECLARED scout-dtype
+    # surface — the float32 check is carved out there, but the
+    # dtype-less-creation check still applies (a declaration is not a
+    # blanket exemption)
+    pkg = _mkpkg(tmp_path, {"ops/scout_kernel.py": GL02_BROKEN})
+    syms = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL02")
+    assert syms == ["seed:dtype-less-asarray", "seed:dtype-less-zeros"]
+
+
+def test_gl02_f32_outside_declared_scout_surface_still_fails(tmp_path):
+    # an UNDECLARED scout-flavored module gets no carve-out: the
+    # surface is a reviewed allowlist (module + symbol), so deliberate
+    # f32 added anywhere else must either join the declaration (a
+    # code-reviewed diff of GL02_SCOUT_SURFACE) or fail the lint —
+    # the baseline shrinks or holds, it never silently grows
+    pkg = _mkpkg(tmp_path, {"ops/scout_helpers.py": GL02_BROKEN,
+                            "parallel/scout_pass.py": GL02_BROKEN})
+    syms = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL02")
+    assert syms.count("downcast:float32") == 2, syms
+
+
+def test_gl02_scout_surface_entries_carry_reasons():
+    # every declared (module, symbol) pair must state WHY f32 is
+    # deliberate there — an empty reason is an undocumented exemption
+    from tools.graftlint.rules import GL02_SCOUT_SURFACE
+    assert GL02_SCOUT_SURFACE, "the scout surface declaration is gone"
+    for module, symbols in GL02_SCOUT_SURFACE.items():
+        assert symbols, f"{module}: empty symbol list"
+        for sym, reason in symbols.items():
+            assert isinstance(reason, str) and len(reason) > 20, \
+                f"{module}:{sym} lacks a substantive reason"
+
+
 # ---------------------------------------------------------------------------
 # GL03 — host sync reachable from a jitted root
 # ---------------------------------------------------------------------------

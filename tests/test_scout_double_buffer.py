@@ -1,0 +1,397 @@
+"""Round 12: mixed-precision scouting + double-buffered root banks.
+
+Contracts pinned here:
+
+* SCOUT AREA CONTRACT — scout mode's decisions are ds-confirmed except
+  decisive splits (which only over-refine), so per-family areas stay
+  within the documented ~1e-9 schedule contract of the non-scout refill
+  run, while every rerun of the SAME mode is bit-identical.
+* DEVICE-COUNTED EVAL SPLIT — scout_evals/confirm_evals are populated
+  in scout mode, zero otherwise, and the non-scout confirm count
+  equals the eval_active waste bucket (each live lane-step is exactly
+  one real eval).
+* GUARD BAND — a wide guard forces (nearly) every decision through the
+  ds confirm pass: confirm volume responds to the band, i.e. the
+  fallback path is real, not decorative.
+* RECONCILIATION — the four lane-waste buckets still sum to
+  lanes x kernel steps in scout and double-buffer modes, on walker,
+  dd (virtual 8-mesh), and stream engines.
+* DOUBLE-BUFFER ROLLING DEAL — one phase consumes more of the
+  work-sorted queue than the single-deal R*lanes window (the swap path
+  actually fires), with area parity.
+* CHECKPOINT IDENTITY (ISSUE 8 satellite) — kill-and-resume stays
+  bit-identical in scout + double-buffer modes on walker, dd, and
+  stream, a snapshot written in one mode refuses to resume in another,
+  and the mode flags ride the snapshot identity.
+"""
+
+import numpy as np
+import pytest
+
+from ppls_tpu.models.integrands import get_family, get_family_ds
+from ppls_tpu.parallel.walker import (WASTE_FIELDS,
+                                      integrate_family_walker,
+                                      resume_family_walker)
+
+F = get_family("sin_recip_scaled")
+F_DS = get_family_ds("sin_recip_scaled")
+THETA = 1.0 + np.arange(8) / 8.0
+BOUNDS = (1e-2, 1.0)
+EPS = 1e-7
+KW = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
+          refill_slots=2, seg_iters=32, min_active_frac=0.05)
+
+
+def _run(**over):
+    kw = dict(KW)
+    kw.update(over)
+    return integrate_family_walker(F, F_DS, THETA, BOUNDS, EPS, **kw)
+
+
+# ---------------------------------------------------------------------------
+# scout mode
+# ---------------------------------------------------------------------------
+
+
+def test_scout_area_contract_and_counters():
+    # explicit "f64": the baseline must stay non-scout even under the
+    # PPLS_SCOUT=1 ci lane
+    base = _run(scout_dtype="f64")
+    sc = _run(scout_dtype="f32")
+    # schedule contract: decisions are ds-confirmed (accepts) or
+    # over-refining (decisive splits); areas track the plain refill run
+    assert np.max(np.abs(sc.areas - base.areas)) < 3e-9
+    # device-counted eval split: scout mode populates both counters
+    assert sc.scout_evals > 0
+    assert sc.confirm_evals > 0
+    # confirm pass fires on a strict subset of scout tests (decisive
+    # splits skip ds entirely — that is the whole saving)
+    assert sc.confirm_evals < 3 * sc.scout_evals
+    # non-scout: zero scout evals, and the confirm count IS the
+    # eval_active bucket (one real eval per live lane-step)
+    assert base.scout_evals == 0
+    assert base.confirm_evals == int(base.waste[0])
+    assert not base.evals_estimated and not sc.evals_estimated
+
+
+def test_scout_raises_lane_efficiency():
+    # the fused-load scout step makes every live lane-step a test:
+    # tasks/lane-steps climbs past the non-scout trapezoid structural
+    # cap (~2/3) toward the occupancy ceiling
+    base = _run(scout_dtype="f64")
+    sc = _run(scout_dtype="f32")
+    assert sc.lane_efficiency > base.lane_efficiency * 1.3, \
+        (base.lane_efficiency, sc.lane_efficiency)
+    assert sc.lane_efficiency > 2.0 / 3.0
+
+
+def test_scout_rerun_bit_identical_and_reconciles():
+    r1 = _run(scout_dtype="f32")
+    r2 = _run(scout_dtype="f32")
+    assert np.array_equal(r1.areas, r2.areas)
+    a = r1.attribution()
+    assert a["reconciles"], a
+    assert sum(a["buckets"].values()) == r1.kernel_steps * r1.lanes
+
+
+def test_scout_guard_band_fallback_is_real(monkeypatch):
+    # widen the guard band 10000x: almost nothing is decisively split
+    # any more, so (nearly) every test must fall back to the ds
+    # confirm pass — the confirm share responds to the band
+    import ppls_tpu.parallel.walker as W
+    narrow = _run(scout_dtype="f32")
+    monkeypatch.setattr(W, "_SCOUT_BAND",
+                        np.float32(W.SCOUT_GUARD_ULPS * 2.0 ** -23
+                                   * 1e4))
+    W.scout_twin.cache_clear()
+    wide = _run(scout_dtype="f32", capacity=1 << 15)  # fresh compile key
+    ratio_n = narrow.confirm_evals / max(narrow.scout_evals, 1)
+    ratio_w = wide.confirm_evals / max(wide.scout_evals, 1)
+    assert ratio_w > ratio_n, (ratio_n, ratio_w)
+    # and the wide-band run still lands on the same areas (everything
+    # ds-confirmed is the baseline decision procedure)
+    assert np.max(np.abs(wide.areas - narrow.areas)) < 3e-9
+
+
+def test_scout_rejects_simpson():
+    from ppls_tpu.config import Rule
+    with pytest.raises(ValueError, match="TRAPEZOID"):
+        _run(scout_dtype="f32", rule=Rule.SIMPSON)
+
+
+def test_scout_env_lane(monkeypatch):
+    # PPLS_SCOUT=1 force-enables scouting on default-mode runs — the
+    # ci.sh f32-rot lane's mechanism
+    explicit = _run(scout_dtype="f32")
+    monkeypatch.setenv("PPLS_SCOUT", "1")
+    env = _run()
+    assert env.scout_evals > 0
+    assert np.array_equal(env.areas, explicit.areas)
+
+
+def test_flagship_proxy_lane_efficiency_target():
+    # ISSUE 8 acceptance: interpret-mode flagship proxy (the
+    # analyze_occupancy --attribution workload) reaches
+    # lane_efficiency >= 0.85 with scout + double-buffer + the
+    # mode-aware cadence, reconciliation intact
+    m = 64
+    theta = 1.0 + np.arange(m) / m
+    r = integrate_family_walker(
+        F, F_DS, theta, (1e-3, 1.0), 1e-8,
+        capacity=1 << 18, lanes=256, roots_per_lane=8, refill_slots=8,
+        seg_iters=256, min_active_frac=0.05,
+        scout_dtype="f32", double_buffer=True)
+    a = r.attribution()
+    assert a["reconciles"]
+    assert r.lane_efficiency >= 0.85, (r.lane_efficiency, a)
+
+
+# ---------------------------------------------------------------------------
+# double-buffered root banks
+# ---------------------------------------------------------------------------
+
+DEEP_KW = dict(capacity=1 << 17, lanes=256, roots_per_lane=8,
+               refill_slots=2, seg_iters=64, min_active_frac=0.05)
+
+
+def test_double_buffer_rolls_past_single_deal_window():
+    # a workload whose bred queue exceeds R*lanes: the rolling deal
+    # must consume MORE roots per cycle than the single-deal window
+    # (i.e. the swap path fires), with area parity
+    from ppls_tpu.parallel.walker import CYCLE_STAT_FIELDS
+    kw = dict(DEEP_KW)
+    base = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-8, **kw)
+    db = integrate_family_walker(F, F_DS, THETA, BOUNDS, 1e-8,
+                                 double_buffer=True, **kw)
+    ic = CYCLE_STAT_FIELDS.index("roots_consumed")
+    per_cycle_base = np.asarray(base.cycle_stats)[:, ic]
+    per_cycle_db = np.asarray(db.cycle_stats)[:, ic]
+    assert per_cycle_db.max() > per_cycle_base.max(), \
+        (per_cycle_base.tolist(), per_cycle_db.tolist())
+    assert np.max(np.abs(db.areas - base.areas)) < 3e-9
+    assert db.attribution()["reconciles"]
+    # root conservation: every bred root is walked or re-bred, never
+    # lost across swaps (task totals agree up to split-decision drift)
+    drift = abs(db.metrics.tasks - base.metrics.tasks) \
+        / base.metrics.tasks
+    assert drift < 1e-3, (db.metrics.tasks, base.metrics.tasks)
+
+
+def test_double_buffer_rerun_bit_identical():
+    r1 = _run(double_buffer=True)
+    r2 = _run(double_buffer=True)
+    assert np.array_equal(r1.areas, r2.areas)
+    assert r1.metrics.tasks == r2.metrics.tasks
+
+
+def test_double_buffer_requires_even_refill():
+    with pytest.raises(ValueError, match="even refill_slots"):
+        _run(double_buffer=True, refill_slots=1, roots_per_lane=1)
+    with pytest.raises(ValueError, match="even refill_slots"):
+        _run(double_buffer=True, refill_slots=0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint identity (satellite: kill-and-resume in the new modes)
+# ---------------------------------------------------------------------------
+
+CKPT_KW = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
+               refill_slots=2, seg_iters=8, max_segments=1,
+               max_cycles=256, min_active_frac=0.05)
+
+
+@pytest.mark.parametrize("mode", [
+    dict(scout_dtype="f32"),
+    dict(double_buffer=True),
+    dict(scout_dtype="f32", double_buffer=True),
+])
+def test_walker_kill_and_resume_bit_identical_in_new_modes(tmp_path,
+                                                           mode):
+    kw = dict(CKPT_KW, **mode)
+    base = integrate_family_walker(F, F_DS, THETA, BOUNDS, EPS, **kw)
+    path = str(tmp_path / "w.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker(F, F_DS, THETA, BOUNDS, EPS, **kw,
+                                checkpoint_path=path,
+                                checkpoint_every=2, _crash_after_legs=2)
+    res = resume_family_walker(path, F, F_DS, THETA, BOUNDS, EPS,
+                               **kw, checkpoint_every=2)
+    assert np.array_equal(res.areas, base.areas)          # bit-for-bit
+    assert res.metrics.tasks == base.metrics.tasks
+    assert res.scout_evals == base.scout_evals
+    assert res.confirm_evals == base.confirm_evals
+    assert np.array_equal(np.asarray(res.waste),
+                          np.asarray(base.waste))
+
+
+def test_walker_mode_flags_are_snapshot_identity(tmp_path):
+    # a scout-mode snapshot must refuse to resume as a default-mode run
+    # (and vice versa): the schedules differ inside the guard band
+    path = str(tmp_path / "w.ckpt")
+    kw = dict(CKPT_KW, scout_dtype="f32")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker(F, F_DS, THETA, BOUNDS, EPS, **kw,
+                                checkpoint_path=path,
+                                checkpoint_every=2, _crash_after_legs=1)
+    with pytest.raises(ValueError, match="different run"):
+        resume_family_walker(path, F, F_DS, THETA, BOUNDS, EPS,
+                             scout_dtype="f64", **CKPT_KW,
+                             checkpoint_every=2)
+
+
+def test_dd_kill_and_resume_bit_identical_scout_db(tmp_path):
+    # the virtual 8-mesh dd engine, scout + double-buffer on
+    from ppls_tpu.parallel.sharded_walker import (
+        integrate_family_walker_dd, resume_family_walker_dd)
+    # max_segments=1 + a small seg_iters bounds each walk phase's step
+    # budget, forcing several cycles so there are real leg boundaries
+    # to crash at (the rolling deal otherwise finishes this workload
+    # in fewer cycles than the crash leg)
+    kw = dict(chunk=1 << 8, capacity=1 << 16, lanes=256,
+              roots_per_lane=2, seg_iters=8, max_segments=1,
+              max_cycles=256, min_active_frac=0.05,
+              n_devices=8, refill_slots=2, scout_dtype="f32",
+              double_buffer=True)
+    theta = [1.0, 1.5]
+    dd_bounds = (1e-3, 1.0)   # deep enough for >= 3 cycles at this
+    #                           step budget on the 8-chip mesh
+    base = integrate_family_walker_dd("sin_recip_scaled", theta,
+                                      dd_bounds, 1e-9, **kw)
+    assert base.scout_evals > 0
+    assert base.attribution()["reconciles"]
+    path = str(tmp_path / "dd.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker_dd("sin_recip_scaled", theta, dd_bounds,
+                                   1e-9, checkpoint_path=path,
+                                   checkpoint_every=1,
+                                   _crash_after_legs=2, **kw)
+    res = resume_family_walker_dd(path, "sin_recip_scaled", theta,
+                                  dd_bounds, 1e-9, checkpoint_every=1,
+                                  **kw)
+    assert np.array_equal(res.areas, base.areas)          # bit-for-bit
+    assert res.metrics.tasks == base.metrics.tasks
+    assert res.scout_evals == base.scout_evals
+    # mode flags are dd identity too
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker_dd("sin_recip_scaled", theta, dd_bounds,
+                                   1e-9, checkpoint_path=path,
+                                   checkpoint_every=1,
+                                   _crash_after_legs=1, **kw)
+    plain = dict(kw)
+    plain.pop("scout_dtype")
+    plain.pop("double_buffer")
+    with pytest.raises(ValueError, match="different run"):
+        resume_family_walker_dd(path, "sin_recip_scaled", theta,
+                                BOUNDS, 1e-9, **plain)
+
+
+def test_stream_kill_and_resume_bit_identical_scout_db(tmp_path):
+    # mid-stream kill + resume with scouting and the rolling deal on:
+    # the continued stream replays bit-identically (satellite: the
+    # shadow half-bank is intra-phase state, folded back into the bag
+    # at every phase edge, so phase-boundary snapshots stay complete)
+    from ppls_tpu.runtime.stream import StreamEngine
+    skw = dict(slots=8, chunk=1 << 10, capacity=1 << 16, lanes=256,
+               roots_per_lane=2, refill_slots=2, seg_iters=32,
+               min_active_frac=0.05, scout_dtype="f32",
+               double_buffer=True)
+    reqs = [(float(t), BOUNDS) for t in THETA[:6]]
+    arr = [0, 0, 1, 2, 3, 5]
+    base = StreamEngine("sin_recip_scaled", EPS, **skw).run(
+        reqs, arrival_phase=arr)
+    assert int(base.totals["scout_evals"]) > 0
+    path = str(tmp_path / "stream.ckpt")
+    eng = StreamEngine("sin_recip_scaled", EPS, checkpoint_path=path,
+                       checkpoint_every=1, **skw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(reqs, arrival_phase=arr, _crash_after_phases=3)
+    eng2 = StreamEngine.resume(path, "sin_recip_scaled", EPS,
+                               checkpoint_every=1, **skw)
+    k = eng2.next_rid
+    while not eng2.idle or k < len(reqs):
+        while k < len(reqs) and arr[k] <= eng2.phase:
+            eng2.submit(*reqs[k])
+            k += 1
+        eng2.step()
+    res = eng2.result()
+    assert np.array_equal(res.areas, base.areas)          # bit-for-bit
+    assert res.phases == base.phases
+    assert res.totals == base.totals
+    # stream identity carries the mode flags: a default-mode engine
+    # must not resume this snapshot
+    eng3 = StreamEngine("sin_recip_scaled", EPS, checkpoint_path=path,
+                        checkpoint_every=1, **skw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng3.run(reqs, arrival_phase=arr, _crash_after_phases=2)
+    plain = {k: v for k, v in skw.items()
+             if k not in ("scout_dtype", "double_buffer")}
+    with pytest.raises(ValueError, match="different run"):
+        StreamEngine.resume(path, "sin_recip_scaled", EPS,
+                            checkpoint_every=1, **plain)
+
+
+def test_stream_resume_pads_pre_round12_phase_rows(tmp_path):
+    # back-compat: a snapshot whose phase rows predate the round-12
+    # tail columns (18-wide) must still resume — the replay pads the
+    # missing eval columns with zeros instead of KeyError-ing the
+    # registry (STREAM_STAT_FIELDS only ever grows at the tail)
+    import json
+
+    from ppls_tpu.parallel.walker import STREAM_STAT_FIELDS
+    from ppls_tpu.runtime.checkpoint import (load_family_checkpoint,
+                                             save_family_checkpoint)
+    from ppls_tpu.runtime.stream import StreamEngine
+    skw = dict(slots=8, chunk=1 << 10, capacity=1 << 16, lanes=256,
+               roots_per_lane=2, refill_slots=2, seg_iters=32,
+               min_active_frac=0.05)
+    reqs = [(float(t), BOUNDS) for t in THETA[:4]]
+    path = str(tmp_path / "s.ckpt")
+    eng = StreamEngine("sin_recip_scaled", EPS, checkpoint_path=path,
+                       checkpoint_every=1, **skw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(reqs, _crash_after_phases=2)
+    # rewrite the snapshot with TRUNCATED (pre-round-12-width) rows
+    bag_cols, count, acc, totals = load_family_checkpoint(
+        path, eng._identity())
+    totals = json.loads(json.dumps(totals))
+    totals["phase_rows"] = [list(r)[:len(STREAM_STAT_FIELDS) - 2]
+                            for r in totals["phase_rows"]]
+    save_family_checkpoint(path, identity=eng._identity(),
+                           bag_cols=bag_cols, count=count, acc=acc,
+                           totals=totals)
+    eng2 = StreamEngine.resume(path, "sin_recip_scaled", EPS,
+                               checkpoint_every=1, **skw)
+    while not eng2.idle:
+        eng2.step()
+    res = eng2.result()
+    assert len(res.completed) == len(reqs)
+    # padded rows stack uniformly and the registry totals resolve
+    assert res.phase_stats.shape[1] == len(STREAM_STAT_FIELDS)
+    assert int(res.totals["tasks"]) > 0
+
+
+def test_stream_rejects_explicit_scout_with_f64_rounds():
+    from ppls_tpu.runtime.stream import StreamEngine
+    with pytest.raises(ValueError, match="f64_rounds"):
+        StreamEngine("sin_recip_scaled", EPS, slots=4, lanes=256,
+                     refill_slots=2, f64_rounds=2, scout_dtype="f32")
+
+
+def test_stream_scout_phase_rows_reconcile():
+    # per-phase reconciliation with the new tail columns: buckets sum
+    # to lanes x wsteps for every phase row, and the eval columns are
+    # device-counted
+    from ppls_tpu.parallel.walker import STREAM_STAT_FIELDS
+    from ppls_tpu.runtime.stream import StreamEngine
+    skw = dict(slots=8, chunk=1 << 10, capacity=1 << 16, lanes=256,
+               roots_per_lane=2, refill_slots=2, seg_iters=32,
+               min_active_frac=0.05, scout_dtype="f32")
+    reqs = [(float(t), BOUNDS) for t in THETA[:4]]
+    res = StreamEngine("sin_recip_scaled", EPS, **skw).run(reqs)
+    iw = [STREAM_STAT_FIELDS.index(k) for k in WASTE_FIELDS]
+    isteps = STREAM_STAT_FIELDS.index("wsteps")
+    for row in np.asarray(res.phase_stats):
+        assert sum(int(row[i]) for i in iw) \
+            == int(row[isteps]) * skw["lanes"], row
+    assert int(res.totals["scout_evals"]) > 0
+    assert int(res.totals["confirm_evals"]) > 0

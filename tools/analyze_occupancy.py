@@ -269,40 +269,62 @@ def main_dd():
 
 
 def main_attribution():
-    """Round-11 tentpole decomposition (``--attribution``): run the
-    walker in BOTH refill modes and print where every kernel
-    lane-cycle went — the four device-counted waste buckets, the
-    reconciliation invariant, and the dominant bucket by name. Sized
-    for the flagship configuration on a TPU backend and for interpret
-    mode elsewhere (the buckets are device-counted either way)."""
+    """Round-11/12 tentpole decomposition (``--attribution``): run the
+    walker across the engine modes — legacy boundary, in-kernel refill,
+    and the round-12 scout + double-buffer flagship mode — and print
+    the BEFORE/AFTER bucket decomposition: where every kernel
+    lane-cycle went (four device-counted waste buckets), the
+    reconciliation invariant, the dominant bucket by name, and the
+    scout/confirm eval split. Sized for the flagship configuration on
+    a TPU backend and for the interpret-mode flagship proxy elsewhere
+    (the buckets are device-counted either way; the >=0.85 interpret
+    lane-efficiency acceptance reads off the scout+db row)."""
     from ppls_tpu.parallel.walker import WASTE_FIELDS
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         m, eps, bounds = M, EPS, BOUNDS
         kw = dict(capacity=1 << 23)
-        modes = ((8, "in-kernel refill (flagship R=8)"),
-                 (0, "legacy XLA-boundary"))
+        modes = (
+            (dict(refill_slots=0), "legacy XLA-boundary"),
+            (dict(refill_slots=8), "in-kernel refill (R=8)"),
+            (dict(refill_slots=8, scout_dtype="f32",
+                  double_buffer=True),
+             "scout + double-buffer (flagship round 12)"),
+        )
         lanes = DEFAULT_LANES
     else:
-        m, eps, bounds = 64, 1e-7, (1e-2, 1.0)
-        kw = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
-                  seg_iters=32, min_active_frac=0.05)
-        modes = ((2, "in-kernel refill (quick R=2)"),
-                 (0, "legacy XLA-boundary"))
+        # the interpret-mode FLAGSHIP PROXY: deep enough that the
+        # drain tail amortizes like the real workload's
+        m, eps, bounds = 64, 1e-8, (1e-3, 1.0)
+        kw = dict(capacity=1 << 18, lanes=256, roots_per_lane=8,
+                  seg_iters=256, min_active_frac=0.05)
+        modes = (
+            (dict(refill_slots=0), "legacy XLA-boundary"),
+            (dict(refill_slots=8), "in-kernel refill (R=8)"),
+            (dict(refill_slots=8, scout_dtype="f32",
+                  double_buffer=True),
+             "scout + double-buffer (flagship round 12)"),
+        )
         lanes = 256
     theta = 1.0 + np.arange(m) / m
     f_theta = get_family("sin_recip_scaled")
     f_ds = get_family_ds("sin_recip_scaled")
-    for refill, label in modes:
+    for mode_kw, label in modes:
         sec(f"attribution: {label}")
         r = integrate_family_walker(f_theta, f_ds, theta, bounds, eps,
-                                    refill_slots=refill, **kw)
+                                    **mode_kw, **kw)
         a = r.attribution()
         print_attribution(a["buckets"], r.kernel_steps, lanes)
+        cap = ("~1 fused scout test/step" if r.scout_evals
+               else "structural max ~2/3 trapezoid")
         print(f"  lane_efficiency={r.lane_efficiency:.4f} "
-              f"(tasks/lane-cycles; structural max ~2/3 trapezoid), "
-              f"cycles={r.cycles}")
+              f"(tasks/lane-cycles; {cap}), cycles={r.cycles}")
+        if r.scout_evals:
+            print(f"  eval split: scout_evals={r.scout_evals} (f32), "
+                  f"confirm_evals={r.confirm_evals} (full ds) — "
+                  f"{r.confirm_evals / max(r.scout_evals + r.confirm_evals, 1):.1%}"
+                  f" of kernel evals pay ds cost")
         assert a["reconciles"], "device-counted buckets failed to " \
             "reconcile — the accounting plumbing is broken"
         cs = r.cycle_stats

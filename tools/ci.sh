@@ -2,6 +2,10 @@
 # One-command CI for this repo (toolchain-less CPU container):
 #
 #   1. tier-1 forced-CPU test suite (the ROADMAP gate, verbatim)
+#   1b. the same tier-1 suite with PPLS_SCOUT=1 — every trapezoid
+#       walker run is forced through the round-12 f32 scouting kernel
+#       (mirroring the PPLS_DEBUG_NANS opt-in lane), so the scout path
+#       cannot rot between TPU-attached rounds
 #   2. `pip install -e .` smoke + `ppls-tpu --help` console script
 #   3. artifact schema check (BENCH_r*/MULTICHIP_r* round JSONs)
 #   4. graftlint static analysis (GL01-GL06 vs the committed baseline)
@@ -35,6 +39,27 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)"
 if [ "$rc" -ne 0 ]; then
     echo "ci: tier-1 suite FAILED (rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+fi
+
+# --- 1b. tier-1 again with scouting FORCED ON (PPLS_SCOUT=1) ---
+# The f32 scout kernel only runs when a caller opts in; without this
+# lane a regression in the scout step would sit invisible until the
+# next TPU round. PPLS_SCOUT=1 flips every default-mode trapezoid
+# walker run (walker.resolve_scout_dtype) into scout mode, so the
+# whole suite — golden parity, checkpoint identity, streaming
+# determinism — re-proves itself on the f32 path.
+step "tier-1 suite under PPLS_SCOUT=1 (scout f32 lane)"
+rm -f /tmp/_t1_scout.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu PPLS_SCOUT=1 \
+    python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1_scout.log
+rc=${PIPESTATUS[0]}
+echo "SCOUT_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_t1_scout.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "ci: PPLS_SCOUT=1 lane FAILED (rc=$rc)"
     FAILURES=$((FAILURES + 1))
 fi
 

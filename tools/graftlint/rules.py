@@ -266,6 +266,39 @@ _GL02_DTYPE_POSITION = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
 # kernels are f32 by construction, not by accident.
 _GL02_F32_EXEMPT = re.compile(r"ops/(ds_kernel|pow2|ds)\.py$")
 
+# Round-12 DECLARED SCOUT-DTYPE SURFACE: the mixed-precision scouting
+# pass is DELIBERATELY f32 — but only where declared. Each entry names
+# a module (path suffix), the symbols (function qualnames, or "*" for
+# the whole module) allowed to reference f32, and the REVIEWED reason.
+# This is a declaration, not a baseline: f32 outside the listed
+# (module, symbol) pairs still fails GL02, and additions here are a
+# code-reviewed API change, never a silent baseline growth
+# (tests/test_graftlint.py pins both directions).
+GL02_SCOUT_SURFACE = {
+    "ops/scout_kernel.py": {
+        "*": "the declared f32 scout surface itself: a single-precision "
+             "ds-API twin evaluated ONLY by the walker's scout pass — "
+             "f32 is the module's entire purpose, and every scout "
+             "decision it feeds is either decisively-split (guard band) "
+             "or re-taken in full ds by the confirm pass.",
+    },
+}
+
+
+def _scout_surface_entry(path: str, qn: str):
+    """The declared scout-surface reason covering (module, symbol), or
+    None when the pair is not declared."""
+    for suffix, symbols in GL02_SCOUT_SURFACE.items():
+        if path.endswith(suffix):
+            if "*" in symbols:
+                return symbols["*"]
+            if qn in symbols:
+                return symbols[qn]
+            # bare function name of a ClassName.method qualname
+            if qn.split(".")[-1] in symbols:
+                return symbols[qn.split(".")[-1]]
+    return None
+
 
 def _is_literal_payload(node: ast.AST) -> bool:
     if isinstance(node, ast.Constant):
@@ -288,7 +321,13 @@ def rule_gl02(modules: List[LintModule]) -> Iterator[Violation]:
     are f32 *by representation*; everywhere else f32 in a numeric path
     is a downcast hazard).  Literal arithmetic (``0.5 * x``) is NOT
     flagged: under weak typing literals adopt the array operand's
-    dtype, so the hazard is creation, not arithmetic."""
+    dtype, so the hazard is creation, not arithmetic.
+
+    Round 12: the DECLARED scout-dtype surface (``GL02_SCOUT_SURFACE``
+    — module + symbol list, per-entry reviewed reason) carves out the
+    mixed-precision scouting pass from the float32 check only; the
+    dtype-less-creation check still applies inside it, and f32 outside
+    the declared pairs still fails."""
     for mod in modules:
         if "/parallel/" not in "/" + mod.path \
                 and "/ops/" not in "/" + mod.path:
@@ -332,7 +371,8 @@ def rule_gl02(modules: List[LintModule]) -> Iterator[Violation]:
                                     f"to the x64-flag dtype — make "
                                     f"the f64 (or integer) intent "
                                     f"explicit."))
-                if not _GL02_F32_EXEMPT.search(mod.path):
+                if not _GL02_F32_EXEMPT.search(mod.path) \
+                        and _scout_surface_entry(mod.path, qn) is None:
                     is_f32 = (
                         (isinstance(n, ast.Attribute)
                          and n.attr == "float32")
