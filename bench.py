@@ -1259,6 +1259,71 @@ def bench_quick() -> dict:
     }
 
 
+def bench_theta(quick: bool = None) -> dict:
+    """Round-13 many-theta amortization leg (``python bench.py theta
+    [--quick]``): one walker frontier scores a batch of T per-user
+    thetas per interval (``theta_block``), and every unit of interval
+    bookkeeping — kernel steps, phase boundaries, bank deals, breed
+    rounds — amortizes over the block.
+
+    The leg is OWNED by tools/bench_history.run_theta_proxies (the
+    same function feeds the committed gate reference and the CI
+    --gate-run measurement): a T=1 solo sweep fixes the per-theta
+    bookkeeping baseline, then theta-blocked runs at T in {32, 256}
+    (--quick) or {32, 256, 2048} measure bookkeeping-per-theta, the
+    reduction multiple, thetas*tasks/s/chip, the theta_overwalk waste
+    share, and the per-theta quality bound (batched error <= solo
+    error + eps; see BASELINE.md round 13). Off-TPU the rates measure
+    the interpreter — the device-counted proxies are the signal."""
+    import jax
+
+    from tools.bench_history import (GATE_THETA_MIN_REDUCTION,
+                                     THETA_FULL_T, THETA_QUICK_T,
+                                     run_theta_proxies)
+
+    interp = jax.default_backend() != "tpu"
+    if quick is None:
+        quick = interp
+    ts = THETA_QUICK_T if quick else THETA_FULL_T
+    rec = run_theta_proxies(ts=ts)
+    t256 = rec["theta"].get("256", {})
+    return {
+        "metric": "many-theta amortized walker: bookkeeping-per-theta "
+                  "reduction at T=256",
+        "value": float(t256.get("reduction_vs_t1", 0.0)),
+        "unit": "x vs T=1 sweep (device-counted steps+boundaries)",
+        # acceptance floor: >= 4x reduction at T=256 at identical
+        # per-theta eps (ISSUE 9); the gate holds it between rounds
+        "vs_baseline": float(GATE_THETA_MIN_REDUCTION),
+        "interpret_mode_quick": bool(quick),
+        "interpret_mode": interp,
+        "t1_bookkeeping_per_theta": rec["t1_bookkeeping_per_theta"],
+        "solo_max_abs_err": rec["solo_max_abs_err"],
+        "family": rec["family"], "eps": rec["eps"],
+        "bounds": rec["bounds"], "lanes": rec["lanes"],
+        "theta": rec["theta"],
+    }
+
+
+def main_theta():
+    """Standalone mode (``python bench.py theta [--quick]``)."""
+    from ppls_tpu.utils.artifact_schema import validate_record
+    quick = True if "--quick" in sys.argv else None
+    try:
+        rec = bench_theta(quick=quick)
+    except Exception as e:  # noqa: BLE001 — one JSON line always
+        print(json.dumps(validate_record(
+            {"metric": "many-theta amortized walker: "
+                       "bookkeeping-per-theta reduction at T=256",
+             "value": 0.0,
+             "unit": "x vs T=1 sweep (device-counted "
+                     "steps+boundaries)",
+             "vs_baseline": 0.0, "error": str(e)})))
+        return 1
+    print(json.dumps(validate_record(rec)))
+    return 0
+
+
 def main_stream():
     """Standalone mode (``python bench.py stream [--quick]``)."""
     from ppls_tpu.utils.artifact_schema import validate_record
@@ -1340,6 +1405,8 @@ if __name__ == "__main__":
         sys.exit(main_dd())
     if len(sys.argv) > 1 and sys.argv[1] == "stream":
         sys.exit(main_stream())
+    if len(sys.argv) > 1 and sys.argv[1] == "theta":
+        sys.exit(main_theta())
     if len(sys.argv) > 1 and sys.argv[1] in ("quick", "--quick"):
         sys.exit(main_quick())
     sys.exit(main())

@@ -14,6 +14,29 @@ import json
 import sys
 
 
+def theta_batch_arg(s: str):
+    """Shared ``--theta`` argparse type (family + serve): a scalar
+    ("1.5"), a comma-separated list ("1,1.5,2"), or ``@file.json``
+    holding a number, a flat list, or a list of per-slot lists (the
+    (m, T) theta-block batch form). Returns a float, a list of floats,
+    or a list of lists of floats."""
+    s = s.strip()
+    if s.startswith("@"):
+        with open(s[1:], encoding="utf-8") as fh:
+            v = json.load(fh)
+        if isinstance(v, (int, float)):
+            return float(v)
+        if isinstance(v, list):
+            if v and all(isinstance(r, list) for r in v):
+                return [[float(x) for x in r] for r in v]
+            return [float(x) for x in v]
+        raise argparse.ArgumentTypeError(
+            f"{s}: JSON must be a number, a list, or a list of lists")
+    if "," in s:
+        return [float(x) for x in s.split(",") if x.strip() != ""]
+    return float(s)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m ppls_tpu",
@@ -67,6 +90,20 @@ def build_parser() -> argparse.ArgumentParser:
     fam.add_argument("--m", type=int, default=64, help="family size")
     fam.add_argument("--theta0", type=float, default=1.0)
     fam.add_argument("--theta1", type=float, default=2.0)
+    fam.add_argument("--theta", type=theta_batch_arg, default=None,
+                     help="explicit theta batch instead of the "
+                          "theta0..theta1 linspace: a scalar, a "
+                          "comma-separated list, or @file.json (a "
+                          "flat list, or a list of per-slot lists "
+                          "for --theta-block runs)")
+    fam.add_argument("--theta-block", type=int, default=1,
+                     dest="theta_block",
+                     help="walker engines: T > 1 vectorizes theta — "
+                          "one union-refinement frontier scores T "
+                          "per-user thetas per interval (theta "
+                          "becomes (m, T); requires --refill-slots "
+                          "> 0, trapezoid rule, T a power of two "
+                          "dividing the lane count)")
     fam.add_argument("-a", type=float, default=1e-4)
     fam.add_argument("-b", type=float, default=1.0)
     fam.add_argument("--eps", type=float, default=1e-8)
@@ -211,6 +248,19 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--seed", type=int, default=0)
     srv.add_argument("--theta0", type=float, default=1.0)
     srv.add_argument("--theta1", type=float, default=2.0)
+    srv.add_argument("--theta", type=theta_batch_arg, default=None,
+                     help="synthetic-mode theta source: scalar, "
+                          "comma-separated list, or @file.json "
+                          "(replaces the theta0..theta1 linspace; "
+                          "with --theta-block the list is chunked "
+                          "into per-request blocks of up to T)")
+    srv.add_argument("--theta-block", type=int, default=1,
+                     dest="theta_block",
+                     help="per-engine compile static: T > 1 makes "
+                          "each request a THETA BATCH of up to T "
+                          "per-user thetas over one shared frontier "
+                          "(JSONL requests may then pass a theta "
+                          "list); retirement emits per-theta areas")
     srv.add_argument("-a", type=float, default=1e-3)
     srv.add_argument("-b", type=float, default=1.0)
     srv.add_argument("--checkpoint", default=None,
@@ -272,7 +322,34 @@ def _main_family(args) -> int:
     from ppls_tpu.models.integrands import (family_exact, get_family,
                                             get_family_ds)
 
-    theta = np.linspace(args.theta0, args.theta1, args.m, endpoint=False)
+    T = int(getattr(args, "theta_block", 1))
+    if args.theta is not None:
+        tv = args.theta
+        if isinstance(tv, float):
+            tv = [tv]
+        theta = np.asarray(tv, dtype=np.float64)
+    else:
+        theta = np.linspace(args.theta0, args.theta1, args.m,
+                            endpoint=False)
+    if T > 1:
+        if theta.ndim == 1:
+            if theta.size % T == 0 and theta.size > T:
+                theta = theta.reshape(-1, T)    # m = size/T slots
+            else:
+                theta = theta.reshape(1, -1)    # one slot
+        if theta.shape[1] < T:
+            # short blocks pad by replicating the row head (padded
+            # thetas vote/credit identically; dropped from output)
+            theta = np.concatenate(
+                [theta, np.repeat(theta[:, :1],
+                                  T - theta.shape[1], axis=1)], axis=1)
+        if args.engine not in ("walker", "sharded-walker-dd",
+                               "sharded-walker"):
+            raise SystemExit(
+                "--theta-block > 1 requires the walker or "
+                "sharded-walker-dd engine")
+    elif theta.ndim != 1:
+        theta = theta.reshape(-1)
     bounds = (args.a, args.b)
     f = get_family(args.family)
     kw = dict(chunk=args.chunk, capacity=args.capacity)
@@ -305,7 +382,8 @@ def _main_family(args) -> int:
                    rule=Rule(args.rule),
                    refill_slots=args.refill_slots,
                    scout_dtype=args.scout_dtype,
-                   double_buffer=args.double_buffer)
+                   double_buffer=args.double_buffer,
+                   theta_block=T)
 
         def engine_call():
             if args.checkpoint and os.path.exists(args.checkpoint):
@@ -326,7 +404,8 @@ def _main_family(args) -> int:
                    refill_slots=args.refill_slots,
                    scout_dtype=args.scout_dtype,
                    double_buffer=args.double_buffer,
-                   reduced_integrands=args.reduced_integrands)
+                   reduced_integrands=args.reduced_integrands,
+                   theta_block=T)
 
         def engine_call():
             if args.checkpoint and os.path.exists(args.checkpoint):
@@ -374,12 +453,18 @@ def _main_family(args) -> int:
 
     m = res.metrics
     exact = family_exact(args.family, args.a, args.b, theta)
-    abs_err = (float(np.max(np.abs(res.areas - np.asarray(exact))))
+    abs_err = (float(np.max(np.abs(np.asarray(res.areas)
+                                   - np.asarray(exact))))
                if exact is not None else None)
+    areas_flat = np.asarray(res.areas).reshape(-1)
     if args.as_json:
         print(json.dumps({
-            "engine": args.engine, "m": args.m, "eps": args.eps,
-            "areas_head": [float(v) for v in res.areas[:4]],
+            "engine": args.engine, "m": int(np.asarray(theta).shape[0]
+                                            if np.asarray(theta).ndim
+                                            else args.m),
+            "eps": args.eps,
+            "theta_block": T,
+            "areas_head": [float(v) for v in areas_flat[:4]],
             "abs_error": abs_err,
             "tasks": m.tasks, "splits": m.splits, "rounds": m.rounds,
             "max_depth": m.max_depth, "wall_time_s": m.wall_time_s,
@@ -389,9 +474,12 @@ def _main_family(args) -> int:
             "walker_fraction": getattr(res, "walker_fraction", None),
         }))
     else:
-        print(f"{args.m} x {args.family} on [{args.a}, {args.b}] "
-              f"@ eps={args.eps} ({args.engine})")
-        print(f"areas[:4] = {[round(float(v), 9) for v in res.areas[:4]]}")
+        n_int = int(np.asarray(theta).size)
+        print(f"{n_int} x {args.family} on [{args.a}, {args.b}] "
+              f"@ eps={args.eps} ({args.engine}"
+              + (f", theta_block={T}" if T > 1 else "") + ")")
+        print(f"areas[:4] = "
+              f"{[round(float(v), 9) for v in areas_flat[:4]]}")
         if abs_err is not None:
             print(f"max abs error vs exact: {abs_err:.3e}")
         print(m.histogram_str())
@@ -423,7 +511,10 @@ def _main_serve(args) -> int:
                 if not line:
                     continue
                 d = json.loads(line)
-                reqs.append((float(d["theta"]),
+                th = d["theta"]
+                th = (tuple(float(x) for x in th)
+                      if isinstance(th, list) else float(th))
+                reqs.append((th,
                              (float(d["bounds"][0]),
                               float(d["bounds"][1]))))
                 arrivals.append(int(d.get("arrival_phase", 0)))
@@ -434,12 +525,28 @@ def _main_serve(args) -> int:
         # deterministic Poisson-ish open-loop load: exponential
         # interarrivals at --arrival-rate requests/phase, seeded
         rng = np.random.default_rng(args.seed)
+        T = int(getattr(args, "theta_block", 1))
         k = int(args.synthetic)
-        thetas = np.linspace(args.theta0, args.theta1, k,
-                             endpoint=False)
+        if args.theta is not None:
+            tv = args.theta
+            if isinstance(tv, float):
+                tv = [tv]
+            if tv and isinstance(tv[0], list):
+                blocks = [tuple(float(x) for x in r) for r in tv]
+            else:
+                flat = [float(x) for x in tv]
+                step = max(T, 1)
+                blocks = [tuple(flat[i:i + step])
+                          for i in range(0, len(flat), step)]
+            k = len(blocks)
+        else:
+            thetas = np.linspace(args.theta0, args.theta1, k * max(T, 1),
+                                 endpoint=False)
+            blocks = [tuple(thetas[i * T:(i + 1) * T]) for i in range(k)]
         gaps = rng.exponential(1.0 / max(args.arrival_rate, 1e-9), k)
         arrivals = np.floor(np.cumsum(gaps) - gaps[0]).astype(int)
-        reqs = [(float(t), (args.a, args.b)) for t in thetas]
+        reqs = [((b if T > 1 else float(b[0])), (args.a, args.b))
+                for b in blocks]
         arrivals = [int(p) for p in arrivals]
 
     # the serve loop admits in list order gated on arrival_phase — an
@@ -456,6 +563,7 @@ def _main_serve(args) -> int:
               scout_dtype=args.scout_dtype,
               double_buffer=args.double_buffer,
               reduced_integrands=args.reduced_integrands,
+              theta_block=int(getattr(args, "theta_block", 1)),
               engine=args.engine, n_devices=args.n_devices,
               checkpoint_every=args.checkpoint_every)
     if args.lanes:
@@ -527,7 +635,12 @@ def _main_serve(args) -> int:
                 k += 1
             for c in eng.step():
                 print(json.dumps({
-                    "rid": c.rid, "theta": c.theta,
+                    "rid": c.rid,
+                    "theta": (list(c.theta)
+                              if isinstance(c.theta, (tuple, list))
+                              else c.theta),
+                    **({"areas": c.areas} if c.areas is not None
+                       else {}),
                     "bounds": list(c.bounds), "area": c.area,
                     "admit_phase": c.admit_phase,
                     "retire_phase": c.retire_phase,

@@ -187,20 +187,49 @@ register_family("cosh4_scaled", _cosh4_scaled)
 
 FAMILY_EXACT: Dict[str, Callable] = {}
 
+# Round 13: VECTORIZED exact forms — exact_vec(a, b, theta_array) ->
+# f64 ndarray, pure numpy. The mpmath scalar forms stay the ground
+# truth (40-digit, used by the equivalence tests); the vectorized forms
+# exist so host-side verification of 2048-theta batches is one ufunc
+# sweep instead of a per-theta mpmath hot loop. Each registered pair is
+# equivalence-tested to ~1 f64 ulp (tests/test_theta_walker.py).
+FAMILY_EXACT_VEC: Dict[str, Callable] = {}
 
-def register_family_exact(name: str, fn: Callable) -> Callable:
-    """Register exact(a, b, theta) -> float for a parameterized family."""
+
+def register_family_exact(name: str, fn: Callable,
+                          vec: Optional[Callable] = None) -> Callable:
+    """Register exact(a, b, theta) -> float for a parameterized family,
+    plus an optional vectorized numpy twin exact_vec(a, b, theta[])."""
     FAMILY_EXACT[name] = fn
+    if vec is not None:
+        FAMILY_EXACT_VEC[name] = vec
     return fn
 
 
-def family_exact(name: str, a: float, b: float, theta) -> Optional["object"]:
-    """Exact integrals for every theta as a float list, or None if the
-    family has no registered closed form."""
+def family_exact(name: str, a: float, b: float, theta,
+                 prefer_vec: Optional[bool] = None):
+    """Exact integrals for every theta as an f64 numpy array, or None
+    if the family has no registered closed form.
+
+    ``theta`` may be any shape; the result matches it. Large batches
+    (>= 64 thetas, or ``prefer_vec=True``) go through the registered
+    VECTORIZED numpy form when one exists — one ufunc sweep instead of
+    a per-theta 40-digit mpmath loop, so verifying a 2048-theta block
+    is not a hot loop; small batches keep the mpmath path, whose extra
+    digits are what the tightest equivalence tests compare against."""
     fn = FAMILY_EXACT.get(name)
-    if fn is None:
+    vfn = FAMILY_EXACT_VEC.get(name)
+    if fn is None and vfn is None:
         return None
-    return [fn(float(a), float(b), float(t)) for t in theta]
+    th = np.asarray(theta, dtype=np.float64)
+    if prefer_vec is None:
+        prefer_vec = th.size >= 64
+    if vfn is not None and (prefer_vec or fn is None):
+        return np.asarray(vfn(float(a), float(b), th.reshape(-1)),
+                          dtype=np.float64).reshape(th.shape)
+    return np.array([fn(float(a), float(b), float(t))
+                     for t in th.reshape(-1)],
+                    dtype=np.float64).reshape(th.shape)
 
 
 def _sin_recip_scaled_exact(a, b, th):
@@ -243,10 +272,67 @@ def _cosh4_scaled_exact(a, b, th):
         return float(F(b) - F(a))
 
 
-register_family_exact("sin_recip_scaled", _sin_recip_scaled_exact)
-register_family_exact("sin_scaled", _sin_scaled_exact)
-register_family_exact("gauss_center", _gauss_center_exact)
-register_family_exact("cosh4_scaled", _cosh4_scaled_exact)
+# --- vectorized numpy twins (round 13; see FAMILY_EXACT_VEC note) ---
+
+
+def _sin_scaled_exact_vec(a, b, th):
+    th = np.asarray(th, dtype=np.float64)
+    safe = np.where(th == 0.0, 1.0, th)
+    out = (np.cos(safe * a) - np.cos(safe * b)) / safe
+    # theta -> 0 limit: integrand -> sin(0+) slope, integral -> 0
+    return np.where(th == 0.0, 0.0, out)
+
+
+def _cosh4_scaled_exact_vec(a, b, th):
+    th = np.asarray(th, dtype=np.float64)
+    safe = np.where(th == 0.0, 1.0, th)
+
+    def F(x):
+        u = safe * x
+        return (3.0 * u / 8.0 + np.sinh(2.0 * u) / 4.0
+                + np.sinh(4.0 * u) / 32.0) / safe
+
+    # theta = 0: cosh^4(0) = 1, integral = b - a
+    return np.where(th == 0.0, b - a, F(b) - F(a))
+
+
+def _try_scipy_special():
+    try:
+        from scipy import special
+        return special
+    except ImportError:       # vectorized forms are an optimization;
+        return None           # the mpmath loop stays the fallback
+
+
+_SPECIAL = _try_scipy_special()
+
+
+def _sin_recip_scaled_exact_vec(a, b, th):
+    th = np.asarray(th, dtype=np.float64)
+    _si_a, ci_a = _SPECIAL.sici(th / a)
+    _si_b, ci_b = _SPECIAL.sici(th / b)
+    F = lambda x, ci: x * np.sin(th / x) - th * ci
+    return F(np.float64(b), ci_b) - F(np.float64(a), ci_a)
+
+
+def _gauss_center_exact_vec(a, b, c):
+    c = np.asarray(c, dtype=np.float64)
+    s = 1e-3
+    g = lambda x: s * np.sqrt(np.pi / 2.0) * _SPECIAL.erf(
+        (x - c) / (s * np.sqrt(2.0)))
+    return g(np.float64(b)) - g(np.float64(a))
+
+
+register_family_exact(
+    "sin_recip_scaled", _sin_recip_scaled_exact,
+    vec=_sin_recip_scaled_exact_vec if _SPECIAL is not None else None)
+register_family_exact("sin_scaled", _sin_scaled_exact,
+                      vec=_sin_scaled_exact_vec)
+register_family_exact(
+    "gauss_center", _gauss_center_exact,
+    vec=_gauss_center_exact_vec if _SPECIAL is not None else None)
+register_family_exact("cosh4_scaled", _cosh4_scaled_exact,
+                      vec=_cosh4_scaled_exact_vec)
 
 
 # --- double-single counterparts for the Pallas walker kernel --------------

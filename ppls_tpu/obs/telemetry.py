@@ -36,9 +36,11 @@ _RUN_COUNTERS = ("tasks", "splits", "leaves", "rounds",
 
 # round-11 lane-waste attribution buckets (walker.WASTE_FIELDS order;
 # spelled locally so the pure-Python obs layer stays importable with no
-# jax — analyze_occupancy --from-events depends on that)
+# jax — analyze_occupancy --from-events depends on that). Round 13
+# appends theta_overwalk: live lane-steps spent on already-accepted
+# thetas in union-refinement (theta_block > 1) mode; 0 otherwise.
 WASTE_BUCKETS = ("eval_active", "masked_dead", "refill_stall",
-                 "drain_tail")
+                 "drain_tail", "theta_overwalk")
 
 
 def build_attribution(buckets: dict, lane_cycles: int) -> dict:
@@ -131,7 +133,7 @@ class Telemetry:
                 "ppls_lane_cycles_total",
                 "kernel lane-cycles by attribution bucket "
                 "(eval_active + masked_dead + refill_stall + "
-                "drain_tail = lanes x kernel steps)",
+                "drain_tail + theta_overwalk = lanes x kernel steps)",
                 ("engine", "bucket"))
             for k, v in zip(WASTE_BUCKETS, waste):
                 fam.labels(engine=engine, bucket=k).inc(float(v))

@@ -99,15 +99,21 @@ from ppls_tpu.parallel.mesh import (FRONTIER_AXIS, device_store,
 from ppls_tpu.parallel.sharded_bag import _ShardBag, _shard_bag_round
 from ppls_tpu.parallel.walker import (
     MAX_REL_DEPTH,
+    N_WASTE,
     S_CAP,
     SEG_STAT_FIELDS,
     WalkerResult,
     _breed as _walker_breed,
     _expand_pending,
     _order_roots_by_work,
+    _run_theta_bag,
     _run_walk,
     _run_walk_kernel_refill,
     _WalkCarry,
+    normalize_theta_batch,
+    theta_breed_target,
+    theta_drain_chunk,
+    validate_theta_block,
 )
 from ppls_tpu.utils.metrics import RunMetrics
 
@@ -135,7 +141,7 @@ class _DDCarry(NamedTuple):
     #                         taken phase reshards (replicated by
     #                         construction — every chip counts the same
     #                         lockstep collectives)
-    waste: jnp.ndarray      # (4,) i64 per-chip lane-waste buckets
+    waste: jnp.ndarray      # (N_WASTE,) i64 per-chip lane-waste buckets
     #                         (walker.WASTE_FIELDS; reconcile to
     #                         lanes x wsteps per chip)
     evals: jnp.ndarray      # (2,) i64 per-chip scout/confirm kernel
@@ -175,7 +181,8 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                         admit_window: int = 0,
                         scout: bool = False,
                         double_buffer: bool = False,
-                        reduced: bool = False):
+                        reduced: bool = False,
+                        theta_block: int = 1):
     """Jitted demand-driven walker leg, memoized per configuration.
 
     Runs up to ``max_cycles`` cycles (a checkpoint leg passes a smaller
@@ -216,6 +223,19 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
     f_ds = get_family_ds(family, reduced=reduced)
     axis = FRONTIER_AXIS
     n_dev = mesh.devices.size
+    m_eff = m * int(theta_block)
+    # round 13: split-only breeding in theta mode (every popped row
+    # splits until the target is met; a breed-accept scored on one
+    # representative theta could strand another above its eps)
+    breed_eps = -1.0 if theta_block > 1 else eps
+    if theta_block > 1:
+        # per-chip runaway-queue clamp (walker.theta_breed_target)
+        target_local = theta_breed_target(target_local, refill_slots,
+                                          lanes, theta_block)
+    # the (m, T) theta table is a per-CALL operand (it must not bake
+    # into this memoized compiled program); shard_body binds it into
+    # this trace-time cell before the cycle loop traces
+    _tt_cell: dict = {"v": None}
     target_global = n_dev * target_local
     min_active = max(1, int(lanes * min_active_frac))
     # phase-reshard geometry (refill mode): the window (from
@@ -254,8 +274,8 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
         def body(carry):
             s, _ = carry
             prev = lax.psum(s.count, axis)
-            return (_shard_bag_round(s, f_theta, eps, rule,
-                                     breed_chunk, capacity, m, axis,
+            return (_shard_bag_round(s, f_theta, breed_eps, rule,
+                                     breed_chunk, capacity, m_eff, axis,
                                      fill_l, fill_th), prev)
 
         out, _ = lax.while_loop(cond, body, (s0, jnp.int32(0)))
@@ -288,8 +308,8 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
         breed round, ~5-15 rounds per cycle) collapses to nothing
         here. Only the overflow predicate is psum'd: the cycle loop's
         condition reads it and must stay replicated."""
-        bred = _walker_breed(_local_bag(c, m), f_theta=f_theta,
-                             eps=eps, chunk=breed_chunk,
+        bred = _walker_breed(_local_bag(c, m_eff), f_theta=f_theta,
+                             eps=breed_eps, chunk=breed_chunk,
                              capacity=capacity, target=target_local,
                              rule=rule)
         any_ovf = lax.psum(bred.overflow.astype(jnp.int32), axis) > 0
@@ -321,7 +341,7 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             bred = lax.cond(dry, breed_collective, breed_local, c)
         else:
             bred = breed_collective(c)
-        local = _local_bag(bred, m)
+        local = _local_bag(bred, m_eff)
         if sort_roots:
             # chip-LOCAL work-ordering of the balanced root share (the
             # same homogeneous-refill-window win as the single-chip
@@ -340,6 +360,8 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
         # local walk on this chip's balanced root share (no collectives:
         # per-chip segment counts diverge freely)
+        # m here is the FRONTIER slot count: the refill walk phase
+        # scales its credit width to m * theta_block internally
         wkw = dict(
             f_ds=f_ds, eps=eps, m=m,
             seg_iters=seg_iters, max_segments=max_segments,
@@ -356,13 +378,15 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
             # per-segment XLA routing (walker.make_walk_kernel)
             walk, kx = _run_walk_kernel_refill(
                 local, refill_slots=refill_slots,
-                double_buffer=double_buffer, **wkw)
+                double_buffer=double_buffer, theta_block=theta_block,
+                theta_table=_tt_cell["v"], **wkw)
             roots_taken = kx.taken.astype(jnp.int64)
         else:
             walk = _run_walk(local, **wkw)
             kx = None
             roots_taken = walk.cursor.astype(jnp.int64)
-        bag2 = _expand_pending(walk, capacity, m, kx)
+        bag2 = _expand_pending(walk, capacity, m_eff, kx,
+                               theta_block=theta_block)
 
         if refill_slots:
             # ONE phase-granular collective boundary: a global
@@ -405,14 +429,24 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
         # local drain of a small tail (per-chip gate; no collectives in
         # either branch, so chips may disagree freely)
-        def drain(b: BagState):
-            # stop_count mirrors walker._run_cycles' drain (VERDICT r4
-            # #9): a sub-min_active remainder that regrows past the
-            # local root target goes back to the walker, not to f64
-            return _run_bag(b, f_theta=f_theta, eps=eps,
-                            rule=rule, chunk=breed_chunk,
-                            capacity=capacity, max_iters=1 << 20,
-                            stop_count=target_local)
+        if theta_block > 1:
+            tchunk = theta_drain_chunk(breed_chunk, theta_block)
+
+            def drain(b: BagState):
+                return _run_theta_bag(
+                    b, theta_table=_tt_cell["v"],
+                    theta_block=theta_block, f_theta=f_theta,
+                    eps=eps, chunk=tchunk, capacity=capacity,
+                    max_iters=1 << 20, stop_count=target_local)
+        else:
+            def drain(b: BagState):
+                # stop_count mirrors walker._run_cycles' drain (VERDICT
+                # r4 #9): a sub-min_active remainder that regrows past
+                # the local root target goes back to the walker, not f64
+                return _run_bag(b, f_theta=f_theta, eps=eps,
+                                rule=rule, chunk=breed_chunk,
+                                capacity=capacity, max_iters=1 << 20,
+                                stop_count=target_local)
 
         bag3 = lax.cond(bag2.count < min_active, drain, lambda b: b, bag2)
 
@@ -449,7 +483,9 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
         prefix onto the local queue top (the store slack covers the
         window — _dd_sizing), and fold the capacity predicate into the
         replicated overflow flag like every collective guard here."""
-        acc2 = jnp.where(clear, 0.0, c.acc)
+        clear_eff = (jnp.repeat(clear, theta_block)
+                     if theta_block > 1 else clear)
+        acc2 = jnp.where(clear_eff, 0.0, c.acc)
         bl = lax.dynamic_update_slice(c.bag_l, adm_l, (c.count,))
         br = lax.dynamic_update_slice(c.bag_r, adm_r, (c.count,))
         bth = lax.dynamic_update_slice(c.bag_th, adm_th, (c.count,))
@@ -473,6 +509,12 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
                    splits, btasks, wtasks, wsplits, roots, rounds, segs,
                    wsteps, srows, crounds, waste, evals, maxd, cycles,
                    overflow, *admit_args):
+        if theta_block > 1:
+            # the (m, T) theta table rides as the LAST operand,
+            # replicated per chip ((n_dev, m, T) sharded -> (1, m, T)
+            # local); bind it for the cycle closures at trace time
+            _tt_cell["v"] = admit_args[-1][0]
+            admit_args = admit_args[:-1]
         c = _DDCarry(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
                      bag_meta=bag_meta, count=count[0], acc=acc[0],
                      tasks=tasks[0], splits=splits[0], btasks=btasks[0],
@@ -499,7 +541,8 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
     sh = P(axis)
     n_state = 22
-    n_in = n_state + (6 if admit_window else 0)
+    n_in = n_state + (6 if admit_window else 0) \
+        + (1 if theta_block > 1 else 0)
     n_out = n_state + (1 if admit_window else 0)
     # check_vma=False: the Pallas segment kernel's out_shape carries no
     # varying-manual-axes annotation, so the static VMA checker cannot
@@ -512,14 +555,15 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
 
 
 def _dd_sizing(lanes: int, capacity: int, chunk: int,
-               roots_per_lane: int):
+               roots_per_lane: int, theta_block: int = 1):
     """One sizing rule for integrate AND resume (store widths must
     match exactly or a resumed run's jitted program reads misaligned
     columns). Mirrors walker.py's single-chip sizing: the collective
     breed pops each chip's WHOLE local share every round (chunk >=
     per-chip target), so the global frontier doubles per round instead
     of plateauing at ~2x the pop width."""
-    target_local = min(roots_per_lane * lanes, capacity // 2)
+    target_local = min(
+        roots_per_lane * (lanes // int(theta_block)), capacity // 2)
     breed_chunk = max(1 << int(max(target_local, 1) - 1).bit_length(),
                       chunk)
     # slack covers bag_step's push windows, _expand_pending's static
@@ -584,6 +628,12 @@ def integrate_family_walker_dd(
         #                             range-reduced ds twin of the
         #                             family (falls back to the
         #                             reference twin when none exists)
+        theta_block: int = 1,       # round 13: T > 1 vectorizes theta
+        #                             per chip — theta is (m, T), each
+        #                             frontier root feeds a T-lane
+        #                             union-refinement group, areas
+        #                             come back (m, T); requires
+        #                             refill_slots > 0 + trapezoid
         interpret: Optional[bool] = None,
         mesh: Optional[Mesh] = None,
         n_devices: Optional[int] = None,
@@ -621,19 +671,24 @@ def integrate_family_walker_dd(
         mesh = make_mesh(n_devices)
     n_dev = mesh.devices.size
 
-    theta = np.asarray(theta, dtype=np.float64)
-    m = theta.shape[0]
+    theta2d, rep_theta = normalize_theta_batch(theta, theta_block)
+    m = theta2d.shape[0]
+    theta_block = validate_theta_block(
+        theta_block, lanes=lanes, refill_slots=refill_slots,
+        rule=rule, m=m)
+    m_eff = m * theta_block
     bounds = np.asarray(bounds, dtype=np.float64)
     if bounds.ndim == 1:
         bounds = np.tile(bounds.reshape(1, 2), (m, 1))
     from ppls_tpu.models.integrands import get_family_ds
     check_ds_domain(get_family_ds(family, reduced=reduced_integrands),
-                    bounds, theta)
+                    np.repeat(bounds, theta_block, axis=0),
+                    theta2d.reshape(-1))
 
     target_local, breed_chunk, store, reshard_window = _dd_sizing(
-        lanes, capacity, chunk, roots_per_lane)
+        lanes, capacity, chunk, roots_per_lane, theta_block)
     fill_l = float(0.5 * (bounds[0, 0] + bounds[0, 1]))
-    fill_th = float(theta[0])
+    fill_th = float(rep_theta[0])
 
     run = build_dd_walker_run(
         mesh, family, float(eps), int(breed_chunk), int(capacity), int(m),
@@ -644,13 +699,19 @@ def integrate_family_walker_dd(
         fill_l, fill_th, Rule(rule), bool(sort_roots),
         float(sort_skip_ratio), int(refill_slots), int(reshard_window),
         scout=bool(scout), double_buffer=bool(double_buffer),
-        reduced=bool(reduced_integrands))
+        reduced=bool(reduced_integrands),
+        theta_block=int(theta_block))
+    # replicated per-call theta operand (the table must not bake into
+    # the memoized compiled program — same config, new thetas)
+    tt_arg = ((jnp.broadcast_to(
+        jnp.asarray(theta2d)[None], (n_dev, m, theta_block)),)
+        if theta_block > 1 else ())
 
     if _state_override is not None:
         bag_l, bag_r, bag_th, bag_meta, count0 = _state_override
     else:
         bag_l, bag_r, bag_th, bag_meta, count0 = _seed_state(
-            bounds, theta, n_dev, store, capacity, fill_l, fill_th)
+            bounds, rep_theta, n_dev, store, capacity, fill_l, fill_th)
 
     # All per-chip counters live on-device and are passed back in across
     # legs, so totals are simply the latest values and a resumed run
@@ -662,10 +723,10 @@ def integrate_family_walker_dd(
     # round-11 lane-waste buckets, (n_dev, 4) — per-chip, unlike the
     # scalar CTR64 counters, so the flight recorder can attribute
     # straggler wsteps chip by chip
-    per_chip["waste"] = np.zeros((n_dev, 4), dtype=np.int64)
+    per_chip["waste"] = np.zeros((n_dev, N_WASTE), dtype=np.int64)
     # round-12 per-chip (scout, confirm) kernel-eval counters
     per_chip["evals"] = np.zeros((n_dev, 2), dtype=np.int64)
-    acc0 = np.zeros((n_dev, m), dtype=np.float64)
+    acc0 = np.zeros((n_dev, m_eff), dtype=np.float64)
     cycles_done = 0
     est_kevals = 0
     if _totals_override is not None:
@@ -678,9 +739,12 @@ def integrate_family_walker_dd(
                 dtype=np.int64)
         per_chip["maxd"] = np.asarray(_totals_override["pc_maxd"],
                                       dtype=np.int32)
-        per_chip["waste"] = np.asarray(
+        w_in = np.asarray(
             _totals_override.get("waste", per_chip["waste"]),
-            dtype=np.int64).reshape(n_dev, 4)
+            dtype=np.int64).reshape(n_dev, -1)
+        # pre-round-13 snapshots carry 4 buckets: zero-pad the
+        # theta_overwalk tail
+        per_chip["waste"][:, :w_in.shape[1]] = w_in
         per_chip["evals"] = np.asarray(
             _totals_override.get("evals", per_chip["evals"]),
             dtype=np.int64).reshape(n_dev, 2)
@@ -702,7 +766,7 @@ def integrate_family_walker_dd(
 
     legs = 0
     while True:
-        out = run(*state, *counters)
+        out = run(*state, *counters, *tt_arg)
         (bl, br, bth, bmeta, count, acc, tasks_c, splits_c, bt_c, wt_c,
          ws_c, roots_c, rounds_c, segs_c, wsteps_c, srows_c, crounds_c,
          waste_c, evals_c, maxd_c, cycles_c, ovf_c) = out
@@ -730,11 +794,12 @@ def integrate_family_walker_dd(
         # so "raise max_cycles and resume" continues from the latest
         # cycle instead of replaying the previous leg.
         from ppls_tpu.runtime.checkpoint import save_family_checkpoint
-        identity = _dd_ckpt_identity(family, float(eps), m, theta, bounds,
-                                     n_dev, Rule(rule),
+        identity = _dd_ckpt_identity(family, float(eps), m, theta2d,
+                                     bounds, n_dev, Rule(rule),
                                      int(refill_slots), scout=scout,
                                      double_buffer=double_buffer,
-                                     reduced=reduced_integrands)
+                                     reduced=reduced_integrands,
+                                     theta_block=theta_block)
         counts = np.asarray(count_h, dtype=np.int32)
         b = min(1 << int(max(int(counts.max()), 1)).bit_length(), store)
         bl2 = np.asarray(jax.device_get(bl.reshape(n_dev, store)[:, :b]))
@@ -779,12 +844,17 @@ def integrate_family_walker_dd(
     tot["cycles"] = cycles_done
 
     if overflow:
-        raise RuntimeError("dd walker bag overflowed; raise capacity")
+        raise RuntimeError(
+            "dd walker bag overflowed; raise capacity (on theta_block "
+            "runs this also fires when a walk phase's step budget "
+            "expired mid-root — raise max_segments/seg_iters)")
     if left > 0:
         raise RuntimeError(
             f"dd walker did not converge in {tot['cycles']} cycles "
             f"({left} tasks left); raise max_cycles")
     areas = np.sum(acc_h, axis=0)      # fixed chip order: deterministic
+    if theta_block > 1:
+        areas = areas.reshape(m, theta_block)
     if not np.all(np.isfinite(areas)):
         bad = int(np.sum(~np.isfinite(areas)))
         raise FloatingPointError(
@@ -867,7 +937,8 @@ def _dd_ckpt_identity(family: str, eps: float, m: int, theta: np.ndarray,
                       rule: Rule = Rule.TRAPEZOID,
                       refill_slots: int = 0, scout: bool = False,
                       double_buffer: bool = False,
-                      reduced: bool = False) -> dict:
+                      reduced: bool = False,
+                      theta_block: int = 1) -> dict:
     from ppls_tpu.runtime.checkpoint import _family_identity, engine_name
     ident = _family_identity(engine_name("walker-dd", rule), family, eps,
                              m, theta, bounds)
@@ -886,6 +957,8 @@ def _dd_ckpt_identity(family: str, eps: float, m: int, theta: np.ndarray,
         ident["double_buffer"] = True
     if reduced:
         ident["reduced"] = True
+    if int(theta_block) > 1:
+        ident["theta_block"] = int(theta_block)
     return ident
 
 
@@ -896,7 +969,8 @@ def resume_family_walker_dd(
     last leg snapshot (identity-checked, mesh size included)."""
     from ppls_tpu.runtime.checkpoint import load_family_checkpoint
 
-    theta_np = np.asarray(theta, dtype=np.float64)
+    theta_np, _rep = normalize_theta_batch(
+        theta, int(kwargs.get("theta_block", 1)))
     m = theta_np.shape[0]
     bounds_np = np.asarray(bounds, dtype=np.float64)
     if bounds_np.ndim == 1:
@@ -914,7 +988,8 @@ def resume_family_walker_dd(
             kwargs.get("scout_dtype"),
             Rule(kwargs.get("rule", Rule.TRAPEZOID))),
         double_buffer=bool(kwargs.get("double_buffer", False)),
-        reduced=bool(kwargs.get("reduced_integrands", False)))
+        reduced=bool(kwargs.get("reduced_integrands", False)),
+        theta_block=int(kwargs.get("theta_block", 1)))
     bag_cols, _count, acc, totals = load_family_checkpoint(path, identity)
 
     # rebuild full-width per-chip stores around the saved live prefixes
@@ -923,9 +998,10 @@ def resume_family_walker_dd(
     chunk = int(kwargs.get("chunk", 1 << 12))
     rpl = int(kwargs.get("roots_per_lane", 12))
     _target_local, _breed_chunk, store, _rw = _dd_sizing(
-        lanes, capacity, chunk, rpl)
+        lanes, capacity, chunk, rpl,
+        int(kwargs.get("theta_block", 1)))
     fill_l = float(0.5 * (bounds_np[0, 0] + bounds_np[0, 1]))
-    fill_th = float(theta_np[0])
+    fill_th = float(_rep[0])
     counts = np.asarray(bag_cols["counts"], dtype=np.int32)
     b = bag_cols["l"].shape[1]
     # Sizing mismatch guard (ADVICE r4): the snapshot's prefix width and
@@ -953,7 +1029,7 @@ def resume_family_walker_dd(
     # shared walker.derive_kernel_evals contract)
     from ppls_tpu.parallel.walker import estimate_legacy_kernel_evals
     totals.setdefault("est_kevals", estimate_legacy_kernel_evals(
-        {"waste": totals.get("waste", [0, 0, 0, 0]),
+        {"waste": totals.get("waste", [0] * N_WASTE),
          "sevals": int(np.sum(np.asarray(
              totals.get("evals", 0), dtype=np.int64))),
          "wtasks": int(np.sum(np.asarray(

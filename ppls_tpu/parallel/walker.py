@@ -255,6 +255,116 @@ def _is_reduced_twin(f_ds: Callable) -> bool:
     return any(f_ds is v for v in DS_FAMILIES_REDUCED.values())
 
 
+def validate_theta_block(theta_block: int, *, lanes: int,
+                         refill_slots: int, rule: Rule, m: int) -> int:
+    """The ONE precondition check of the round-13 many-theta mode,
+    shared by every engine entry (walker/dd/stream) so the constraints
+    cannot drift. theta_block = T > 1 makes theta a VECTORIZED MINOR
+    AXIS: groups of T adjacent SIMD lanes share one interval walk (one
+    (i, d) DFS state, one root bank slot sequence) and carry T distinct
+    thetas; the split test runs in union-refinement mode and credit
+    lands in a (slots, T) accumulator keyed fam * T + t."""
+    T = int(theta_block)
+    if T < 1:
+        raise ValueError(f"theta_block must be >= 1, got {T}")
+    if T == 1:
+        return T
+    if T & (T - 1):
+        raise ValueError(f"theta_block must be a power of two, got {T}")
+    if lanes % T:
+        raise ValueError(
+            f"theta_block={T} must divide lanes={lanes} (each theta "
+            f"block occupies T adjacent minor-axis lanes)")
+    if not refill_slots:
+        raise ValueError(
+            "theta_block > 1 requires refill_slots > 0 (the theta "
+            "groups take roots together through the in-kernel refill "
+            "deal; the legacy XLA-boundary refill permutes lanes "
+            "individually and would scramble the groups)")
+    if Rule(rule) != Rule.TRAPEZOID:
+        raise ValueError(
+            "theta_block > 1 supports Rule.TRAPEZOID only (the Simpson "
+            "walker's 5-phase mode chain has no union-vote step)")
+    from ppls_tpu.parallel.bag_engine import MAX_FAMILIES
+    if m * T > MAX_FAMILIES:
+        raise ValueError(
+            f"slots * theta_block = {m} * {T} exceeds the meta-word "
+            f"fam field ({MAX_FAMILIES})")
+    return T
+
+
+def normalize_theta_batch(theta, theta_block: int):
+    """Normalize the engines' theta input for a given ``theta_block``.
+
+    T = 1 keeps the scalar contract: theta is (m,). T > 1 expects
+    (m, T) — one row of T per-user thetas per frontier slot — and
+    accepts a bare (T,) vector as the m = 1 convenience. Returns
+    ``(theta2d, rep)`` where ``theta2d`` is the (m, T) f64 table and
+    ``rep`` the (m,) representative theta column (theta[:, 0]) that
+    frontier bag rows carry for work-scoring; put a representative
+    member (e.g. the hardest theta) first for the best work-sort."""
+    theta = np.asarray(theta, dtype=np.float64)
+    T = int(theta_block)
+    if T == 1:
+        return theta.reshape(-1, 1), theta.reshape(-1)
+    if theta.ndim == 1:
+        if theta.shape[0] != T:
+            raise ValueError(
+                f"theta_block={T}: 1-D theta must have exactly T "
+                f"entries (the m=1 convenience), got {theta.shape[0]}")
+        theta = theta.reshape(1, T)
+    if theta.ndim != 2 or theta.shape[1] != T:
+        raise ValueError(
+            f"theta_block={T}: theta must be (m, {T}), got "
+            f"{theta.shape}")
+    return theta, theta[:, 0].copy()
+
+
+def theta_drain_chunk(breed_chunk: int, theta_block: int) -> int:
+    """The ONE pop-width clamp of the union-refinement f64 drain
+    (walker cycle, stream cycle, dd cycle): the exact segment sum
+    credits chunk * T rows per round, and its digit-plane length bound
+    caps the product near 2^16 — one definition so the engines' drain
+    policies cannot drift."""
+    return max(1, min(breed_chunk, (1 << 16) // theta_block))
+
+
+def theta_breed_target(target: int, refill_slots: int, lanes: int,
+                       theta_block: int) -> int:
+    """The ONE breed-target clamp of theta mode (walker + dd):
+    split-only breeding terminates no work, so the target must not
+    outrun what one walk phase consumes (one full deal: R roots per
+    theta group) — a larger target would DOUBLE the un-dealt remainder
+    every cycle faster than the walker drains it (runaway queue). The
+    leftover after a deal stays strictly below one deal, so the cycle
+    loop converges."""
+    return min(target,
+               max(1, refill_slots) * (lanes // theta_block))
+
+
+def _group_any(mask: jnp.ndarray, theta_block: int) -> jnp.ndarray:
+    """ANY-reduce a (rows, 128) boolean over theta groups of T adjacent
+    flattened lanes (row-major, so groups are contiguous on the minor
+    axis; T > 128 groups span whole rows), broadcast back to lane
+    shape. The union-refinement vote of the theta-batched kernels."""
+    g = mask.reshape(-1, theta_block)
+    r = jnp.any(g, axis=1)[:, None]
+    return jnp.broadcast_to(r, g.shape).reshape(mask.shape)
+
+
+def _theta_retired(s: "WalkState") -> jnp.ndarray:
+    """Per-lane retired mask of the theta-batched walk: a theta lane is
+    retired while the group's current node (i, d) is a descendant of
+    the lane's accept marker (mk_i, mk_d) — set when the lane's own
+    test passed but the union vote split. DFS node indexes at any depth
+    are strictly increasing in visit order, so a stale marker can never
+    alias a later subtree; markers reset on refill."""
+    dd = s.d - s.mk_d
+    anc = s.i >> jnp.clip(dd, 0, 31)
+    return jnp.logical_and(
+        jnp.logical_and(s.mk_d >= 0, dd >= 0), anc == s.mk_i)
+
+
 def resolve_cadence(exit_frac: Optional[float],
                     suspend_frac: Optional[float], scout: bool,
                     refill_slots: int = 0):
@@ -314,6 +424,15 @@ class WalkState(NamedTuple):
     tasks: jnp.ndarray      # int32 cumulative tasks evaluated by this lane
     splits: jnp.ndarray     # int32
     maxd: jnp.ndarray       # int32 max absolute depth seen
+    mk_i: jnp.ndarray       # int32 theta-accept marker node index
+    #                         (round 13, theta_block > 1 only; 0 else)
+    mk_d: jnp.ndarray       # int32 marker depth; -1 = no marker. While
+    #                         (i, d) is a descendant of (mk_i, mk_d)
+    #                         this theta lane is RETIRED: it already
+    #                         credited its own accepted value at the
+    #                         marker node and neither votes nor credits
+    #                         in the subtree (its steps count in the
+    #                         theta_overwalk waste bucket)
 
 
 def _node_geometry(s: WalkState):
@@ -343,7 +462,7 @@ def _ctz(k):
 def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                      interpret: bool = False, early_exit: bool = False,
                      rule: Rule = Rule.TRAPEZOID, refill_slots: int = 0,
-                     scout: bool = False):
+                     scout: bool = False, theta_block: int = 1):
     """Build the segment kernel: up to seg_iters walker steps over all
     lanes.
 
@@ -400,6 +519,9 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
     with bank-lifetime segments and ZERO boundary sorts.
     """
     eps32 = np.float32(eps)
+    if theta_block > 1 and rule != Rule.TRAPEZOID:
+        raise ValueError(
+            "theta_block > 1 supports Rule.TRAPEZOID only")
 
     def step(s: WalkState) -> WalkState:
         parked = (s.flags & _PARKED) != 0
@@ -430,20 +552,55 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
 
         testing = jnp.logical_and(
             live, jnp.logical_not(jnp.logical_or(mode_load, mode_init)))
-        do_split = jnp.logical_and(testing, split)
-        # depth guard: an overflow lane parks un-finished; the mop-up
-        # phase expands its pending nodes into bag tasks.
-        ovf = jnp.logical_and(do_split, s.d >= MAX_REL_DEPTH)
-        do_split = jnp.logical_and(do_split, jnp.logical_not(ovf))
-        do_accept = jnp.logical_and(testing, jnp.logical_not(split))
+        if theta_block > 1:
+            # UNION-REFINEMENT vote (round 13): the T lanes of a theta
+            # group share one (i, d) walk; the node splits iff ANY
+            # unretired theta fails its own test. A theta whose own
+            # test passes while the union splits credits its value HERE
+            # (its solo-run leaf) and retires for the subtree via the
+            # (mk_i, mk_d) marker — so each theta's credited leaf set
+            # is exactly its per-theta refinement, never coarser.
+            retired = _theta_retired(s)
+            test_act = jnp.logical_and(testing,
+                                       jnp.logical_not(retired))
+            vote = jnp.logical_and(test_act, split)
+            do_split = jnp.logical_and(
+                testing, _group_any(vote, theta_block))
+            # depth-cap FORCE-ACCEPT: past MAX_REL_DEPTH the union
+            # accepts instead of parking (the per-lane mop-up path
+            # cannot carry per-theta markers); every active theta
+            # credits its best value here. Unreachable at sane
+            # eps/breeding — the non-theta engine's depth-30 overflow
+            # has never been observed either.
+            ovf_force = jnp.logical_and(do_split, s.d >= MAX_REL_DEPTH)
+            do_split = jnp.logical_and(do_split,
+                                       jnp.logical_not(ovf_force))
+            ovf = jnp.zeros_like(do_split)
+            group_accept = jnp.logical_and(testing,
+                                           jnp.logical_not(do_split))
+            credit = jnp.logical_and(test_act, jnp.logical_or(
+                jnp.logical_not(split), ovf_force))
+            split_inc = jnp.logical_and(vote, do_split)
+            task_inc = test_act
+        else:
+            do_split = jnp.logical_and(testing, split)
+            # depth guard: an overflow lane parks un-finished; the
+            # mop-up phase expands its pending nodes into bag tasks.
+            ovf = jnp.logical_and(do_split, s.d >= MAX_REL_DEPTH)
+            do_split = jnp.logical_and(do_split, jnp.logical_not(ovf))
+            group_accept = jnp.logical_and(testing,
+                                           jnp.logical_not(split))
+            credit = group_accept
+            split_inc = do_split
+            task_inc = testing
 
         # --- descend (left child): i <<= 1, midpoint becomes f(right)
         # --- accept: bank value, advance to the DFS successor
         acc = dsk.ds_add((s.acc_h, s.acc_l), dsk.ds_where(
-            do_accept, val, (jnp.zeros_like(val[0]), jnp.zeros_like(val[1]))))
+            credit, val, (jnp.zeros_like(val[0]), jnp.zeros_like(val[1]))))
         t = _ctz(s.i + 1)
-        fin = jnp.logical_and(do_accept, t >= s.d)   # last leaf of the root
-        adv = jnp.logical_and(do_accept, jnp.logical_not(fin))
+        fin = jnp.logical_and(group_accept, t >= s.d)  # last leaf
+        adv = jnp.logical_and(group_accept, jnp.logical_not(fin))
         i_next = jnp.where(do_split, s.i * 2,
                            jnp.where(adv, (s.i >> t) + 1, s.i))
         d_next = jnp.where(do_split, s.d + 1,
@@ -464,6 +621,13 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         flags = jnp.where(fin, flags | _PARKED, flags)
         flags = jnp.where(ovf, flags | (_PARKED | _OVF), flags)
 
+        if theta_block > 1:
+            set_mark = jnp.logical_and(do_split, credit)
+            mk_i = jnp.where(set_mark, s.i, s.mk_i)
+            mk_d = jnp.where(set_mark, s.d, s.mk_d)
+        else:
+            mk_i, mk_d = s.mk_i, s.mk_d
+
         return WalkState(
             a_h=s.a_h, a_l=s.a_l, w_h=s.w_h, w_l=s.w_l,
             th_h=s.th_h, th_l=s.th_l,
@@ -473,10 +637,11 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             acc_h=acc[0], acc_l=acc[1],
             i=i_next, d=d_next, base_d=s.base_d, fam=s.fam,
             flags=flags,
-            tasks=s.tasks + testing.astype(jnp.int32),
-            splits=s.splits + do_split.astype(jnp.int32),
+            tasks=s.tasks + task_inc.astype(jnp.int32),
+            splits=s.splits + split_inc.astype(jnp.int32),
             maxd=jnp.maximum(s.maxd, jnp.where(
                 testing, s.base_d + s.d, jnp.int32(0))),
+            mk_i=mk_i, mk_d=mk_d,
         )
 
     def step_simpson(s: WalkState) -> WalkState:
@@ -604,6 +769,7 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             splits=s.splits + do_split.astype(jnp.int32),
             maxd=jnp.maximum(s.maxd, jnp.where(
                 testing, s.base_d + s.d, jnp.int32(0))),
+            mk_i=s.mk_i, mk_d=s.mk_d,
         )
 
     def step_scout(s: WalkState):
@@ -671,8 +837,21 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
 
         testing = live
         decisive = jnp.logical_and(testing, err32 > eps32 + band)
-        need_conf = jnp.logical_and(testing,
-                                    jnp.logical_not(decisive))
+        if theta_block > 1:
+            # union-refinement scout (round 13): retired theta lanes
+            # neither vote nor confirm; lanes at the depth cap always
+            # confirm so the force-accept path has a ds credit value
+            # even for decisive splitters
+            retired_sc = _theta_retired(s)
+            test_act = jnp.logical_and(testing,
+                                       jnp.logical_not(retired_sc))
+            atcap = s.d >= MAX_REL_DEPTH
+            need_conf = jnp.logical_and(test_act, jnp.logical_or(
+                jnp.logical_not(decisive), atcap))
+        else:
+            test_act = testing
+            need_conf = jnp.logical_and(testing,
+                                        jnp.logical_not(decisive))
         n_conf = dsk.mask_count(need_conf)
 
         z32 = jnp.zeros_like(s.fl_h)
@@ -703,19 +882,40 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         val = (vh, vl)
         split = jnp.where(need_conf, split_ds, decisive)
 
-        do_split = jnp.logical_and(testing, split)
-        ovf = jnp.logical_and(do_split, s.d >= MAX_REL_DEPTH)
-        do_split = jnp.logical_and(do_split, jnp.logical_not(ovf))
-        # an accept is only ever a confirmed (ds) accept: decisive
-        # lanes split, so do_accept implies need_conf and `val` is the
-        # full-ds leaf value
-        do_accept = jnp.logical_and(testing, jnp.logical_not(split))
+        if theta_block > 1:
+            vote = jnp.logical_and(test_act, split)
+            do_split = jnp.logical_and(
+                testing, _group_any(vote, theta_block))
+            ovf_force = jnp.logical_and(do_split, atcap)
+            do_split = jnp.logical_and(do_split,
+                                       jnp.logical_not(atcap))
+            ovf = jnp.zeros_like(do_split)
+            group_accept = jnp.logical_and(testing,
+                                           jnp.logical_not(do_split))
+            # credit lanes all hold a ds `val`: ~split implies
+            # need_conf, and force-accepted lanes confirmed via atcap
+            credit = jnp.logical_and(test_act, jnp.logical_or(
+                jnp.logical_not(split), ovf_force))
+            split_inc = jnp.logical_and(vote, do_split)
+            task_inc = test_act
+        else:
+            do_split = jnp.logical_and(testing, split)
+            ovf = jnp.logical_and(do_split, s.d >= MAX_REL_DEPTH)
+            do_split = jnp.logical_and(do_split, jnp.logical_not(ovf))
+            # an accept is only ever a confirmed (ds) accept: decisive
+            # lanes split, so the credit implies need_conf and `val`
+            # is the full-ds leaf value
+            group_accept = jnp.logical_and(testing,
+                                           jnp.logical_not(split))
+            credit = group_accept
+            split_inc = do_split
+            task_inc = testing
 
         acc = dsk.ds_add((s.acc_h, s.acc_l), dsk.ds_where(
-            do_accept, val, (z32, z32)))
+            credit, val, (z32, z32)))
         t = _ctz(s.i + 1)
-        fin = jnp.logical_and(do_accept, t >= s.d)
-        adv = jnp.logical_and(do_accept, jnp.logical_not(fin))
+        fin = jnp.logical_and(group_accept, t >= s.d)
+        adv = jnp.logical_and(group_accept, jnp.logical_not(fin))
         i_next = jnp.where(do_split, s.i * 2,
                            jnp.where(adv, (s.i >> t) + 1, s.i))
         d_next = jnp.where(do_split, s.d + 1,
@@ -738,6 +938,13 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                 + dsk.mask_count(need_r))
         cf_n = 3 * n_conf
 
+        if theta_block > 1:
+            set_mark = jnp.logical_and(do_split, credit)
+            mk_i = jnp.where(set_mark, s.i, s.mk_i)
+            mk_d = jnp.where(set_mark, s.d, s.mk_d)
+        else:
+            mk_i, mk_d = s.mk_i, s.mk_d
+
         s2 = WalkState(
             a_h=s.a_h, a_l=s.a_l, w_h=s.w_h, w_l=s.w_l,
             th_h=s.th_h, th_l=s.th_l,
@@ -747,10 +954,11 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             acc_h=acc[0], acc_l=acc[1],
             i=i_next, d=d_next, base_d=s.base_d, fam=s.fam,
             flags=flags,
-            tasks=s.tasks + testing.astype(jnp.int32),
-            splits=s.splits + do_split.astype(jnp.int32),
+            tasks=s.tasks + task_inc.astype(jnp.int32),
+            splits=s.splits + split_inc.astype(jnp.int32),
             maxd=jnp.maximum(s.maxd, jnp.where(
                 testing, s.base_d + s.d, jnp.int32(0))),
+            mk_i=mk_i, mk_d=mk_d,
         )
         return s2, sc_n, cf_n
 
@@ -792,11 +1000,14 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             resl_ref = refs[17 + 2 * n_fields]
             resm_out = refs[18 + 2 * n_fields:21 + 2 * n_fields]
             steps_ref = refs[21 + 2 * n_fields]
-            # round-11 lane-waste accounting: one (1, 1) SMEM scalar per
-            # bucket (eval_active, masked_dead, refill_stall, drain_tail)
-            waste_refs = refs[22 + 2 * n_fields:26 + 2 * n_fields]
+            # round-11 lane-waste accounting: one (1, 1) SMEM scalar
+            # per bucket (eval_active, masked_dead, refill_stall,
+            # drain_tail, + round-13 theta_overwalk)
+            waste_refs = refs[22 + 2 * n_fields:
+                              22 + N_WASTE + 2 * n_fields]
             # round-12 eval accounting: scout evals / ds confirm evals
-            eval_refs = refs[26 + 2 * n_fields:28 + 2 * n_fields]
+            eval_refs = refs[22 + N_WASTE + 2 * n_fields:
+                             24 + N_WASTE + 2 * n_fields]
 
             s0 = WalkState(*(r[:] for r in in_refs))
             slot0 = slot_ref[:]
@@ -886,6 +1097,9 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                     flags=jnp.where(take, jnp.int32(_MODE_INIT),
                                     st.flags),
                     tasks=st.tasks, splits=st.splits, maxd=st.maxd,
+                    # fresh root: theta-accept markers reset (round 13)
+                    mk_i=pick(zi, st.mk_i),
+                    mk_d=jnp.where(take, jnp.int32(-1), st.mk_d),
                 )
                 return st2, jnp.where(take, sl + 1, sl), \
                     tuple(resh), tuple(resl), resm
@@ -906,7 +1120,7 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
 
             def body(c):
                 (k, st, sl, live, nref, resh, resl, resm, wa, wd, ws,
-                 wt, se, ce) = c
+                 wt, wo, se, ce) = c
                 # refill BEFORE the step: freshly parked lanes from the
                 # previous step join the candidate pool, and a fully
                 # parked start (phase seeding) refills on iteration 0
@@ -922,9 +1136,11 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                 # by cause — takeable (waiting on the refill batch
                 # cadence) = refill-stall; no-root with nothing left to
                 # take = masked-dead (never fed this phase); the rest
-                # (finished its slots, or OVF) = drain-tail. The four
-                # buckets partition the lane set every step, so their
-                # phase sums reconcile to lanes x steps exactly.
+                # (finished its slots, or OVF) = drain-tail. In theta
+                # mode a live-but-RETIRED theta lane's eval splits out
+                # of eval_active into theta_overwalk. The buckets
+                # partition the lane set every step, so their phase
+                # sums reconcile to lanes x steps exactly.
                 parked = (st.flags & _PARKED) != 0
                 noroot = (st.flags & _NO_ROOT) != 0
                 ovfl = (st.flags & _OVF) != 0
@@ -936,17 +1152,23 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                 dead_n = dsk.mask_count(jnp.logical_and(
                     noroot, jnp.logical_not(takeable)))
                 tail_n = n_lanes - live_n - stall_n - dead_n
+                if theta_block > 1:
+                    over_n = dsk.mask_count(jnp.logical_and(
+                        jnp.logical_not(parked), _theta_retired(st)))
+                else:
+                    over_n = jnp.int32(0)
                 st, sc_n, cf_n = step_fn(st)
                 live, nref = counts(st, sl)
                 return (k + 1, st, sl, live, nref, resh, resl, resm,
-                        wa + live_n, wd + dead_n, ws + stall_n,
-                        wt + tail_n, se + sc_n, ce + cf_n)
+                        wa + live_n - over_n, wd + dead_n,
+                        ws + stall_n, wt + tail_n, wo + over_n,
+                        se + sc_n, ce + cf_n)
 
             (k, out, slot_o, _, _, resh, resl, resm, wa, wd, ws, wt,
-             se, ce) = lax.while_loop(
+             wo, se, ce) = lax.while_loop(
                     cond, body,
                     (jnp.int32(0), s0, slot0, live0, nref0, resh0,
-                     resl0, resm0, zc, zc, zc, zc, zc, zc))
+                     resl0, resm0, zc, zc, zc, zc, zc, zc, zc))
             for r, v in zip(out_refs, out):
                 r[:] = v
             slot_out_ref[:] = slot_o
@@ -956,7 +1178,7 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             for r, v in zip(resm_out, resm):
                 r[:] = v
             steps_ref[0, 0] = k
-            for r, v in zip(waste_refs, (wa, wd, ws, wt)):
+            for r, v in zip(waste_refs, (wa, wd, ws, wt, wo)):
                 r[0, 0] = v
             for r, v in zip(eval_refs, (se, ce)):
                 r[0, 0] = v
@@ -984,14 +1206,13 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                     lane_i32,
                     jax.ShapeDtypeStruct(bank_shape, jnp.float32),
                     jax.ShapeDtypeStruct(bank_shape, jnp.float32),
-                    lane_f32, lane_f32, lane_i32,
-                    scalar, scalar, scalar, scalar, scalar, scalar,
-                    scalar),
+                    lane_f32, lane_f32, lane_i32)
+                + (scalar,) * (3 + N_WASTE),
                 in_specs=[smem, smem, smem]
                 + [vmem] * (1 + 7 + 1 + 3)
                 + [vmem] * n_fields,
                 out_specs=(vmem,) * n_fields
-                + (vmem,) * 6 + (smem,) * 7,
+                + (vmem,) * 6 + (smem,) * (3 + N_WASTE),
                 interpret=interpret,
             )(thresh.reshape(1, 1).astype(jnp.int32),
               cap.reshape(1, 1).astype(jnp.int32),
@@ -1001,8 +1222,9 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
                     out[n_fields + 1], out[n_fields + 2],
                     tuple(out[n_fields + 3 + j] for j in range(3)),
                     out[n_fields + 6][0, 0],
-                    tuple(out[n_fields + 7 + j][0, 0] for j in range(4)),
-                    tuple(out[n_fields + 11 + j][0, 0]
+                    tuple(out[n_fields + 7 + j][0, 0]
+                          for j in range(N_WASTE)),
+                    tuple(out[n_fields + 7 + N_WASTE + j][0, 0]
                           for j in range(2)))
 
         return run_segment_rf
@@ -1057,8 +1279,9 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         # the third bucket into refill-stall (queue had roots: the lane
         # was waiting for the segment's bank/refill boundary) vs
         # drain-tail (queue dry: nothing could have fed it).
-        wa_ref, wd_ref, wr_ref = refs[3 + 2 * n_fields:6 + 2 * n_fields]
-        se_ref, ce_ref = refs[6 + 2 * n_fields:8 + 2 * n_fields]
+        wa_ref, wd_ref, wr_ref, wo_ref = \
+            refs[3 + 2 * n_fields:7 + 2 * n_fields]
+        se_ref, ce_ref = refs[7 + 2 * n_fields:9 + 2 * n_fields]
         s = WalkState(*(r[:] for r in in_refs))
         thresh = thresh_ref[0, 0]
         cap = cap_ref[0, 0]
@@ -1084,23 +1307,30 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
             # while_loop's cond/body are separate programs with no
             # cross-CSE, so recomputing it would double the per-step
             # popcount cost)
-            k, st, live_n, wa, wd, wr, se, ce = carry
+            k, st, live_n, wa, wd, wr, wo, se, ce = carry
             dead_n = dsk.mask_count((st.flags & _NO_ROOT) != 0)
+            if theta_block > 1:
+                over_n = dsk.mask_count(jnp.logical_and(
+                    (st.flags & _PARKED) == 0, _theta_retired(st)))
+            else:
+                over_n = jnp.int32(0)
             st2, sc_n, cf_n = step_fn(st)
-            return (k + 1, st2, live_count(st2), wa + live_n,
+            return (k + 1, st2, live_count(st2),
+                    wa + live_n - over_n,
                     wd + dead_n, wr + (n_lanes - live_n - dead_n),
-                    se + sc_n, ce + cf_n)
+                    wo + over_n, se + sc_n, ce + cf_n)
 
         zc = jnp.int32(0)
-        k, out, _, wa, wd, wr, se, ce = lax.while_loop(
+        k, out, _, wa, wd, wr, wo, se, ce = lax.while_loop(
             cond, body, (jnp.int32(0), s, live_count(s), zc, zc, zc,
-                         zc, zc))
+                         zc, zc, zc))
         for r, v in zip(out_refs, out):
             r[:] = v
         steps_ref[0, 0] = k
         wa_ref[0, 0] = wa
         wd_ref[0, 0] = wd
         wr_ref[0, 0] = wr
+        wo_ref[0, 0] = wo
         se_ref[0, 0] = se
         ce_ref[0, 0] = ce
 
@@ -1111,17 +1341,17 @@ def make_walk_kernel(f_ds: Callable, eps: float, seg_iters: int,
         scalar = jax.ShapeDtypeStruct((1, 1), jnp.int32)
         out = pl.pallas_call(
             kernel_ee,
-            out_shape=shapes + (scalar,) * 6,
+            out_shape=shapes + (scalar,) * 7,
             in_specs=[smem, smem]
             + [pl.BlockSpec(memory_space=pltpu.VMEM)] * n_fields,
             out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * n_fields
-            + (smem,) * 6,
+            + (smem,) * 7,
             interpret=interpret,
         )(thresh.reshape(1, 1).astype(jnp.int32),
           cap.reshape(1, 1).astype(jnp.int32), *state)
         return (WalkState(*out[:n_fields]), out[n_fields][0, 0],
-                tuple(out[n_fields + 1 + j][0, 0] for j in range(3)),
-                tuple(out[n_fields + 4 + j][0, 0] for j in range(2)))
+                tuple(out[n_fields + 1 + j][0, 0] for j in range(4)),
+                tuple(out[n_fields + 5 + j][0, 0] for j in range(2)))
 
     return run_segment_ee
 
@@ -1139,18 +1369,26 @@ C_CAP = 64      # per-cycle stats ring rows
 SEG_STAT_FIELDS = ("steps", "live_at_exit", "queue_left", "refilled")
 # Round-11 lane-waste attribution buckets: every kernel lane-step of a
 # walk phase lands in exactly one —
-#   eval_active:  the lane was live, its eval was useful work;
+#   eval_active:  the lane was live AND (theta mode) unretired — its
+#                 eval was useful per-theta work;
 #   masked_dead:  parked with no root and nothing left to take (a lane
 #                 the deal never fed, structurally masked all phase);
 #   refill_stall: parked but refillable — waiting on the refill batch
 #                 cadence (in-kernel) or the segment's XLA boundary
 #                 (legacy mode with a non-dry queue);
 #   drain_tail:   parked with work exhausted (bank/queue dry, or OVF) —
-#                 burning steps until the phase suspends.
-# RECONCILIATION INVARIANT: the four sums equal lanes x kernel steps per
-# phase, device-counted end to end (BASELINE.md round 11).
+#                 burning steps until the phase suspends;
+#   theta_overwalk: (round 13, theta_block > 1) live lanes whose theta
+#                 already accepted an ancestor of the current node —
+#                 evals paid for already-accepted thetas while the
+#                 union refinement walks deeper for the others. The
+#                 device-counted cost of union-refinement amortization;
+#                 identically 0 with theta_block = 1.
+# RECONCILIATION INVARIANT: the five sums equal lanes x kernel steps per
+# phase, device-counted end to end (BASELINE.md rounds 11 + 13).
 WASTE_FIELDS = ("eval_active", "masked_dead", "refill_stall",
-                "drain_tail")
+                "drain_tail", "theta_overwalk")
+N_WASTE = len(WASTE_FIELDS)
 
 # Round-12 device-counted kernel eval split (tail columns after the
 # waste buckets): `scout_evals` = useful f32 scout-pass evals,
@@ -1459,6 +1697,8 @@ def _bank_and_refill(c: _WalkCarry, m: int, lanes: int) -> _WalkCarry:
         base_d=pick(based_new, sp.base_d), fam=pick(fam_new, sp.fam),
         flags=flags,
         tasks=sp.tasks, splits=sp.splits, maxd=sp.maxd,
+        mk_i=pick(zi, sp.mk_i),
+        mk_d=jnp.where(take2, jnp.int32(-1), sp.mk_d),
     )
     return _WalkCarry(lanes=new_lanes, bag=c.bag,
                       cursor=c.cursor + n_taken, acc=acc,
@@ -1512,6 +1752,7 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
         i=zi, d=zi, base_d=zi, fam=zi,
         flags=jnp.full((rows, 128), _PARKED | _NO_ROOT, jnp.int32),
         tasks=zi, splits=zi, maxd=zi,
+        mk_i=zi, mk_d=jnp.full((rows, 128), -1, jnp.int32),
     )
     # segs starts at -1: the initial seeding call below increments it,
     # so `segs` counts executed kernel segments only.
@@ -1520,7 +1761,7 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
                        steps=jnp.int32(0),
                        gsegs=jnp.asarray(gsegs0, jnp.int32),
                        seg_stats=seg_stats0,
-                       waste=jnp.zeros(4, jnp.int64),
+                       waste=jnp.zeros(N_WASTE, jnp.int64),
                        evals=jnp.zeros(2, jnp.int64))
     carry = _bank_and_refill(carry, m, lanes)   # initial seeding
     min_active = jnp.int32(int(lanes * min_active_frac))
@@ -1553,7 +1794,7 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
         thresh = jnp.where(queue_left > 0, exit_thresh,
                            jnp.maximum(min_active, suspend_thresh))
         cap = jnp.clip(step_budget - c.steps, 1, seg_iters)
-        new_lanes, si_used, (wa, wd, wr), (se, ce) = run_segment(
+        new_lanes, si_used, (wa, wd, wr, wo), (se, ce) = run_segment(
             c.lanes, thresh, cap)
         live_exit = lanes - jnp.sum((new_lanes.flags & _PARKED) != 0,
                                     dtype=jnp.int32)
@@ -1572,7 +1813,7 @@ def _run_walk(bag: BagState, *, f_ds: Callable, eps: float,
         waste_row = jnp.stack([
             wa, wd,
             jnp.where(queue_left > 0, wr, zq),
-            jnp.where(queue_left > 0, zq, wr)]).astype(jnp.int64)
+            jnp.where(queue_left > 0, zq, wr), wo]).astype(jnp.int64)
         return out._replace(steps=out.steps + si_used,
                             gsegs=out.gsegs + 1, seg_stats=stats,
                             waste=out.waste + waste_row,
@@ -1623,11 +1864,13 @@ def _fresh_lanes(lanes: int) -> WalkState:
         i=zi, d=zi, base_d=zi, fam=zi,
         flags=jnp.full((rows, 128), _PARKED | _NO_ROOT, jnp.int32),
         tasks=zi, splits=zi, maxd=zi,
+        mk_i=zi, mk_d=jnp.full((rows, 128), -1, jnp.int32),
     )
 
 
 def deal_root_bank(bag: BagState, *, refill_slots: int, lanes: int,
-                   min_active, offset=0):
+                   min_active, offset=0, theta_block: int = 1,
+                   theta_table=None):
     """Build the per-lane VMEM root bank from a work-sorted root queue:
     the SHARED bank builder of every in-kernel-refill walk phase (the
     single-chip :func:`_run_walk_kernel_refill` and the demand-driven
@@ -1653,10 +1896,26 @@ def deal_root_bank(bag: BagState, *, refill_slots: int, lanes: int,
     top — window g covers rows [count - offset - W, count - offset).
     It may be a traced scalar (the in-loop shadow deal's cursor), as
     may ``min_active``.
+
+    With ``theta_block`` = T > 1 (round 13) the queue holds THETA-LESS
+    FRONTIER roots and the deal REPLICATES: the top
+    ``min(count, R * lanes/T)`` roots go round-robin over the lanes/T
+    theta GROUPS (root p -> group p % G, slot p // G), each dealt root
+    expanding across its group's T adjacent lanes with per-lane theta
+    from ``theta_table[fam, lane % T]`` ((m, T) f64) and per-lane
+    credit identity fam' = fam * T + (lane % T) in the bank meta — so
+    the kernel's refill machinery and the phase-end segment-sum run
+    UNCHANGED over the expanded ids. ``navail``/``offset`` stay in
+    FRONTIER-root units; the returned ``dealt`` columns are the
+    lane-EXPANDED (R*lanes,) views (the credit and untaken-re-push
+    consumers index them per (slot, lane); expand-pending dedupes to
+    group leaders).
     """
     R = int(refill_slots)
+    T = int(theta_block)
     rows = lanes // 128
-    cap_roots = R * lanes
+    G = lanes // T
+    cap_roots = R * G
     top = bag.count - jnp.asarray(offset, jnp.int32)
     navail = jnp.where(top >= min_active,
                        jnp.minimum(top, cap_roots), 0)
@@ -1688,6 +1947,38 @@ def deal_root_bank(bag: BagState, *, refill_slots: int, lanes: int,
         lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
         return hi.reshape(R, rows, 128), lo.reshape(R, rows, 128)
 
+    if T > 1:
+        # replicate each frontier root across its theta group's T
+        # adjacent lanes: (R*G,) -> (R, G, T) -> (R*lanes,), lane
+        # order g * T + t (row-major), matching the flat lane index
+        def expand(col):
+            return jnp.broadcast_to(
+                col.reshape(R, G, 1), (R, G, T)).reshape(-1)
+
+        dl_e, dr_e = expand(dl), expand(dr)
+        fam_p = dmeta >> DEPTH_BITS                       # (R*G,)
+        dep_p = dmeta & DEPTH_MASK
+        tidx = jnp.arange(T, dtype=jnp.int32)
+        th64 = theta_table.astype(jnp.float64)[
+            fam_p[:, None], tidx[None, :]]                # (R*G, T)
+        dth_e = th64.reshape(-1)
+        famp = fam_p[:, None] * T + tidx[None, :]
+        dmeta_e = ((famp << DEPTH_BITS)
+                   + dep_p[:, None]).reshape(-1)
+        p_e = jnp.arange(R * lanes, dtype=jnp.int32) // T
+        dmeta_e = jnp.where(p_e < navail, dmeta_e, 0)
+        a_h, a_l = to_ds3(dl_e)
+        w_h, w_l = to_ds3(dr_e - dl_e)
+        th_h, th_l = to_ds3(dth_e)
+        bank = (a_h, a_l, w_h, w_l, th_h, th_l,
+                dmeta_e.reshape(R, rows, 128))
+        # round-robin over GROUPS: group g holds ceil((navail - g)/G)
+        # roots; every lane of the group shares the slot count
+        g_ids = jnp.arange(lanes, dtype=jnp.int32) // T
+        nslots = jnp.clip((navail - g_ids + G - 1) // G,
+                          0, R).astype(jnp.int32).reshape(rows, 128)
+        return bank, nslots, navail, (dl_e, dr_e, dth_e, dmeta_e)
+
     a_h, a_l = to_ds3(dl)
     w_h, w_l = to_ds3(dr - dl)
     th_h, th_l = to_ds3(dth)
@@ -1706,7 +1997,8 @@ def _run_walk_kernel_refill(
         exit_frac: float, suspend_frac: float, interpret: bool,
         lanes: int, gsegs0, seg_stats0, rule: Rule = Rule.TRAPEZOID,
         refill_slots: int = 8, scout: bool = False,
-        double_buffer: bool = False):
+        double_buffer: bool = False, theta_block: int = 1,
+        theta_table=None):
     """One walk phase with IN-KERNEL refill (traced inline inside
     :func:`_run_cycles` and, per chip, inside the demand-driven
     multi-chip engine's cycle body — ``sharded_walker.py``; the
@@ -1756,14 +2048,31 @@ def _run_walk_kernel_refill(
     cycle edge like all walker lane state).
     """
     R = int(refill_slots)
+    T = int(theta_block)
+    m_eff = m * T
     run_segment = make_walk_kernel(f_ds, eps, seg_iters,
                                    interpret=interpret, rule=rule,
-                                   refill_slots=R, scout=scout)
+                                   refill_slots=R, scout=scout,
+                                   theta_block=T)
     rows = lanes // 128
     cap_roots = R * lanes
-    min_active = jnp.int32(int(lanes * min_active_frac))
-    suspend_thresh = jnp.int32(int(lanes * suspend_frac))
-    floor = jnp.maximum(min_active, suspend_thresh)
+    if T > 1:
+        # round 13: engagement floors count FRONTIER roots (each feeds
+        # a whole T-lane theta group), and the phase runs every engaged
+        # root to COMPLETION (floor 0) — a theta-mode root suspended
+        # mid-walk would re-enter the bag without its lanes' per-theta
+        # accept markers and double-credit the retired thetas through
+        # the union drain. The step budget (max_segments * seg_iters,
+        # ~5e8 steps at the defaults) is the only remaining bound;
+        # callers must keep it above any real phase.
+        min_active = jnp.int32(max(1, int((lanes // theta_block)
+                                          * min_active_frac)))
+        floor = jnp.int32(0)
+    else:
+        min_active = jnp.int32(int(lanes * min_active_frac))
+        suspend_thresh = jnp.int32(int(lanes * suspend_frac))
+        floor = jnp.maximum(min_active, suspend_thresh)
+    tdiv = jnp.int32(T)
     # refill cadence: top lanes up once ~batch of them have parked —
     # the in-kernel analog of exit_frac's boundary cadence
     batch = jnp.int32(max(lanes - int(lanes * exit_frac), 1))
@@ -1790,18 +2099,20 @@ def _run_walk_kernel_refill(
     if double_buffer:
         validate_double_buffer(double_buffer, R)
         Rh = R // 2
-        half_roots = Rh * lanes
+        half_roots = Rh * lanes          # lane-expanded rows per half
+        half_deal = Rh * (lanes // T)    # FRONTIER roots per half
         # active half (engagement-gated like the single deal), then the
         # first shadow half — dealt only behind a FULL active half so
         # the combined per-lane cursor k -> bank[k] mapping never
         # crosses an empty active slot
         bank_a, nsl_a, navail_a, dealt_a = deal_root_bank(
-            bag, refill_slots=Rh, lanes=lanes, min_active=min_active)
-        gate_s = jnp.where(navail_a == half_roots, jnp.int32(1),
+            bag, refill_slots=Rh, lanes=lanes, min_active=min_active,
+            theta_block=T, theta_table=theta_table)
+        gate_s = jnp.where(navail_a == half_deal, jnp.int32(1),
                            jnp.int32(1 << 30))
         bank_s, nsl_s, navail_s, dealt_s = deal_root_bank(
             bag, refill_slots=Rh, lanes=lanes, min_active=gate_s,
-            offset=navail_a)
+            offset=navail_a, theta_block=T, theta_table=theta_table)
         bank = tuple(jnp.concatenate([a, b])
                      for a, b in zip(bank_a, bank_s))
         nslots0 = nsl_a + nsl_s
@@ -1839,12 +2150,13 @@ def _run_walk_kernel_refill(
                 contrib,
                 resm[0].astype(jnp.float64).reshape(-1)
                 + resm[1].astype(jnp.float64).reshape(-1)])
-            acc_sw = acc_sw + segment_sum_auto(ids, contrib, m,
+            acc_sw = acc_sw + segment_sum_auto(ids, contrib, m_eff,
                                                half_roots + lanes)
             # deal the next shadow window off the sorted queue top
             bank_n, nsl_n, navail_n, dealt_n = deal_root_bank(
                 bag, refill_slots=Rh, lanes=lanes,
-                min_active=jnp.int32(1), offset=consumed)
+                min_active=jnp.int32(1), offset=consumed,
+                theta_block=T, theta_table=theta_table)
             bankc = tuple(jnp.concatenate([b[Rh:], bn])
                           for b, bn in zip(bankc, bank_n))
             # the retiring half was full (swaps require queue
@@ -1871,10 +2183,11 @@ def _run_walk_kernel_refill(
             resl = resl + rl
             live_exit = lanes - _idle_lanes(s2)
             # retired + current cursors is swap-shift invariant, so the
-            # running total is exact across swaps
+            # running total is exact across swaps (lane-expanded units;
+            # /tdiv converts to frontier roots in theta mode)
             taken2 = retired + jnp.sum(slot2, dtype=jnp.int32)
             row = jnp.stack([si, live_exit, top - consumed,
-                             taken2 - taken]).astype(jnp.int32)
+                             (taken2 - taken) // tdiv]).astype(jnp.int32)
             stats = lax.dynamic_update_slice(
                 stats, row[None, :],
                 (jnp.minimum(gsegs, S_CAP - 1), jnp.int32(0)))
@@ -1897,9 +2210,9 @@ def _run_walk_kernel_refill(
          resm) = lax.while_loop(cond, body, (
                 lane0, slot0, resbank0, resbank0, jnp.int32(0),
                 jnp.int32(0), jnp.asarray(gsegs0, jnp.int32),
-                seg_stats0, jnp.int32(0), jnp.zeros(4, jnp.int64),
+                seg_stats0, jnp.int32(0), jnp.zeros(N_WASTE, jnp.int64),
                 jnp.zeros(2, jnp.int64), bank, nslots0, dealt0,
-                consumed0, jnp.int32(0), jnp.zeros(m, jnp.float64),
+                consumed0, jnp.int32(0), jnp.zeros(m_eff, jnp.float64),
                 resm0))
         dl, dr, dth, dmeta = dealt
         navail = consumed
@@ -1908,12 +2221,13 @@ def _run_walk_kernel_refill(
         acc0_phase = acc_sw + segment_sum_auto(
             resm[2].reshape(-1),
             resm[0].astype(jnp.float64).reshape(-1)
-            + resm[1].astype(jnp.float64).reshape(-1), m, lanes)
+            + resm[1].astype(jnp.float64).reshape(-1), m_eff, lanes)
     else:
         # shared bank builder (engagement gate included: a queue below
         # the min_active floor deals nothing, left for the f64 drain)
         bank, nslots, navail, (dl, dr, dth, dmeta) = deal_root_bank(
-            bag, refill_slots=R, lanes=lanes, min_active=min_active)
+            bag, refill_slots=R, lanes=lanes, min_active=min_active,
+            theta_block=T, theta_table=theta_table)
 
         def cond(c):
             s, slot = c[0], c[1]
@@ -1932,8 +2246,8 @@ def _run_walk_kernel_refill(
                 s, slot, floor, cap, batch, nslots, bank, resm)
             live_exit = lanes - _idle_lanes(s2)
             taken2 = jnp.sum(slot2, dtype=jnp.int32)
-            row = jnp.stack([si, live_exit, top - taken,
-                             taken2 - taken]).astype(jnp.int32)
+            row = jnp.stack([si, live_exit, top - taken // tdiv,
+                             (taken2 - taken) // tdiv]).astype(jnp.int32)
             stats = lax.dynamic_update_slice(
                 stats, row[None, :],
                 (jnp.minimum(gsegs, S_CAP - 1), jnp.int32(0)))
@@ -1951,9 +2265,9 @@ def _run_walk_kernel_refill(
          waste, evals) = lax.while_loop(cond, body, (
             lane0, slot0, resbank0, resbank0, resm0, jnp.int32(0),
             jnp.int32(0), jnp.asarray(gsegs0, jnp.int32), seg_stats0,
-            jnp.int32(0), jnp.zeros(4, jnp.int64),
+            jnp.int32(0), jnp.zeros(N_WASTE, jnp.int64),
             jnp.zeros(2, jnp.int64)))
-        acc0_phase = jnp.zeros(m, jnp.float64)
+        acc0_phase = jnp.zeros(m_eff, jnp.float64)
 
     # Phase-end credit, ONE exact segment-sum: completed-root results
     # from the (current) bank (ids from the dealt meta grid) + every
@@ -1971,7 +2285,7 @@ def _run_walk_kernel_refill(
                     + resl.astype(jnp.float64)).reshape(-1)
     ids = jnp.concatenate([s.fam.reshape(-1), dmeta >> DEPTH_BITS])
     contrib = jnp.concatenate([lane_contrib, grid_contrib])
-    acc = acc0_phase + segment_sum_auto(ids, contrib, m,
+    acc = acc0_phase + segment_sum_auto(ids, contrib, m_eff,
                                         lanes + cap_roots)
 
     carry = _WalkCarry(lanes=s, bag=bag, cursor=navail, acc=acc,
@@ -1979,12 +2293,13 @@ def _run_walk_kernel_refill(
                        seg_stats=stats, waste=waste, evals=evals)
     extras = _KernelRefillExtras(slot=slot, nslots=nslots, dealt_l=dl,
                                  dealt_r=dr, dealt_th=dth,
-                                 dealt_meta=dmeta, taken=taken)
+                                 dealt_meta=dmeta, taken=taken // tdiv)
     return carry, extras
 
 
 def _expand_pending(c: _WalkCarry, capacity: int, m: int,
-                    kx: Optional[_KernelRefillExtras] = None) -> BagState:
+                    kx: Optional[_KernelRefillExtras] = None,
+                    theta_block: int = 1) -> BagState:
     """Convert un-walked state back into explicit bag tasks.
 
     Roots were consumed from the TOP of the bred bag (_bank_and_refill,
@@ -2003,8 +2318,17 @@ def _expand_pending(c: _WalkCarry, capacity: int, m: int,
     The caller guarantees the pending-grid row count fits the bag's
     slack region (walker_sizing), so the push window never clamps even
     when the remainder fills the whole capacity.
+
+    With ``theta_block`` = T > 1 (round 13) the lane state is
+    theta-grouped: all T lanes of a group share one (i, d) walk and
+    one slot cursor, so pending nodes and untaken dealt roots are
+    deduped to the GROUP LEADER (lane % T == 0) and pushed back as
+    THETA-LESS frontier rows (fam' // T in the meta, the leader's
+    theta — the slot's representative theta[:, 0] — in the th
+    column). ``m`` is then the expanded m * T accumulator width.
     """
     s = c.lanes
+    T = int(theta_block)
     has_root = ((s.flags & _NO_ROOT) == 0).reshape(-1)
     parked = ((s.flags & _PARKED) != 0).reshape(-1)
     ovf = ((s.flags & _OVF) != 0).reshape(-1)
@@ -2014,6 +2338,18 @@ def _expand_pending(c: _WalkCarry, capacity: int, m: int,
     # retired to _NO_ROOT and have no pending nodes.
     suspended = jnp.logical_or(
         jnp.logical_and(has_root, jnp.logical_not(parked)), ovf)
+    theta_suspended = jnp.zeros((), bool)
+    if T > 1:
+        # theta mode runs every engaged root to completion (floor 0;
+        # OVF force-accepts), so a suspended lane here can only mean
+        # the walk phase's STEP BUDGET expired mid-root — re-walking
+        # its pending nodes would double-credit the thetas already
+        # retired under their markers. Refuse loudly (the flag rides
+        # the engine's overflow path) instead of silently blending.
+        theta_suspended = jnp.any(suspended)
+        n_lanes_f = s.i.size
+        leader = (jnp.arange(n_lanes_f, dtype=jnp.int32) % T) == 0
+        suspended = jnp.logical_and(suspended, leader)
 
     i_l = s.i.reshape(-1)
     d_l = s.d.reshape(-1)
@@ -2044,6 +2380,9 @@ def _expand_pending(c: _WalkCarry, capacity: int, m: int,
     wd = w64[None, :] * pow2_f64(-node_d.astype(jnp.float64))
     ln = a64[None, :] + node_i.astype(jnp.float64) * wd
     rn = ln + wd
+    if T > 1:
+        # re-pushed rows are THETA-LESS frontier tasks: fam' -> slot
+        fam_l = fam_l // T
     meta_n = ((fam_l[None, :] << DEPTH_BITS)
               + jnp.minimum(based[None, :] + node_d, DEPTH_MASK))
     th_n = jnp.broadcast_to(th[None, :], ln.shape)
@@ -2061,11 +2400,19 @@ def _expand_pending(c: _WalkCarry, capacity: int, m: int,
         slot_f = kx.slot.reshape(-1)[None, :]
         nsl_f = kx.nslots.reshape(-1)[None, :]
         valid_u = jnp.logical_and(kk >= slot_f, kk < nsl_f)
+        dealt_meta = kx.dealt_meta.reshape(Rk, n_lanes)
+        if T > 1:
+            # dealt rows are lane-EXPANDED replicas: push each untaken
+            # frontier root once (group leader) with frontier meta
+            leader_u = ((jnp.arange(n_lanes, dtype=jnp.int32) % T)
+                        == 0)[None, :]
+            valid_u = jnp.logical_and(valid_u, leader_u)
+            dealt_meta = (((dealt_meta >> DEPTH_BITS) // T)
+                          << DEPTH_BITS) + (dealt_meta & DEPTH_MASK)
         ln = jnp.concatenate([ln, kx.dealt_l.reshape(Rk, n_lanes)])
         rn = jnp.concatenate([rn, kx.dealt_r.reshape(Rk, n_lanes)])
         th_n = jnp.concatenate([th_n, kx.dealt_th.reshape(Rk, n_lanes)])
-        meta_n = jnp.concatenate(
-            [meta_n, kx.dealt_meta.reshape(Rk, n_lanes)])
+        meta_n = jnp.concatenate([meta_n, dealt_meta])
         valid = jnp.concatenate([valid, valid_u])
 
     # compact the pending grid to a dense prefix (the engine's standard
@@ -2103,8 +2450,120 @@ def _expand_pending(c: _WalkCarry, capacity: int, m: int,
         splits=jnp.zeros((), jnp.int64),
         iters=jnp.zeros((), jnp.int64),
         max_depth=jnp.zeros((), jnp.int32),
-        overflow=n_tasks > capacity,
+        overflow=jnp.logical_or(n_tasks > capacity, theta_suspended),
     )
+
+
+def _theta_bag_round(state: BagState, theta_table, theta_block: int,
+                     f_theta: Callable, eps: float, chunk: int,
+                     capacity: int) -> BagState:
+    """One UNION-REFINEMENT f64 bag round (round 13): the theta-mode
+    twin of :func:`bag_engine.bag_step`. Each popped FRONTIER row
+    evaluates the 3 trapezoid nodes against all T thetas of its slot
+    (``theta_table[fam]``), splits when ANY theta fails its own test,
+    and on acceptance credits every theta its OWN value into the
+    (m * T,) accumulator (ids fam * T + t, exact segment sum). The
+    conservative no-early-retirement rule keeps every pushed row a
+    plain theta-less frontier task — a drained leaf set is the union
+    refinement, at least as refined as each theta's solo run."""
+    T = int(theta_block)
+    m_eff = state.acc.shape[0]
+    n_take = jnp.minimum(state.count, chunk)
+    start = state.count - n_take
+    l = lax.dynamic_slice(state.bag_l, (start,), (chunk,))
+    r = lax.dynamic_slice(state.bag_r, (start,), (chunk,))
+    th = lax.dynamic_slice(state.bag_th, (start,), (chunk,))
+    meta = lax.dynamic_slice(state.bag_meta, (start,), (chunk,))
+    lane = jnp.arange(chunk, dtype=jnp.int32)
+    active = lane < n_take
+
+    fam = meta >> DEPTH_BITS
+    depth = meta & DEPTH_MASK
+    th2 = theta_table.astype(jnp.float64)[
+        jnp.clip(fam, 0, theta_table.shape[0] - 1)]       # (chunk, T)
+
+    mid = (l + r) * 0.5
+    fl = f_theta(l[:, None], th2)
+    fr = f_theta(r[:, None], th2)
+    fm = f_theta(mid[:, None], th2)
+    lrarea = (fl + fr) * ((r - l) * 0.5)[:, None]
+    larea = (fl + fm) * ((mid - l) * 0.5)[:, None]
+    rarea = (fm + fr) * ((r - mid) * 0.5)[:, None]
+    value = larea + rarea
+    err = jnp.abs(value - lrarea)
+    split_t = err > eps                                    # per theta
+    split = jnp.logical_and(jnp.any(split_t, axis=1), active)
+    accept = jnp.logical_and(active, jnp.logical_not(split))
+
+    leaf = jnp.where(accept[:, None], value, 0.0)
+    tids = fam[:, None] * T + jnp.arange(T, dtype=jnp.int32)[None, :]
+    acc = state.acc + segment_sum_auto(
+        tids.reshape(-1), leaf.reshape(-1), m_eff, chunk * T)
+
+    max_depth = jnp.maximum(state.max_depth,
+                            jnp.max(jnp.where(active, depth, 0)))
+
+    # children compaction + push: identical to bag_step (one fused
+    # multi-operand sort, two overlapping child windows)
+    skey = jnp.where(split, meta, meta | ACCEPT_BIT)
+    skey, sl, sr, sth = lax.sort((skey, l, r, th), dimension=0,
+                                 is_stable=True, num_keys=1)
+    smid = (sl + sr) * 0.5
+    ch_meta = (skey & ~ACCEPT_BIT) + 1
+    n_split32 = jnp.sum(split, dtype=jnp.int32)
+    n_children = 2 * n_split32
+    mid_start = start + n_split32
+    bag_l = lax.dynamic_update_slice(state.bag_l, sl, (start,))
+    bag_l = lax.dynamic_update_slice(bag_l, smid, (mid_start,))
+    bag_r = lax.dynamic_update_slice(state.bag_r, smid, (start,))
+    bag_r = lax.dynamic_update_slice(bag_r, sr, (mid_start,))
+    bag_th = lax.dynamic_update_slice(state.bag_th, sth, (start,))
+    bag_th = lax.dynamic_update_slice(bag_th, sth, (mid_start,))
+    bag_meta = lax.dynamic_update_slice(state.bag_meta, ch_meta,
+                                        (start,))
+    bag_meta = lax.dynamic_update_slice(bag_meta, ch_meta,
+                                        (mid_start,))
+    new_count_raw = start + n_children
+    overflow = jnp.logical_or(
+        state.overflow,
+        new_count_raw > jnp.asarray(capacity, jnp.int32))
+    return BagState(
+        bag_l=bag_l, bag_r=bag_r, bag_th=bag_th, bag_meta=bag_meta,
+        count=jnp.minimum(new_count_raw,
+                          jnp.asarray(capacity, jnp.int32)),
+        acc=acc,
+        # per-theta accounting: each popped row is T per-theta tests
+        tasks=state.tasks + n_take.astype(jnp.int64) * T,
+        splits=state.splits + jnp.sum(
+            jnp.logical_and(split_t, active[:, None]),
+            dtype=jnp.int64),
+        iters=state.iters + 1,
+        max_depth=max_depth,
+        overflow=overflow,
+    )
+
+
+def _run_theta_bag(state: BagState, stop_iters=None, *, theta_table,
+                   theta_block: int, f_theta: Callable, eps: float,
+                   chunk: int, capacity: int, max_iters: int,
+                   stop_count: Optional[int] = None) -> BagState:
+    """Theta-mode twin of :func:`bag_engine._run_bag`: union-refinement
+    rounds to empty / stop_count / the dynamic ``stop_iters``."""
+    def cond(s: BagState):
+        live = jnp.logical_and(
+            jnp.logical_and(s.count > 0, jnp.logical_not(s.overflow)),
+            s.iters < max_iters)
+        if stop_count is not None:
+            live = jnp.logical_and(live, s.count < stop_count)
+        if stop_iters is not None:
+            live = jnp.logical_and(live, s.iters < stop_iters)
+        return live
+
+    def body(s: BagState):
+        return _theta_bag_round(s, theta_table, theta_block, f_theta,
+                                eps, chunk, capacity)
+
+    return lax.while_loop(cond, body, state)
 
 
 class _CycleOut(NamedTuple):
@@ -2128,26 +2587,43 @@ def _cycle_once(bag: BagState, *, f_theta: Callable, f_ds: Callable,
                 rule: Rule, sort_roots: bool, refill_slots: int,
                 sort_skip_ratio: float, gsegs0, seg_stats0,
                 scout: bool = False,
-                double_buffer: bool = False) -> _CycleOut:
+                double_buffer: bool = False,
+                theta_block: int = 1, theta_table=None) -> _CycleOut:
     """ONE engine cycle — breed (graduated f64 BFS) -> work-sort ->
     walk (Pallas, in-kernel refill when ``refill_slots`` > 0) ->
     expand -> gated drain — factored out of :func:`_run_cycles` so the
     streaming engine (``runtime/stream.py``) can drive the identical
     per-phase computation one cycle at a time with admission/retirement
-    at the host boundary between calls."""
+    at the host boundary between calls.
+
+    With ``theta_block`` = T > 1 (round 13) the bag holds THETA-LESS
+    frontier rows: breeding is SPLIT-ONLY (eps = -1 forces every popped
+    row to split until the root target is met — splitting is always a
+    refinement, and a breed-accept scored on one representative theta
+    could strand another theta above its eps), the walk phase is the
+    theta-grouped union-refinement kernel, and the drain is the
+    union-refinement f64 twin (:func:`_theta_bag_round`). ``m`` stays
+    the FRONTIER slot count; accumulators are (m * T,)."""
     # Graduated breed: a BFS round costs O(chunk) emulated-f64
     # integrand evals and an O(chunk log chunk) sort REGARDLESS of
     # the live frontier (masked lanes still evaluate), so grow the
     # frontier through rising chunk widths — each phase's waste is
     # bounded ~2x instead of the 2^19-wide rounds evaluating 97%
     # dead lanes while the frontier was 16k.
+    breed_eps = -1.0 if theta_block > 1 else eps
+    if theta_block > 1:
+        # split-only breeding must not outrun one deal per phase — the
+        # shared runaway-queue clamp (theta_breed_target docstring)
+        target = theta_breed_target(target, refill_slots, lanes,
+                                    theta_block)
     bred = bag
     for pc in (1 << 14, 1 << 16, 1 << 18):
         if pc < breed_chunk:
-            bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=pc,
-                          capacity=capacity,
+            bred = _breed(bred, f_theta=f_theta, eps=breed_eps,
+                          chunk=pc, capacity=capacity,
                           target=min(pc // 2, target), rule=rule)
-    bred = _breed(bred, f_theta=f_theta, eps=eps, chunk=breed_chunk,
+    bred = _breed(bred, f_theta=f_theta, eps=breed_eps,
+                  chunk=breed_chunk,
                   capacity=capacity, target=target, rule=rule)
     if sort_roots:
         bred, srows_d = _order_roots_by_work(
@@ -2166,25 +2642,43 @@ def _cycle_once(bag: BagState, *, f_theta: Callable, f_ds: Callable,
     if refill_slots:
         walk, kx = _run_walk_kernel_refill(
             bred, refill_slots=refill_slots,
-            double_buffer=double_buffer, **wkw)
+            double_buffer=double_buffer, theta_block=theta_block,
+            theta_table=theta_table, **wkw)
         roots_taken = kx.taken.astype(jnp.int64)
     else:
         walk = _run_walk(bred, **wkw)
         kx = None
         roots_taken = walk.cursor.astype(jnp.int64)
-    bag2 = _expand_pending(walk, capacity, m, kx)
+    m_eff = m * int(theta_block)
+    bag2 = _expand_pending(walk, capacity, m_eff, kx,
+                           theta_block=theta_block)
 
     # Drain in f64 ONLY below the walker's own engagement threshold
     # (walk's cond would refuse to run there, so the cycle loop could
     # not make progress); see _run_cycles' drain note for the
-    # stop_count=target rationale.
-    def drain(b: BagState):
-        return _run_bag(b, f_theta=f_theta, eps=eps,
-                        rule=rule, chunk=breed_chunk,
-                        capacity=capacity, max_iters=1 << 20,
-                        stop_count=target)
+    # stop_count=target rationale. Theta mode drains through the
+    # union-refinement twin with the pop width clamped so the exact
+    # segment sum's chunk * T rows stay within its length bound.
+    if theta_block > 1:
+        tchunk = theta_drain_chunk(breed_chunk, theta_block)
 
-    min_active = max(1, int(lanes * min_active_frac))
+        def drain(b: BagState):
+            return _run_theta_bag(
+                b, theta_table=theta_table, theta_block=theta_block,
+                f_theta=f_theta, eps=eps, chunk=tchunk,
+                capacity=capacity, max_iters=1 << 20,
+                stop_count=target)
+
+        min_active = max(1, int((lanes // theta_block)
+                                * min_active_frac))
+    else:
+        def drain(b: BagState):
+            return _run_bag(b, f_theta=f_theta, eps=eps,
+                            rule=rule, chunk=breed_chunk,
+                            capacity=capacity, max_iters=1 << 20,
+                            stop_count=target)
+
+        min_active = max(1, int(lanes * min_active_frac))
     bag3 = lax.cond(bag2.count < min_active, drain, lambda b: b, bag2)
     return _CycleOut(bred=bred, walk=walk, bag3=bag3,
                      bag2_count=bag2.count, roots_taken=roots_taken,
@@ -2221,8 +2715,10 @@ class _CycleCarry(NamedTuple):
                      "interpret",
                      "lanes", "capacity", "breed_chunk", "target",
                      "max_cycles", "rule", "sort_roots", "refill_slots",
-                     "sort_skip_ratio", "scout", "double_buffer"))
-def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
+                     "sort_skip_ratio", "scout", "double_buffer",
+                     "theta_block"))
+def _run_cycles(bag: BagState, acc0=None, theta_table=None, *,
+                f_theta: Callable,
                 f_ds: Callable,
                 eps: float, m: int, seg_iters: int, max_segments: int,
                 min_active_frac: float, exit_frac: float,
@@ -2235,7 +2731,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
                 refill_slots: int = 0,
                 sort_skip_ratio: float = 8.0,
                 scout: bool = False,
-                double_buffer: bool = False) -> _CycleCarry:
+                double_buffer: bool = False,
+                theta_block: int = 1) -> _CycleCarry:
     """The full engine as ONE device program:
 
         while bag not empty:
@@ -2274,7 +2771,8 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
             rule=rule, sort_roots=sort_roots, refill_slots=refill_slots,
             sort_skip_ratio=sort_skip_ratio,
             gsegs0=c.segs.astype(jnp.int32), seg_stats0=c.seg_stats,
-            scout=scout, double_buffer=double_buffer)
+            scout=scout, double_buffer=double_buffer,
+            theta_block=theta_block, theta_table=theta_table)
         bred, walk, bag3 = o.bred, o.walk, o.bag3
         roots_taken, srows_d = o.roots_taken, o.srows
 
@@ -2330,10 +2828,11 @@ def _run_cycles(bag: BagState, acc0=None, *, f_theta: Callable,
     # nothing and stays bit-identical to the fused run.
     init = _CycleCarry(
         bag=bag,
-        acc=acc0 if acc0 is not None else jnp.zeros(m, jnp.float64),
+        acc=acc0 if acc0 is not None
+        else jnp.zeros(m * theta_block, jnp.float64),
         tasks=z64, splits=z64, btasks=z64, wtasks=z64, wsplits=z64,
         roots=z64, rounds=z64, segs=z64, wsteps=z64, srows=z64,
-        waste=jnp.zeros(4, jnp.int64),
+        waste=jnp.zeros(N_WASTE, jnp.int64),
         sevals=z64, cevals=z64,
         maxd=jnp.zeros((), jnp.int32), cycles=jnp.zeros((), jnp.int32),
         overflow=jnp.zeros((), bool),
@@ -2404,8 +2903,9 @@ class StreamCycleOut(NamedTuple):
                      "suspend_frac", "interpret", "lanes", "capacity",
                      "breed_chunk", "target", "rule", "sort_roots",
                      "refill_slots", "sort_skip_ratio", "f64_rounds",
-                     "scout", "double_buffer"))
-def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
+                     "scout", "double_buffer", "theta_block"))
+def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase,
+                     theta_table=None, *,
                      f_theta: Callable, f_ds: Callable, eps: float,
                      m: int, seg_iters: int, max_segments: int,
                      min_active_frac: float, exit_frac: float,
@@ -2415,7 +2915,8 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
                      sort_roots: bool = True, refill_slots: int = 0,
                      sort_skip_ratio: float = 8.0,
                      f64_rounds: int = 0, scout: bool = False,
-                     double_buffer: bool = False) -> StreamCycleOut:
+                     double_buffer: bool = False,
+                     theta_block: int = 1) -> StreamCycleOut:
     """ONE phase of the streaming walker: the identical
     breed -> sort -> walk -> expand -> drain cycle of :func:`_run_cycles`
     (via the shared :func:`_cycle_once`), plus the streaming surface —
@@ -2445,15 +2946,23 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
     also the no-Pallas fallback for hosts where the kernel cannot run.
     """
     if f64_rounds:
-        bag3 = _run_bag(bag, jnp.asarray(f64_rounds, jnp.int64),
-                        f_theta=f_theta, eps=eps, rule=rule,
-                        chunk=breed_chunk, capacity=capacity,
-                        max_iters=1 << 20)
+        if theta_block > 1:
+            bag3 = _run_theta_bag(
+                bag, jnp.asarray(f64_rounds, jnp.int64),
+                theta_table=theta_table, theta_block=theta_block,
+                f_theta=f_theta, eps=eps,
+                chunk=theta_drain_chunk(breed_chunk, theta_block),
+                capacity=capacity, max_iters=1 << 20)
+        else:
+            bag3 = _run_bag(bag, jnp.asarray(f64_rounds, jnp.int64),
+                            f_theta=f_theta, eps=eps, rule=rule,
+                            chunk=breed_chunk, capacity=capacity,
+                            max_iters=1 << 20)
         credit = bag3.acc
         z64 = jnp.zeros((), jnp.int64)
         wt, ws, roots_taken, srows = z64, z64, z64, z64
         segs, wsteps = z64, z64
-        waste4 = jnp.zeros(4, jnp.int64)   # no kernel, no lane-cycles
+        waste4 = jnp.zeros(N_WASTE, jnp.int64)  # no kernel lane-cycles
         evals2 = jnp.zeros(2, jnp.int64)
         bag_tasks = bag3.tasks
         bag_splits = bag3.splits
@@ -2473,7 +2982,8 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
             gsegs0=jnp.int32(0),
             seg_stats0=jnp.zeros((S_CAP, len(SEG_STAT_FIELDS)),
                                  jnp.int32),
-            scout=scout, double_buffer=double_buffer)
+            scout=scout, double_buffer=double_buffer,
+            theta_block=theta_block, theta_table=theta_table)
         bred, walk, bag3 = o.bred, o.walk, o.bag3
         # this phase's exact per-family credit, folded into the running
         # compensated accumulator (never reassociated across phases)
@@ -2498,7 +3008,12 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
 
     fam_live = family_live_counts(bag3, m)
     phase = jnp.asarray(phase, jnp.int32)
-    fam_last2 = jnp.where(credit != 0.0, phase, fam_last)
+    # fam_last is per-SLOT; theta mode reduces the (m * T,) credit to
+    # a per-slot any-theta-credited mark
+    credited = credit != 0.0
+    if theta_block > 1:
+        credited = jnp.any(credited.reshape(m, theta_block), axis=1)
+    fam_last2 = jnp.where(credited, phase, fam_last)
 
     stats = jnp.concatenate([jnp.stack([
         bag_tasks + wt, bag_tasks, wt, ws, roots_taken,
@@ -2525,7 +3040,7 @@ def run_stream_cycle(bag: BagState, acc, acc_c, fam_last, phase, *,
 
 
 def walker_sizing(lanes: int, roots_per_lane: int, capacity: int,
-                  chunk: int):
+                  chunk: int, theta_block: int = 1):
     """Shared store sizing for the walker engines — the single source of
     truth for integrate/resume/sharded/bench seed-state construction.
 
@@ -2537,8 +3052,15 @@ def walker_sizing(lanes: int, roots_per_lane: int, capacity: int,
     rows under kernel refill (refill_slots <= roots_per_lane is
     enforced), and the slack covers it in BOTH refill modes so one
     prebuilt seed state serves either.
+
+    With ``theta_block`` = T > 1 each frontier root feeds a whole
+    T-lane theta group, so the breed target scales down to
+    ``roots_per_lane * lanes / T`` — the queue counts FRONTIER roots.
+    The slack formula keeps its lane-based worst case (the pending
+    grid's static row count is lane-shaped regardless of T).
     """
-    target = min(roots_per_lane * lanes, capacity // 2)
+    target = min(roots_per_lane * (lanes // int(theta_block)),
+                 capacity // 2)
     breed_chunk = max(1 << int(target - 1).bit_length(), chunk)
     slack_chunk = max(
         breed_chunk,
@@ -2549,7 +3071,8 @@ def walker_sizing(lanes: int, roots_per_lane: int, capacity: int,
 def seed_family_walker_state(theta, bounds, *, chunk: int = 1 << 15,
                              capacity: int = 1 << 23,
                              lanes: int = DEFAULT_LANES,
-                             roots_per_lane: int = 12) -> BagState:
+                             roots_per_lane: int = 12,
+                             theta_block: int = 1) -> BagState:
     """Build the walker's initial seed bag ONCE for reuse across repeated
     runs of the same problem (pass as ``_state_override=`` to
     :func:`dispatch_family_walker`).
@@ -2562,14 +3085,15 @@ def seed_family_walker_state(theta, bounds, *, chunk: int = 1 << 15,
     measured round 5), so per-dispatch seed construction was the
     dominant cost of the round-4 bench pipeline.
     """
-    theta = np.asarray(theta, dtype=np.float64)
-    m = theta.shape[0]
+    theta2d, rep_theta = normalize_theta_batch(theta, theta_block)
+    m = theta2d.shape[0]
     bounds = np.asarray(bounds, dtype=np.float64)
     if bounds.ndim == 1:
         bounds = np.tile(bounds.reshape(1, 2), (m, 1))
     _, _, slack_chunk = walker_sizing(lanes, roots_per_lane, capacity,
-                                      chunk)
-    return initial_bag(bounds, capacity, m, slack_chunk, theta=theta)
+                                      chunk, theta_block)
+    return initial_bag(bounds, capacity, m * int(theta_block),
+                       slack_chunk, theta=rep_theta)
 
 
 @dataclasses.dataclass
@@ -2729,6 +3253,7 @@ class WalkerDispatch(NamedTuple):
     lanes: int
     rule: Rule = Rule.TRAPEZOID
     refill_slots: int = 0
+    theta_block: int = 1
 
 
 # NOTE on pipelined wall times: a WalkerDispatch's t0 is its DISPATCH
@@ -2787,6 +3312,14 @@ def integrate_family_walker(
         #                             refill deal (_run_walk_kernel_
         #                             refill docstring); requires an
         #                             even refill_slots >= 2
+        theta_block: int = 1,       # round 13: T > 1 makes theta a
+        #                             vectorized minor axis — theta is
+        #                             (m, T), groups of T adjacent
+        #                             lanes share one union-refinement
+        #                             walk, areas come back (m, T).
+        #                             Requires refill_slots > 0 and
+        #                             the trapezoid rule
+        #                             (validate_theta_block)
         interpret: Optional[bool] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 1,
@@ -2840,16 +3373,21 @@ def integrate_family_walker(
     validate_double_buffer(double_buffer, refill_slots)
     exit_frac, suspend_frac = resolve_cadence(exit_frac, suspend_frac,
                                               scout, refill_slots)
-    theta = np.asarray(theta, dtype=np.float64)
-    m = theta.shape[0]
+    theta2d, rep_theta = normalize_theta_batch(theta, theta_block)
+    m = theta2d.shape[0]
+    theta_block = validate_theta_block(
+        theta_block, lanes=lanes, refill_slots=refill_slots,
+        rule=rule, m=m)
     bounds = np.asarray(bounds, dtype=np.float64)
     if bounds.ndim == 1:
         bounds = np.tile(bounds.reshape(1, 2), (m, 1))
     # ds transcendentals are valid only inside their Cody-Waite ranges;
     # outside they return silently wrong values (VERDICT r3 #6) —
-    # refuse up front rather than report a plausible-looking area.
+    # refuse up front rather than report a plausible-looking area;
+    # theta mode checks EVERY theta of every slot against its bounds.
     from ppls_tpu.models.integrands import check_ds_domain
-    check_ds_domain(f_ds, bounds, theta)
+    check_ds_domain(f_ds, np.repeat(bounds, theta_block, axis=0),
+                    theta2d.reshape(-1))
 
     # Breeding pops the WHOLE bag each iteration (chunk >= target:
     # breadth-first, the frontier doubles per round) — a plain LIFO
@@ -2862,7 +3400,8 @@ def integrate_family_walker(
     # the dynamic_update_slice would clamp its start and corrupt live
     # entries. Slack is memory only; bag_step never pops past `capacity`.
     target, breed_chunk, slack_chunk = walker_sizing(
-        lanes, roots_per_lane, capacity, chunk)
+        lanes, roots_per_lane, capacity, chunk, theta_block)
+    theta_dev = (jnp.asarray(theta2d) if theta_block > 1 else None)
 
     t0 = time.perf_counter()
     if _state_override is not None:
@@ -2880,7 +3419,8 @@ def integrate_family_walker(
                 f"with seed_family_walker_state using the SAME chunk/"
                 f"capacity/lanes/roots_per_lane as the run")
     else:
-        state = initial_bag(bounds, capacity, m, slack_chunk, theta=theta)
+        state = initial_bag(bounds, capacity, m * theta_block,
+                            slack_chunk, theta=rep_theta)
     kw = dict(f_theta=f_theta, f_ds=f_ds, eps=float(eps),
               m=m, seg_iters=int(seg_iters),
               max_segments=int(max_segments),
@@ -2893,12 +3433,15 @@ def integrate_family_walker(
               sort_roots=bool(sort_roots),
               refill_slots=int(refill_slots),
               sort_skip_ratio=float(sort_skip_ratio),
-              scout=bool(scout), double_buffer=bool(double_buffer))
+              scout=bool(scout), double_buffer=bool(double_buffer),
+              theta_block=int(theta_block))
     if checkpoint_path is None:
-        out = _run_cycles(state, max_cycles=int(max_cycles), **kw)
+        out = _run_cycles(state, theta_table=theta_dev,
+                          max_cycles=int(max_cycles), **kw)
         d = WalkerDispatch(out=out, t0=t0, lanes=int(lanes),
                            rule=Rule(rule),
-                           refill_slots=int(refill_slots))
+                           refill_slots=int(refill_slots),
+                           theta_block=int(theta_block))
         return d if _dispatch_only else collect_family_walker(d)
     else:
         from ppls_tpu.parallel.bag_engine import _family_ckpt_identity
@@ -2907,7 +3450,7 @@ def integrate_family_walker(
         from ppls_tpu.runtime.checkpoint import engine_name
         identity = _family_ckpt_identity(engine_name("walker", rule),
                                          f_theta, float(eps),
-                                         m, theta, bounds)
+                                         m, theta2d, bounds)
         # round 12: the scout/double-buffer/reduced-twin schedules
         # differ from the plain refill schedule (different split
         # decisions inside the guard band / different phase structure /
@@ -2920,9 +3463,14 @@ def integrate_family_walker(
             identity["double_buffer"] = True
         if _is_reduced_twin(f_ds):
             identity["reduced"] = True
+        if theta_block > 1:
+            # round 13: the theta-batched schedule (union votes,
+            # grouped deal, (m, T) accumulator layout) is checkpoint
+            # identity; conditional key keeps old snapshots loadable
+            identity["theta_block"] = int(theta_block)
         tot = dict(tasks=0, splits=0, btasks=0, wtasks=0, wsplits=0,
                    roots=0, rounds=0, segs=0, wsteps=0, srows=0,
-                   max_depth=0, cycles=0, waste=[0, 0, 0, 0],
+                   max_depth=0, cycles=0, waste=[0] * N_WASTE,
                    sevals=0, cevals=0)
         if _totals_override is not None:
             # the accumulator re-enters the DEVICE addition chain via
@@ -2930,14 +3478,16 @@ def integrate_family_walker(
             acc_dev = jnp.asarray(
                 np.array(_totals_override.pop("acc"), dtype=np.float64))
             tot.update(_totals_override)
+            w = list(tot["waste"])
+            tot["waste"] = w + [0] * (N_WASTE - len(w))
         else:
-            acc_dev = jnp.zeros(m, jnp.float64)
+            acc_dev = jnp.zeros(m * theta_block, jnp.float64)
         legs = 0
         bag = state
         leg_seg_stats = []
         leg_cyc_stats = []
         while True:
-            out = _run_cycles(bag, acc_dev,
+            out = _run_cycles(bag, acc_dev, theta_table=theta_dev,
                               max_cycles=int(checkpoint_every), **kw)
             (l_tasks, l_splits, l_bt, l_wt, l_ws, l_roots,
              l_rounds, l_segs, l_wst, l_srows, l_maxd, l_cycles, l_ovf,
@@ -2999,21 +3549,30 @@ def integrate_family_walker(
         acc, dict(tot),
         left=left, overflow=overflow, wall=wall, lanes=lanes,
         seg_stats=seg_stats_np, cyc_stats=cyc_stats_np, rule=Rule(rule),
-        refill_slots=int(refill_slots), checkpoint_path=checkpoint_path)
+        refill_slots=int(refill_slots), checkpoint_path=checkpoint_path,
+        theta_block=int(theta_block))
 
 
 def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
                      seg_stats, cyc_stats, rule: Rule = Rule.TRAPEZOID,
                      refill_slots: int = 0,
-                     checkpoint_path=None) -> WalkerResult:
+                     checkpoint_path=None,
+                     theta_block: int = 1) -> WalkerResult:
     """Validate a finished run and build its :class:`WalkerResult`."""
     if bool(overflow):
-        raise RuntimeError("walker bag overflowed; raise capacity")
+        raise RuntimeError(
+            "walker bag overflowed; raise capacity (on theta_block "
+            "runs this also fires when a walk phase's step budget "
+            "expired mid-root — raise max_segments/seg_iters; see "
+            "_expand_pending's theta-suspension note)")
     if int(left) > 0:
         raise RuntimeError(
             f"walker did not converge in {int(tot['cycles'])} cycles "
             f"({int(left)} tasks left); raise max_cycles")
     acc = np.asarray(acc)
+    if theta_block > 1:
+        # (m, T): one row of per-user areas per frontier slot
+        acc = acc.reshape(-1, int(theta_block))
     if not np.all(np.isfinite(acc)):
         bad = int(np.sum(~np.isfinite(acc)))
         raise FloatingPointError(
@@ -3029,8 +3588,12 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
     segs = int(tot["segs"])
     roots = int(tot["roots"])
     srows = int(tot.get("srows", 0))
-    waste_arr = np.asarray(tot.get("waste", [0, 0, 0, 0]),
-                           dtype=np.int64)
+    waste_arr = np.asarray(
+        list(tot.get("waste", [])) or [0] * N_WASTE, dtype=np.int64)
+    if waste_arr.shape[0] < N_WASTE:   # pre-round-13 snapshots: 4
+        waste_arr = np.concatenate(
+            [waste_arr,
+             np.zeros(N_WASTE - waste_arr.shape[0], np.int64)])
     sevals = int(tot.get("sevals", 0))
     cevals = int(tot.get("cevals", 0))
     # Round 12: the walker's integrand-eval count is DEVICE-COUNTED —
@@ -3141,7 +3704,8 @@ def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
         left=left, overflow=overflow,
         wall=time.perf_counter() - d.t0, lanes=d.lanes, rule=d.rule,
         refill_slots=d.refill_slots,
-        seg_stats=seg_stats_np, cyc_stats=cyc_stats_np)
+        seg_stats=seg_stats_np, cyc_stats=cyc_stats_np,
+        theta_block=d.theta_block)
 
 
 def dispatch_family_walker(
@@ -3183,6 +3747,7 @@ def resume_family_walker(
         sort_skip_ratio: float = 8.0,
         scout_dtype: Optional[str] = None,
         double_buffer: bool = False,
+        theta_block: int = 1,
         interpret: Optional[bool] = None,
         checkpoint_every: int = 1) -> WalkerResult:
     """Continue an interrupted checkpointed walker run from its last
@@ -3192,14 +3757,15 @@ def resume_family_walker(
                                               _restore_bag)
     from ppls_tpu.runtime.checkpoint import load_family_checkpoint
 
-    theta_np = np.asarray(theta, dtype=np.float64)
-    m = theta_np.shape[0]
+    theta2d, rep_theta = normalize_theta_batch(theta, theta_block)
+    m = theta2d.shape[0]
+    m_eff = m * int(theta_block)
     bounds_np = np.asarray(bounds, dtype=np.float64)
     if bounds_np.ndim == 1:
         bounds_np = np.tile(bounds_np.reshape(1, 2), (m, 1))
     from ppls_tpu.runtime.checkpoint import engine_name
     identity = _family_ckpt_identity(engine_name("walker", rule), f_theta,
-                                     float(eps), m, theta_np, bounds_np)
+                                     float(eps), m, theta2d, bounds_np)
     # mode keys mirror integrate_family_walker's snapshot identity
     if resolve_scout_dtype(scout_dtype, rule):
         identity["scout"] = True
@@ -3207,14 +3773,17 @@ def resume_family_walker(
         identity["double_buffer"] = True
     if _is_reduced_twin(f_ds):
         identity["reduced"] = True
+    if int(theta_block) > 1:
+        identity["theta_block"] = int(theta_block)
     bag_cols, count, acc, totals = load_family_checkpoint(path, identity)
 
     # same store sizing as integrate_family_walker
     target, breed_chunk, slack_chunk = walker_sizing(
-        lanes, roots_per_lane, capacity, chunk)
-    fresh = initial_bag(bounds_np, capacity, m, slack_chunk, theta=theta_np)
+        lanes, roots_per_lane, capacity, chunk, theta_block)
+    fresh = initial_bag(bounds_np, capacity, m_eff, slack_chunk,
+                        theta=rep_theta)
     state = _restore_bag(
-        fresh, bag_cols, count, acc=np.zeros(m, np.float64),
+        fresh, bag_cols, count, acc=np.zeros(m_eff, np.float64),
         totals={"tasks": 0, "splits": 0, "iters": 0, "max_depth": 0})
     totals = dict(totals)
     # snapshots from before the adaptive-segment change lack "wsteps";
@@ -3226,7 +3795,11 @@ def resume_family_walker(
     totals.setdefault("srows", 0)
     # ... and pre-round-11 snapshots lack the lane-waste buckets: zeros
     # keep the attribution honest-empty instead of failing the resume
-    totals.setdefault("waste", [0, 0, 0, 0])
+    # (pre-round-13 snapshots carry 4 buckets: pad the theta_overwalk
+    # tail with zero)
+    totals.setdefault("waste", [0] * N_WASTE)
+    totals["waste"] = list(totals["waste"]) + [0] * (
+        N_WASTE - len(totals["waste"]))
     # pre-round-12 snapshots lack the device eval counters: zeros make
     # _assemble_result fall back to the flagged host-side estimate
     totals.setdefault("sevals", 0)
@@ -3246,7 +3819,7 @@ def resume_family_walker(
         max_cycles=max_cycles, rule=rule, sort_roots=sort_roots,
         refill_slots=refill_slots, sort_skip_ratio=sort_skip_ratio,
         scout_dtype=scout_dtype, double_buffer=double_buffer,
-        interpret=interpret,
+        theta_block=theta_block, interpret=interpret,
         checkpoint_path=path, checkpoint_every=checkpoint_every,
         _state_override=state, _totals_override=totals)
 

@@ -76,8 +76,11 @@ from ppls_tpu.obs.telemetry import Telemetry
 from ppls_tpu.parallel.bag_engine import DEPTH_BITS, BagState
 from ppls_tpu.parallel.walker import (
     DEFAULT_LANES,
+    N_WASTE,
     STREAM_STAT_FIELDS,
+    normalize_theta_batch,
     run_stream_cycle,
+    validate_theta_block,
     walker_sizing,
 )
 
@@ -90,13 +93,21 @@ _COUNTER_STATS = tuple(k for k in STREAM_STAT_FIELDS if k != "maxd")
 
 @dataclasses.dataclass
 class StreamRequest:
-    """One pending integration request (one 1D integral)."""
+    """One pending integration request: one 1D integral (scalar
+    ``theta``), or — on a ``theta_block`` > 1 engine (round 13) — a
+    THETA BATCH: up to T per-user thetas scored over one shared
+    union-refinement frontier (``theta`` is then a tuple)."""
 
     rid: int
-    theta: float
+    theta: object                 # float, or tuple of floats (batch)
     bounds: Tuple[float, float]
     submit_phase: int
     submit_t: float
+
+    @property
+    def thetas(self) -> Tuple[float, ...]:
+        t = self.theta
+        return tuple(t) if isinstance(t, (tuple, list)) else (float(t),)
 
 
 @dataclasses.dataclass
@@ -111,15 +122,19 @@ class CompletedRequest:
     """
 
     rid: int
-    theta: float
+    theta: object
     bounds: Tuple[float, float]
-    area: float
+    area: float               # scalar requests; first theta on batches
     submit_phase: int
     admit_phase: int
     retire_phase: int
     latency_s: float
     first_seeded_phase: int
     last_credited_phase: int
+    # round 13 (theta_block > 1): the request's per-theta areas, in
+    # submission order (len == len(request.theta)); None on scalar
+    # engines so pre-round-13 snapshots replay unchanged
+    areas: Optional[List[float]] = None
 
     @property
     def phases_in_flight(self) -> int:
@@ -237,11 +252,15 @@ def _admit_program(bag: BagState, acc, acc_c, fam_last,
     count = start + n_new
     overflow = jnp.logical_or(
         bag.overflow, count > jnp.asarray(capacity, jnp.int32))
+    # round 13: on a theta-blocked engine the accumulator pair is
+    # (slots * T,) while the clear mask stays per-slot — expand it
+    clear_acc = (jnp.repeat(clear, acc.shape[0] // clear.shape[0])
+                 if acc.shape[0] != clear.shape[0] else clear)
     return (bag._replace(bag_l=bag_l, bag_r=bag_r, bag_th=bag_th,
                          bag_meta=bag_meta, count=count,
                          overflow=overflow),
-            jnp.where(clear, 0.0, acc),
-            jnp.where(clear, 0.0, acc_c),
+            jnp.where(clear_acc, 0.0, acc),
+            jnp.where(clear_acc, 0.0, acc_c),
             jnp.where(clear, jnp.int32(-1), fam_last))
 
 
@@ -298,6 +317,7 @@ class StreamEngine:
                  scout_dtype: Optional[str] = None,
                  double_buffer: bool = False,
                  reduced_integrands: bool = False,
+                 theta_block: int = 1,
                  admit_window: Optional[int] = None,
                  interpret: Optional[bool] = None,
                  engine: str = "walker",
@@ -336,6 +356,12 @@ class StreamEngine:
         from ppls_tpu.parallel.walker import resolve_cadence
         exit_frac, suspend_frac = resolve_cadence(
             exit_frac, suspend_frac, self._scout, refill_slots)
+        # theta_block composes with f64_rounds (the pure-f64 streaming
+        # mode runs the union-refinement bag twin); scouting is the
+        # only mode conflict, checked above
+        self._theta_block = validate_theta_block(
+            theta_block, lanes=int(lanes), refill_slots=refill_slots,
+            rule=rule, m=slots)
         self.family = family
         self.f_theta = get_family(family)
         self.f_ds = get_family_ds(family,
@@ -349,7 +375,8 @@ class StreamEngine:
         self.lanes = int(lanes)
         self.interpret = bool(interpret)
         target, breed_chunk, slack_chunk = walker_sizing(
-            lanes, roots_per_lane, capacity, chunk)
+            lanes, roots_per_lane, capacity, chunk,
+            self._theta_block)
         self._store = capacity + 2 * slack_chunk
         self._capacity = int(capacity)
         self._chunk = int(chunk)
@@ -369,7 +396,8 @@ class StreamEngine:
             refill_slots=int(refill_slots),
             sort_skip_ratio=float(sort_skip_ratio),
             f64_rounds=int(f64_rounds),
-            scout=self._scout, double_buffer=self._double_buffer)
+            scout=self._scout, double_buffer=self._double_buffer,
+            theta_block=self._theta_block)
         # admit window: fixed seed-array width (one compiled admit
         # program); capped by the store slack so the push never clamps
         aw = slots if admit_window is None else int(admit_window)
@@ -464,22 +492,44 @@ class StreamEngine:
             ident["double_buffer"] = True
         if self._reduced:
             ident["reduced"] = True
+        if self._theta_block > 1:
+            ident["theta_block"] = int(self._theta_block)
         return ident
 
     # ------------------------------------------------------------------
     # request intake
     # ------------------------------------------------------------------
 
-    def submit(self, theta: float, bounds) -> int:
-        """Queue one integration request; returns its request id."""
+    def submit(self, theta, bounds) -> int:
+        """Queue one integration request; returns its request id.
+
+        On a ``theta_block`` = T > 1 engine (round 13) ``theta`` may be
+        a sequence of up to T per-user thetas — the request becomes a
+        THETA BATCH scored over one shared union-refinement frontier,
+        retiring with per-theta areas (``CompletedRequest.areas``).
+        Scalar theta stays valid on every engine."""
         from ppls_tpu.models.integrands import check_ds_domain
         bounds = (float(bounds[0]), float(bounds[1]))
-        check_ds_domain(self.f_ds, np.array([bounds]),
-                        np.array([float(theta)]))
+        if isinstance(theta, (tuple, list, np.ndarray)):
+            thetas = tuple(float(t) for t in np.asarray(theta).reshape(-1))
+            if not thetas:
+                raise ValueError("empty theta batch")
+            if len(thetas) > self._theta_block:
+                raise ValueError(
+                    f"theta batch of {len(thetas)} exceeds this "
+                    f"engine's theta_block={self._theta_block}")
+            theta_store = thetas if self._theta_block > 1 \
+                else thetas[0]
+        else:
+            thetas = (float(theta),)
+            theta_store = float(theta)
+        check_ds_domain(self.f_ds,
+                        np.tile(np.array([bounds]), (len(thetas), 1)),
+                        np.array(thetas))
         rid = self._next_rid
         self._next_rid += 1
         self._pending.append(StreamRequest(
-            rid=rid, theta=float(theta), bounds=bounds,
+            rid=rid, theta=theta_store, bounds=bounds,
             submit_phase=self.phase, submit_t=time.perf_counter()))
         return rid
 
@@ -512,7 +562,12 @@ class StreamEngine:
         if self._dev is not None:
             return
         fill_x = 0.5 * (first.bounds[0] + first.bounds[1])
-        self._fill = (float(fill_x), float(first.theta))
+        fill_th = float(first.thetas[0])
+        self._fill = (float(fill_x), fill_th)
+        # round 13: per-slot theta rows; recycled rows are overwritten
+        # at admission, un-admitted rows keep the benign fill theta
+        self._theta_table = np.full(
+            (self.slots, self._theta_block), fill_th, dtype=np.float64)
         self._build_store()
 
     def _build_dd_store(self):
@@ -548,9 +603,10 @@ class StreamEngine:
             self.rule, ck["sort_roots"], ck["sort_skip_ratio"],
             self._refill_slots, int(reshard_window), admit_window=aw,
             scout=self._scout, double_buffer=self._double_buffer,
-            reduced=self._reduced)
+            reduced=self._reduced, theta_block=self._theta_block)
         self._dd_store = store
         self._dd_n_dev = n_dev
+        m_eff = self.slots * self._theta_block
         z64 = jnp.zeros(n_dev, jnp.int64)
         self._dd_state = (
             jnp.full((n_dev * store,), fill_x, jnp.float64),
@@ -558,17 +614,17 @@ class StreamEngine:
             jnp.full((n_dev * store,), fill_th, jnp.float64),
             jnp.zeros((n_dev * store,), jnp.int32),
             jnp.zeros(n_dev, jnp.int32),
-            jnp.zeros((n_dev, self.slots), jnp.float64))
+            jnp.zeros((n_dev, m_eff), jnp.float64))
         self._dd_counters = tuple(z64 for _ in range(11)) + (
-            jnp.zeros((n_dev, 4), jnp.int64),
+            jnp.zeros((n_dev, N_WASTE), jnp.int64),
             jnp.zeros((n_dev, 2), jnp.int64),
             jnp.zeros(n_dev, jnp.int32),
             jnp.zeros(n_dev, jnp.int32),
             jnp.zeros(n_dev, dtype=bool))
         self._dd_prev = np.zeros(11, dtype=np.int64)
-        self._dd_prev_waste = np.zeros(4, dtype=np.int64)
+        self._dd_prev_waste = np.zeros(N_WASTE, dtype=np.int64)
         self._dd_prev_evals = np.zeros(2, dtype=np.int64)
-        self._dd_prev_acc = np.zeros(self.slots)
+        self._dd_prev_acc = np.zeros(m_eff)
         self._dd_fam_last = np.full(self.slots, -1, np.int32)
         self._dd_rr = 0
         self._dd_admit = None
@@ -579,7 +635,7 @@ class StreamEngine:
             "wsteps": np.zeros(n_dev, np.int64),
             "tasks": np.zeros(n_dev, np.int64),
             "crounds": np.zeros(n_dev, np.int64),
-            "waste": np.zeros((n_dev, 4), np.int64),
+            "waste": np.zeros((n_dev, N_WASTE), np.int64),
         }
         self._dd_prev_count = np.zeros(n_dev, np.int64)
         self._flight = ChipFlightRecorder(
@@ -592,13 +648,14 @@ class StreamEngine:
             self._build_dd_store()
             return
         store = self._store
+        m_eff = self.slots * self._theta_block
         bag = BagState(
             bag_l=jnp.full(store, fill_x, jnp.float64),
             bag_r=jnp.full(store, fill_x, jnp.float64),
             bag_th=jnp.full(store, fill_th, jnp.float64),
             bag_meta=jnp.zeros(store, jnp.int32),
             count=jnp.asarray(0, jnp.int32),
-            acc=jnp.zeros(self.slots, jnp.float64),
+            acc=jnp.zeros(m_eff, jnp.float64),
             tasks=jnp.zeros((), jnp.int64),
             splits=jnp.zeros((), jnp.int64),
             iters=jnp.zeros((), jnp.int64),
@@ -606,8 +663,8 @@ class StreamEngine:
             overflow=jnp.zeros((), bool))
         self._dev = dict(
             bag=bag,
-            acc=jnp.zeros(self.slots, jnp.float64),
-            acc_c=jnp.zeros(self.slots, jnp.float64),
+            acc=jnp.zeros(m_eff, jnp.float64),
+            acc_c=jnp.zeros(m_eff, jnp.float64),
             fam_last=jnp.full(self.slots, -1, jnp.int32))
 
     # ------------------------------------------------------------------
@@ -644,7 +701,15 @@ class StreamEngine:
             req = self._pending.pop(0)
             slot = self._free.pop(0)
             sl[i], sr[i] = req.bounds
-            sth[i] = req.theta
+            row = req.thetas
+            # frontier rows carry the batch's REPRESENTATIVE theta
+            # (row[0]) for work-scoring; short batches pad the slot's
+            # theta row by replicating it (padded lanes vote and
+            # credit identically — discarded at retirement)
+            sth[i] = row[0]
+            if self._theta_block > 1:
+                pad = row + (row[0],) * (self._theta_block - len(row))
+                self._theta_table[slot] = pad
             sm[i] = np.int32(slot << DEPTH_BITS)
             clear[slot] = True       # recycle: zero the slot's acc pair
             self._slot_req[slot] = req
@@ -654,7 +719,9 @@ class StreamEngine:
             admitted.append(req)
             self.telemetry.event(
                 "admit", rid=req.rid, slot=slot, phase=self.phase,
-                theta=req.theta, bounds=list(req.bounds),
+                theta=(list(row) if self._theta_block > 1
+                       else req.theta),
+                bounds=list(req.bounds),
                 submit_phase=req.submit_phase)
         if n_new:
             self._c_admitted.inc(n_new)
@@ -707,9 +774,11 @@ class StreamEngine:
         if self.engine == "walker-dd":
             return self._dd_cycle_and_pull()
         d = self._dev
+        tt = (jnp.asarray(self._theta_table)
+              if self._theta_block > 1 else None)
         out = run_stream_cycle(
             d["bag"], d["acc"], d["acc_c"], d["fam_last"],
-            jnp.asarray(self.phase, jnp.int32), **self._cycle_kw)
+            jnp.asarray(self.phase, jnp.int32), tt, **self._cycle_kw)
         self._dev = dict(bag=out.bag, acc=out.acc, acc_c=out.acc_c,
                          fam_last=out.fam_last)
         fam_live, acc, acc_c, fam_last, count, overflow, stats = \
@@ -733,7 +802,13 @@ class StreamEngine:
                 np.zeros((n_dev, self.slots), dtype=bool))
         adm = tuple(jnp.asarray(a) for a in self._dd_admit)
         self._dd_admit = None
-        out = self._dd_run(*self._dd_state, *self._dd_counters, *adm)
+        tt_arg = ()
+        if self._theta_block > 1:
+            tt_arg = (jnp.broadcast_to(
+                jnp.asarray(self._theta_table)[None],
+                (n_dev, self.slots, self._theta_block)),)
+        out = self._dd_run(*self._dd_state, *self._dd_counters, *adm,
+                           *tt_arg)
         state = out[:4] + (out[4], out[5])
         fam_live_c = out[22]
         (count_c, acc_c2, ctr_h, waste_h, evals_h, maxd_c, ovf_c,
@@ -786,6 +861,10 @@ class StreamEngine:
         self._dd_prev_count = count_pc
         acc = np.sum(np.asarray(acc_c2), axis=0)      # fixed chip order
         credited = acc != self._dd_prev_acc
+        if self._theta_block > 1:
+            # per-slot credit mark: any of the slot's T thetas credited
+            credited = credited.reshape(
+                self.slots, self._theta_block).any(axis=1)
         self._dd_fam_last = np.where(credited, self.phase,
                                      self._dd_fam_last).astype(np.int32)
         self._dd_prev_acc = acc
@@ -891,8 +970,18 @@ class StreamEngine:
                 continue
             req = self._slot_req.pop(slot)
             rec = self._records.pop(req.rid)
-            area = float(acc[slot] + acc_c[slot])
-            if not np.isfinite(area):
+            T = self._theta_block
+            if T > 1:
+                seg = (acc.reshape(self.slots, T)[slot]
+                       + acc_c.reshape(self.slots, T)[slot])
+                areas = [float(v) for v in seg[:len(req.thetas)]]
+                area = areas[0]
+                finite = np.all(np.isfinite(areas))
+            else:
+                areas = None
+                area = float(acc[slot] + acc_c[slot])
+                finite = np.isfinite(area)
+            if not finite:
                 tel.event("nan_retire", rid=req.rid, slot=slot,
                           phase=self.phase)
                 span.close(error="nan_retire")
@@ -901,7 +990,7 @@ class StreamEngine:
                     f"area — refusing to report garbage")
             c = CompletedRequest(
                 rid=req.rid, theta=req.theta, bounds=req.bounds,
-                area=area,
+                area=area, areas=areas,
                 submit_phase=req.submit_phase,
                 admit_phase=rec["admit_phase"],
                 retire_phase=self.phase,
@@ -916,6 +1005,8 @@ class StreamEngine:
             # every attr below except latency_s is device-counted or
             # schedule-determined: bit-stable across rerun and resume
             tel.event("retire", rid=c.rid, slot=slot, area=c.area,
+                      **({"areas": c.areas} if c.areas is not None
+                         else {}),
                       submit_phase=c.submit_phase,
                       admit_phase=c.admit_phase,
                       retire_phase=c.retire_phase,
@@ -1034,7 +1125,7 @@ class StreamEngine:
         from ppls_tpu.runtime.checkpoint import save_family_checkpoint
         if self._dev is None:
             bag_cols = {}
-            acc_pair = np.zeros((2, self.slots))
+            acc_pair = np.zeros((2, self.slots * self._theta_block))
             fam_last = [-1] * self.slots
             count = 0
             extra = {}
@@ -1074,6 +1165,8 @@ class StreamEngine:
             "completed": [dataclasses.asdict(c)
                           for c in self.completed],
         }
+        if self._theta_block > 1 and self._fill is not None:
+            totals["theta_table"] = self._theta_table.tolist()
         totals.update(extra)
         save_family_checkpoint(
             self.checkpoint_path, identity=self._identity(),
@@ -1156,18 +1249,24 @@ class StreamEngine:
             return row
 
         eng._phase_rows = [_pad_row(r) for r in totals["phase_rows"]]
+
+        def _theta_in(v):
+            # JSON round-trips theta batches as lists
+            return tuple(v) if isinstance(v, list) else v
+
         eng._pending = [StreamRequest(
-            rid=d["rid"], theta=d["theta"],
+            rid=d["rid"], theta=_theta_in(d["theta"]),
             bounds=tuple(d["bounds"]),
             submit_phase=d["submit_phase"],
             submit_t=time.perf_counter()) for d in totals["pending"]]
         eng.completed = [CompletedRequest(
-            **{k: (tuple(v) if k == "bounds" else v)
+            **{k: (tuple(v) if k == "bounds"
+                   else _theta_in(v) if k == "theta" else v)
                for k, v in d.items()}) for d in totals["completed"]]
         for slot_s, d in totals["resident"].items():
             slot = int(slot_s)
             req = StreamRequest(
-                rid=d["rid"], theta=d["theta"],
+                rid=d["rid"], theta=_theta_in(d["theta"]),
                 bounds=tuple(d["bounds"]),
                 submit_phase=d["submit_phase"],
                 submit_t=time.perf_counter())
@@ -1178,6 +1277,12 @@ class StreamEngine:
         eng._count = int(count)
         if totals["fill"] is not None:
             eng._fill = tuple(totals["fill"])
+            if eng._theta_block > 1:
+                eng._theta_table = (
+                    np.asarray(totals["theta_table"], dtype=np.float64)
+                    if "theta_table" in totals else
+                    np.full((eng.slots, eng._theta_block),
+                            eng._fill[1], dtype=np.float64))
             eng._build_store()
             if eng.engine == "walker-dd":
                 eng._restore_device_dd(bag_cols, totals,
@@ -1238,13 +1343,16 @@ class StreamEngine:
             jnp.asarray(bl).reshape(-1), jnp.asarray(br).reshape(-1),
             jnp.asarray(bth).reshape(-1), jnp.asarray(bm).reshape(-1),
             jnp.asarray(counts, dtype=jnp.int32),
-            jnp.asarray(np.asarray(acc, dtype=np.float64)
-                        .reshape(n_dev, self.slots)))
+            jnp.asarray(np.asarray(acc, dtype=np.float64).reshape(
+                n_dev, self.slots * self._theta_block)))
+        w_in = np.asarray(dd["waste"], dtype=np.int64).reshape(
+            n_dev, -1)
+        w_pad = np.zeros((n_dev, N_WASTE), dtype=np.int64)
+        w_pad[:, :w_in.shape[1]] = w_in   # pre-round-13: 4 buckets
         self._dd_counters = tuple(
             jnp.asarray(np.asarray(v, dtype=np.int64))
             for v in dd["ctr"]) + (
-            jnp.asarray(np.asarray(dd["waste"], dtype=np.int64)
-                        .reshape(n_dev, 4)),
+            jnp.asarray(w_pad),
             jnp.asarray(np.asarray(dd.get(
                 "evals", np.zeros((n_dev, 2))), dtype=np.int64)
                 .reshape(n_dev, 2)),
@@ -1252,8 +1360,9 @@ class StreamEngine:
             jnp.zeros(n_dev, jnp.int32),
             jnp.asarray(np.asarray(dd["ovf"], dtype=bool)))
         self._dd_prev = np.asarray(dd["prev"], dtype=np.int64)
-        self._dd_prev_waste = np.asarray(dd["prev_waste"],
-                                         dtype=np.int64)
+        pw = np.asarray(dd["prev_waste"], dtype=np.int64)
+        self._dd_prev_waste = np.concatenate(
+            [pw, np.zeros(N_WASTE - pw.shape[0], np.int64)])
         self._dd_prev_evals = np.asarray(
             dd.get("prev_evals", np.zeros(2)), dtype=np.int64)
         self._dd_prev_acc = np.asarray(dd["prev_acc"],
@@ -1261,6 +1370,11 @@ class StreamEngine:
         self._dd_prev_chip = {
             k: np.asarray(v, dtype=np.int64)
             for k, v in dd["prev_chip"].items()}
+        pcw = self._dd_prev_chip["waste"].reshape(n_dev, -1)
+        if pcw.shape[1] < N_WASTE:
+            pad = np.zeros((n_dev, N_WASTE), dtype=np.int64)
+            pad[:, :pcw.shape[1]] = pcw
+            self._dd_prev_chip["waste"] = pad
         self._dd_prev_count = np.asarray(dd["prev_count"],
                                          dtype=np.int64)
         self._dd_fam_last = np.asarray(totals["fam_last"],

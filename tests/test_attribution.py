@@ -113,7 +113,8 @@ def test_dd_walker_buckets_reconcile_per_chip():
         refill_slots=2, n_devices=8)
     a = r.attribution()
     assert a is not None and a["reconciles"], a
-    assert r.waste_per_chip.shape == (8, 4)
+    from ppls_tpu.parallel.walker import N_WASTE
+    assert r.waste_per_chip.shape == (8, N_WASTE)
     assert np.array_equal(r.waste_per_chip.sum(axis=0), r.waste)
     # the mesh-aggregate reconciliation: kernel_steps is the per-chip
     # sum, lanes is per chip, so buckets == kernel_steps * lanes

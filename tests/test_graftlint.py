@@ -612,3 +612,99 @@ def test_cli_exit_codes(tmp_path):
         capture_output=True, text=True, cwd=repo, env=env)
     assert r2.returncode == 0, r2.stdout + r2.stderr
     assert "0 new" in r2.stdout
+
+
+# ---------------------------------------------------------------------------
+# Round 13 — theta_block joins the policed surfaces (GL01 + GL05)
+# ---------------------------------------------------------------------------
+
+GL01_THETA_BROKEN = """
+    from typing import NamedTuple
+
+    class _ThetaCarry(NamedTuple):
+        bag_l: object
+        acc: object
+        tasks: object
+        theta_block: object    # <- round-13 shape: a theta-batched
+        #                        schedule resumed scalar would blend
+        #                        (m, T) and (m,) accumulator layouts
+
+    def run_cycles(c: _ThetaCarry):
+        return c
+
+    def integrate(state, checkpoint_path):
+        out = run_cycles(state)
+        identity = {"engine": "walker", "eps": 1e-6}
+        save_family_checkpoint(
+            checkpoint_path, identity=identity,
+            bag_cols={"l": out.bag_l}, count=1, acc=out.acc,
+            totals={"tasks": 0})
+        return out
+"""
+
+
+def test_gl01_catches_missing_theta_block(tmp_path):
+    # the round-13 twin of the PR-2 refill_slots near-miss: a carry
+    # whose theta_block never reaches the snapshot identity fires
+    pkg = _mkpkg(tmp_path, {"parallel/walker.py": GL01_THETA_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL01"]
+    assert [v.symbol for v in got] == ["_ThetaCarry.theta_block"], got
+    assert "theta_block" in got[0].message
+
+
+def test_gl01_theta_block_fixed_by_joining_identity(tmp_path):
+    fixed = GL01_THETA_BROKEN.replace(
+        '{"engine": "walker", "eps": 1e-6}',
+        '{"engine": "walker", "eps": 1e-6, "theta_block": 256}')
+    pkg = _mkpkg(tmp_path, {"parallel/walker.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL01"] == []
+
+
+def test_gl05_theta_block_must_be_declared_static(tmp_path):
+    # theta_block is compile-shape config (it sizes the union-vote
+    # reshape and the (m, T) credit width): feeding it traced would
+    # fail at trace time or silently recompile — GL05 demands the
+    # static declaration, and the declared form is clean
+    pkg = _mkpkg(tmp_path, {"parallel/tcfg.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=())
+        def run_theta(x, theta_block: int = 1):
+            return x * theta_block
+    """})
+    got = [v for v in run_lint(pkg) if v.code == "GL05"]
+    assert [v.symbol for v in got] == \
+        ["run_theta:theta_block:undeclared-static"], got
+
+    pkg2 = _mkpkg(tmp_path, {"parallel/tcfg2.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("theta_block",))
+        def run_theta(x, theta_block: int = 1):
+            return x * theta_block
+    """})
+    # (same tmp root as the broken fixture: assert only on tcfg2)
+    assert [v for v in run_lint(pkg2)
+            if v.code == "GL05" and "tcfg2" in v.path] == []
+
+
+def test_gl05_theta_block_loop_fed_static_flagged(tmp_path):
+    # sweeping theta_block from a loop variable recompiles per T —
+    # exactly the bench-theta shape that must stay a per-T explicit
+    # call, not a hidden loop-varying static
+    pkg = _mkpkg(tmp_path, {"parallel/tsweep.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("theta_block",))
+        def run_theta(x, *, theta_block: int):
+            return x * theta_block
+
+        def sweep(xs):
+            return [run_theta(xs, theta_block=t) for t in range(8)]
+    """})
+    got = [v for v in run_lint(pkg) if v.code == "GL05"]
+    assert [v.symbol for v in got] == \
+        ["sweep:run_theta.theta_block:loop-varying"], got

@@ -1,0 +1,406 @@
+"""Round 13 — many-theta amortized walker (``theta_block`` = T > 1).
+
+One walker frontier scores a batch of T per-user thetas per interval:
+groups of T adjacent SIMD lanes share one (i, d) DFS walk, the split
+test runs in UNION-REFINEMENT mode (split iff ANY unretired theta
+fails), per-theta accepts retire thetas individually through the
+(mk_i, mk_d) ancestor markers, and credit lands in a (slots, T)
+accumulator through the exact segment sum.
+
+Contracts pinned here:
+
+* PER-THETA QUALITY — each theta's credited leaf set is at least as
+  refined as its solo run, so its area error versus the exact integral
+  is never worse than the solo run's plus one eps. (The raw
+  batched-minus-solo gap is bounded by SOLO's own global error, which
+  is O(leaves x eps) under the per-leaf test semantics — the batched
+  run is the MORE accurate of the two; BASELINE.md round 13.)
+* RECONCILIATION — the five lane-waste buckets (theta_overwalk
+  appended in round 13) partition lanes x kernel steps exactly, on the
+  walker, the dd engine, and the stream.
+* KILL-AND-RESUME — theta-batched runs snapshot/resume bit-identically
+  on the walker, the dd engine (virtual 8-mesh), and the stream, and
+  ``theta_block`` is snapshot identity (cross-mode resume refuses).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ppls_tpu.models.integrands import (FAMILY_EXACT_VEC, family_exact,
+                                        get_family, get_family_ds)
+from ppls_tpu.parallel.walker import (N_WASTE, WASTE_FIELDS,
+                                      integrate_family_walker,
+                                      normalize_theta_batch,
+                                      resume_family_walker,
+                                      validate_theta_block)
+from ppls_tpu.config import Rule
+
+F = get_family("sin_scaled")
+F_DS = get_family_ds("sin_scaled")
+B = (0.0, 1.0)
+EPS = 1e-6
+T = 8
+# one shared sizing so the jitted cycle program compiles once across
+# this module (compile-once guard economics)
+KW = dict(capacity=1 << 16, lanes=256, roots_per_lane=2,
+          refill_slots=2, seg_iters=2048, min_active_frac=0.05)
+
+
+def _exact(th):
+    return np.asarray(family_exact("sin_scaled", *B, th))
+
+
+# ---------------------------------------------------------------------------
+# validation surface
+# ---------------------------------------------------------------------------
+
+
+def test_validate_theta_block_errors():
+    with pytest.raises(ValueError, match="power of two"):
+        validate_theta_block(6, lanes=256, refill_slots=2,
+                             rule=Rule.TRAPEZOID, m=1)
+    with pytest.raises(ValueError, match="divide lanes"):
+        validate_theta_block(512, lanes=256, refill_slots=2,
+                             rule=Rule.TRAPEZOID, m=1)
+    with pytest.raises(ValueError, match="refill_slots"):
+        validate_theta_block(8, lanes=256, refill_slots=0,
+                             rule=Rule.TRAPEZOID, m=1)
+    with pytest.raises(ValueError, match="TRAPEZOID"):
+        validate_theta_block(8, lanes=256, refill_slots=2,
+                             rule=Rule.SIMPSON, m=1)
+    with pytest.raises(ValueError, match="fam field"):
+        validate_theta_block(2048, lanes=4096, refill_slots=2,
+                             rule=Rule.TRAPEZOID, m=64)
+    assert validate_theta_block(1, lanes=256, refill_slots=0,
+                                rule=Rule.SIMPSON, m=1) == 1
+
+
+def test_normalize_theta_batch_shapes():
+    t2, rep = normalize_theta_batch([1.0, 2.0, 3.0], 1)
+    assert t2.shape == (3, 1) and np.array_equal(rep, [1.0, 2.0, 3.0])
+    t2, rep = normalize_theta_batch([1.0, 2.0], 2)    # (T,) -> (1, T)
+    assert t2.shape == (1, 2) and rep.tolist() == [1.0]
+    t2, rep = normalize_theta_batch([[1., 2.], [3., 4.]], 2)
+    assert t2.shape == (2, 2) and rep.tolist() == [1.0, 3.0]
+    with pytest.raises(ValueError, match="exactly T"):
+        normalize_theta_batch([1.0, 2.0, 3.0], 2)
+
+
+# ---------------------------------------------------------------------------
+# the per-theta quality property (union-refinement contract)
+# ---------------------------------------------------------------------------
+
+
+def test_property_random_batch_per_theta_quality():
+    # every theta of a RANDOM batch: the batched area is within eps of
+    # its solo-run area modulo the solo run's own distance from truth —
+    # equivalently, batched error vs exact never exceeds solo error
+    # vs exact + eps (each theta's batched leaf set is at least as
+    # refined as its solo run's)
+    rng = np.random.default_rng(1337)
+    th = np.sort(rng.uniform(1.0, 4.0, T))
+    r = integrate_family_walker(F, F_DS, th.reshape(1, T), B, EPS,
+                                theta_block=T, **KW)
+    assert r.areas.shape == (1, T)
+    ex = _exact(th)
+    solo = np.array([
+        integrate_family_walker(F, F_DS, [t], B, EPS, **KW).areas[0]
+        for t in th])
+    solo_err = np.abs(solo - ex)
+    batched_err = np.abs(r.areas[0] - ex)
+    assert np.all(batched_err <= solo_err + EPS), \
+        (batched_err, solo_err)
+    # ... which bounds the distance to the solo areas themselves
+    assert np.all(np.abs(r.areas[0] - solo) <= solo_err + EPS)
+
+
+def test_theta_rerun_bit_identical():
+    th = np.linspace(1.0, 4.0, T).reshape(1, T)
+    r1 = integrate_family_walker(F, F_DS, th, B, EPS,
+                                 theta_block=T, **KW)
+    r2 = integrate_family_walker(F, F_DS, th, B, EPS,
+                                 theta_block=T, **KW)
+    assert np.array_equal(r1.areas, r2.areas)
+    assert r1.metrics.tasks == r2.metrics.tasks
+
+
+def test_scout_and_double_buffer_compose_with_theta():
+    th = np.linspace(1.0, 4.0, T).reshape(1, T)
+    base = integrate_family_walker(F, F_DS, th, B, EPS,
+                                   theta_block=T, **KW)
+    sc = integrate_family_walker(F, F_DS, th, B, EPS, theta_block=T,
+                                 scout_dtype="f32", **KW)
+    db = integrate_family_walker(F, F_DS, th, B, EPS, theta_block=T,
+                                 double_buffer=True, **KW)
+    # the scout confirm pass re-takes every credit in full ds and the
+    # rolling deal only reorders bank windows — areas stay within the
+    # interpret-mode ds noise floor of the plain theta run
+    assert np.max(np.abs(base.areas - sc.areas)) <= 1e-9
+    assert np.max(np.abs(base.areas - db.areas)) <= 1e-9
+    assert sc.scout_evals > 0
+    assert sc.attribution()["reconciles"]
+    assert db.attribution()["reconciles"]
+
+
+# ---------------------------------------------------------------------------
+# lane-waste reconciliation with theta_overwalk
+# ---------------------------------------------------------------------------
+
+
+def test_waste_reconciles_with_live_overwalk_bucket():
+    assert WASTE_FIELDS[4] == "theta_overwalk" and N_WASTE == 5
+    th = np.linspace(1.0, 4.0, T).reshape(1, T)
+    r = integrate_family_walker(F, F_DS, th, B, 1e-7,
+                                theta_block=T, **KW)
+    a = r.attribution()
+    assert a["reconciles"]
+    assert int(np.asarray(r.waste).sum()) == r.kernel_steps * r.lanes
+    # a heterogeneous theta batch at this eps retires thetas early:
+    # the overwalk bucket must be LIVE, not vacuously zero
+    assert int(r.waste[4]) > 0
+    # scalar runs keep the bucket identically zero
+    r1 = integrate_family_walker(F, F_DS, [1.5], B, 1e-7, **KW)
+    assert int(r1.waste[4]) == 0 and r1.attribution()["reconciles"]
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume bit-identity + snapshot identity (walker)
+# ---------------------------------------------------------------------------
+
+# m = 3 slots: breeding doubles 3 -> 96 > one deal (64 roots), so the
+# run spans >= 2 cycles and a real leg boundary exists to crash at
+CKPT_TH = np.linspace(1.0, 2.5, 3 * T).reshape(3, T)
+CKPT_B = (1e-2, 1.0)
+CKPT_EPS = 1e-8
+F_R = get_family("sin_recip_scaled")
+F_R_DS = get_family_ds("sin_recip_scaled")
+
+
+@pytest.mark.parametrize("mode", [dict(), dict(scout_dtype="f32")])
+def test_theta_kill_and_resume_bit_identical(tmp_path, mode):
+    kw = dict(KW, theta_block=T, **mode)
+    base = integrate_family_walker(F_R, F_R_DS, CKPT_TH, CKPT_B,
+                                   CKPT_EPS, **kw)
+    assert base.cycles >= 2      # a real leg boundary exists
+    path = str(tmp_path / "wt.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker(F_R, F_R_DS, CKPT_TH, CKPT_B,
+                                CKPT_EPS, **kw, checkpoint_path=path,
+                                checkpoint_every=1,
+                                _crash_after_legs=1)
+    res = resume_family_walker(path, F_R, F_R_DS, CKPT_TH, CKPT_B,
+                               CKPT_EPS, **kw, checkpoint_every=1)
+    assert np.array_equal(res.areas, base.areas)          # bit-for-bit
+    assert res.metrics.tasks == base.metrics.tasks
+    assert np.array_equal(np.asarray(res.waste),
+                          np.asarray(base.waste))
+
+
+def test_theta_block_is_snapshot_identity(tmp_path):
+    path = str(tmp_path / "wt.ckpt")
+    kw = dict(KW, theta_block=T)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker(F_R, F_R_DS, CKPT_TH, CKPT_B,
+                                CKPT_EPS, **kw, checkpoint_path=path,
+                                checkpoint_every=1,
+                                _crash_after_legs=1)
+    # a scalar engine must refuse the theta-batched snapshot (the
+    # (m, T) accumulator layout and union schedule are identity)
+    with pytest.raises(ValueError, match="different run"):
+        resume_family_walker(path, F_R, F_R_DS,
+                             CKPT_TH.reshape(-1), CKPT_B, CKPT_EPS,
+                             **KW, checkpoint_every=1)
+
+
+# ---------------------------------------------------------------------------
+# dd engine (virtual 8-mesh)
+# ---------------------------------------------------------------------------
+
+DD_KW = dict(chunk=1 << 8, capacity=1 << 16, lanes=256,
+             roots_per_lane=2, refill_slots=2, n_devices=8,
+             min_active_frac=0.05)
+
+
+def test_dd_theta_quality_and_reconciliation():
+    from ppls_tpu.parallel.sharded_walker import (
+        integrate_family_walker_dd)
+    th = np.linspace(1.0, 4.0, T)
+    r = integrate_family_walker_dd(
+        "sin_scaled", th.reshape(1, T), B, EPS, theta_block=T,
+        **DD_KW)
+    assert r.areas.shape == (1, T)
+    a = r.attribution()
+    assert a["reconciles"]
+    assert r.waste_per_chip.shape == (8, N_WASTE)
+    ex = _exact(th)
+    solo = np.array([
+        integrate_family_walker(F, F_DS, [t], B, EPS, **KW).areas[0]
+        for t in th])
+    assert np.all(np.abs(r.areas[0] - ex)
+                  <= np.abs(solo - ex) + EPS)
+
+
+def test_dd_theta_kill_and_resume_bit_identical(tmp_path):
+    from ppls_tpu.parallel.sharded_walker import (
+        integrate_family_walker_dd, resume_family_walker_dd)
+    kw = dict(DD_KW, theta_block=T)
+    base = integrate_family_walker_dd(
+        "sin_recip_scaled", CKPT_TH, CKPT_B, CKPT_EPS, **kw)
+    assert base.cycles >= 2
+    path = str(tmp_path / "ddt.ckpt")
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        integrate_family_walker_dd(
+            "sin_recip_scaled", CKPT_TH, CKPT_B, CKPT_EPS,
+            checkpoint_path=path, checkpoint_every=1,
+            _crash_after_legs=1, **kw)
+    res = resume_family_walker_dd(
+        path, "sin_recip_scaled", CKPT_TH, CKPT_B, CKPT_EPS,
+        checkpoint_every=1, **kw)
+    assert np.array_equal(res.areas, base.areas)          # bit-for-bit
+    assert res.metrics.tasks == base.metrics.tasks
+    assert np.array_equal(res.waste_per_chip, base.waste_per_chip)
+
+
+# ---------------------------------------------------------------------------
+# stream: theta-batch requests, retirement, kill-and-resume
+# ---------------------------------------------------------------------------
+
+SKW = dict(slots=4, chunk=1 << 9, capacity=1 << 16, lanes=256,
+           roots_per_lane=2, refill_slots=2, seg_iters=2048,
+           min_active_frac=0.05)
+
+
+def test_stream_theta_batch_requests_retire_with_areas():
+    from ppls_tpu.runtime.stream import StreamEngine
+    eng = StreamEngine("sin_scaled", EPS, theta_block=T, **SKW)
+    # a SHORT batch (padded by replication, pads discarded at emit),
+    # a full batch, and a scalar request on the same engine
+    r0 = eng.submit([1.0, 2.0, 3.0], B)
+    r1 = eng.submit(list(np.linspace(1.0, 4.0, T)), B)
+    r2 = eng.submit(1.5, B)
+    done = {c.rid: c for c in eng.drain()}
+    assert set(done) == {r0, r1, r2}
+    assert len(done[r0].areas) == 3
+    assert len(done[r1].areas) == T
+    assert len(done[r2].areas) == 1
+    for c in done.values():
+        ths = np.asarray(c.theta if isinstance(c.theta, tuple)
+                         else [c.theta])
+        assert np.all(np.abs(np.asarray(c.areas) - _exact(ths))
+                      <= 60 * EPS)      # solo-error-scale bound
+        assert c.area == c.areas[0]
+    res = eng.result()
+    occ = res.occupancy_summary(SKW["lanes"])
+    assert occ["attribution"]["reconciles"]
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.submit(list(np.linspace(1.0, 2.0, T + 1)), B)
+
+
+def test_stream_theta_kill_and_resume_bit_identical(tmp_path):
+    from ppls_tpu.runtime.stream import StreamEngine
+    reqs = [(tuple(np.linspace(1.0 + 0.1 * i, 2.0 + 0.1 * i, T)), B)
+            for i in range(4)]
+    arr = [0, 0, 1, 2]
+    skw = dict(SKW, theta_block=T)
+    base = StreamEngine("sin_scaled", EPS, **skw).run(
+        reqs, arrival_phase=arr)
+    assert int(base.totals.get("theta_overwalk", 0)) >= 0
+    path = str(tmp_path / "st.ckpt")
+    eng = StreamEngine("sin_scaled", EPS, checkpoint_path=path,
+                       checkpoint_every=1, **skw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(reqs, arrival_phase=arr, _crash_after_phases=2)
+    eng2 = StreamEngine.resume(path, "sin_scaled", EPS,
+                               checkpoint_every=1, **skw)
+    k = eng2.next_rid
+    while not eng2.idle or k < len(reqs):
+        while k < len(reqs) and arr[k] <= eng2.phase:
+            eng2.submit(*reqs[k])
+            k += 1
+        eng2.step()
+    res = eng2.result()
+    assert np.array_equal(res.areas, base.areas)          # bit-for-bit
+    base_areas = {c.rid: c.areas for c in base.completed}
+    for c in res.completed:
+        assert c.areas == base_areas[c.rid]               # per theta
+    assert res.totals == base.totals
+    # theta_block is stream identity: a scalar engine must refuse
+    eng3 = StreamEngine("sin_scaled", EPS, checkpoint_path=path,
+                        checkpoint_every=1, **skw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng3.run(reqs, arrival_phase=arr, _crash_after_phases=1)
+    with pytest.raises(ValueError, match="different run"):
+        StreamEngine.resume(path, "sin_scaled", EPS,
+                            checkpoint_every=1, **SKW)
+
+
+# ---------------------------------------------------------------------------
+# satellites: vectorized family_exact, CLI --theta forms
+# ---------------------------------------------------------------------------
+
+
+def test_family_exact_vectorized_matches_mpmath():
+    th = np.linspace(1.0, 4.0, 16)
+    for name, a, b in (("sin_scaled", 0.0, 1.0),
+                       ("cosh4_scaled", 0.0, 2.0)):
+        loop = family_exact(name, a, b, th, prefer_vec=False)
+        vec = family_exact(name, a, b, th, prefer_vec=True)
+        assert isinstance(vec, np.ndarray)
+        assert np.max(np.abs((vec - loop)
+                             / np.maximum(np.abs(loop), 1e-300))) \
+            < 1e-12
+    # the big-batch path defaults to the vectorized form and keeps
+    # shape; 2048 thetas must not be a hot mpmath loop
+    big = np.linspace(1.0, 4.0, 2048).reshape(8, 256)
+    v = family_exact("sin_scaled", 0.0, 1.0, big)
+    assert v.shape == (8, 256)
+    assert "sin_scaled" in FAMILY_EXACT_VEC
+
+
+def test_cli_theta_arg_forms(tmp_path):
+    from ppls_tpu.__main__ import theta_batch_arg
+    assert theta_batch_arg("1.5") == 1.5                  # scalar
+    assert theta_batch_arg("1,2.5,3") == [1.0, 2.5, 3.0]  # comma list
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps([[1.0, 2.0], [3.0, 4.0]]))
+    assert theta_batch_arg("@" + str(p)) == [[1.0, 2.0], [3.0, 4.0]]
+    p2 = tmp_path / "t2.json"
+    p2.write_text("2.25")
+    assert theta_batch_arg("@" + str(p2)) == 2.25
+
+
+def test_cli_scalar_backcompat_parse():
+    # the scalar path must be untouched: no --theta builds the same
+    # linspace family run arguments as before round 13
+    from ppls_tpu.__main__ import build_parser
+    args = build_parser().parse_args(
+        ["family", "--engine", "walker", "--m", "4"])
+    assert args.theta is None and args.theta_block == 1
+    args2 = build_parser().parse_args(
+        ["family", "--engine", "walker", "--theta", "1,2",
+         "--theta-block", "2"])
+    assert args2.theta == [1.0, 2.0] and args2.theta_block == 2
+
+
+def test_dd_stream_theta_snapshot_resume_state_roundtrip(tmp_path):
+    # regression (round-13 review): _restore_device_dd must rebuild
+    # the (n_dev, slots * T) accumulator — the scalar reshape crashed
+    # every theta-batched dd-stream resume. State-only roundtrip: the
+    # store builds and snapshots WITHOUT running a phase (no shard
+    # compile), which is exactly the path the reshape sits on.
+    from ppls_tpu.runtime.stream import StreamEngine
+    kw = dict(SKW, theta_block=T, engine="walker-dd", n_devices=8)
+    eng = StreamEngine("sin_scaled", EPS,
+                       checkpoint_path=str(tmp_path / "ddst.ckpt"),
+                       **kw)
+    eng.submit([1.0, 2.0], B)
+    eng._ensure_state(eng._pending[0])      # build stores, no phase
+    eng._theta_table[1] = 7.0
+    eng.snapshot()
+    eng2 = StreamEngine.resume(str(tmp_path / "ddst.ckpt"),
+                               "sin_scaled", EPS, **kw)
+    assert eng2._dd_state[5].shape == (8, kw["slots"] * T)
+    assert np.array_equal(eng2._theta_table, eng._theta_table)
+    assert eng2.pending == 1

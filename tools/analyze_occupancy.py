@@ -31,8 +31,9 @@ makes essential: a TPU-attached serve round is diagnosable from its
 timeline alone.
 
 Round 11: ``python tools/analyze_occupancy.py --attribution`` runs the
-LANE-WASTE ATTRIBUTION decomposition — the four device-counted buckets
-(eval_active / masked_dead / refill_stall / drain_tail) that partition
+LANE-WASTE ATTRIBUTION decomposition — the five device-counted buckets
+(eval_active / masked_dead / refill_stall / drain_tail /
+theta_overwalk) that partition
 every kernel lane-cycle, in both refill modes, with the reconciliation
 invariant checked and the dominant waste bucket named (the number the
 ceiling-hunt work is judged against). Offline too: ``--from-events``
@@ -273,7 +274,7 @@ def main_attribution():
     walker across the engine modes — legacy boundary, in-kernel refill,
     and the round-12 scout + double-buffer flagship mode — and print
     the BEFORE/AFTER bucket decomposition: where every kernel
-    lane-cycle went (four device-counted waste buckets), the
+    lane-cycle went (five device-counted waste buckets), the
     reconciliation invariant, the dominant bucket by name, and the
     scout/confirm eval split. Sized for the flagship configuration on
     a TPU backend and for the interpret-mode flagship proxy elsewhere
@@ -332,7 +333,7 @@ def main_attribution():
             iw = [CYCLE_STAT_FIELDS.index(k) for k in WASTE_FIELDS]
             istep = CYCLE_STAT_FIELDS.index("walker_steps")
             print("  per-cycle [steps, eval_active, masked_dead, "
-                  "refill_stall, drain_tail]:")
+                  "refill_stall, drain_tail, theta_overwalk]:")
             for row in cs.tolist():
                 print(f"    {[row[istep]] + [row[i] for i in iw]}")
 

@@ -67,6 +67,28 @@ QUICK_M = 8
 QUICK_EPS = 1e-7
 QUICK_BOUNDS = (1e-2, 1.0)
 
+# Round 13: the many-theta amortization proxy leg (bench.py theta).
+# One frontier scores a batch of T per-user thetas per interval; the
+# proxy measures device-counted INTERVAL BOOKKEEPING PER THETA
+# (kernel steps + boundaries, i.e. bag rounds + segments, divided by
+# T) against a T=1 solo sweep at identical per-theta eps.
+THETA_FAMILY = "sin_scaled"
+THETA_EPS = 1e-5
+THETA_BOUNDS = (0.0, 1.0)
+THETA_RANGE = (1.0, 4.0)
+THETA_LANES = 2048
+THETA_SOLO_SAMPLES = 8
+THETA_QUICK_T = (32, 256)
+THETA_FULL_T = (32, 256, 2048)
+THETA_KW = dict(capacity=1 << 16, roots_per_lane=8, refill_slots=8,
+                seg_iters=64, min_active_frac=0.05)
+# regression floor: the T=256 bookkeeping-per-theta reduction vs the
+# T=1 sweep must stay above this multiple (the round-13 acceptance
+# number), and must not drop more than GATE_THETA_TOL below the
+# committed reference's measured reduction.
+GATE_THETA_MIN_REDUCTION = 4.0
+GATE_THETA_TOL = 0.25
+
 # gate tolerances (the "stated tolerance" of the round-11 acceptance)
 GATE_STEP_TOL = 0.5      # kernel_steps / boundaries may grow <= 1.5x
 GATE_EFF_TOL = 0.15      # lane_efficiency may drop <= 15% (relative)
@@ -253,6 +275,145 @@ def run_quick_proxies() -> dict:
     }
 
 
+def run_theta_proxies(ts=THETA_QUICK_T) -> dict:
+    """The ``bench.py theta`` walker leg, standalone (one definition
+    for the bench record, the committed gate reference, and the CI
+    gate measurement — same ownership contract as
+    :func:`run_quick_proxies`).
+
+    Measures, per T in ``ts``: the device-counted interval bookkeeping
+    (kernel steps + boundaries) per theta of a theta-blocked run over
+    T thetas, the reduction versus a T=1 solo sweep at identical
+    per-theta eps, thetas*tasks/s/chip (interpret rate off-TPU — the
+    proxies are the signal), the theta_overwalk share, and the
+    per-theta quality check: batched |area - exact| must not exceed
+    the solo sweep's worst |area - exact| + eps (the union-refinement
+    contract — each theta's leaf set is at least as refined as solo,
+    so its error is never worse beyond one local eps; the raw
+    batched-minus-solo gap is bounded by SOLO's own global error,
+    which is O(leaves * eps) by the per-leaf test semantics)."""
+    import time
+
+    import numpy as np
+
+    from ppls_tpu.models.integrands import (family_exact, get_family,
+                                            get_family_ds)
+    from ppls_tpu.parallel.walker import integrate_family_walker
+
+    f = get_family(THETA_FAMILY)
+    fds = get_family_ds(THETA_FAMILY)
+    lo, hi = THETA_RANGE
+    samples = np.linspace(lo, hi, THETA_SOLO_SAMPLES)
+    solo_bk, solo_err = [], []
+    ex_s = np.asarray(family_exact(THETA_FAMILY, *THETA_BOUNDS,
+                                   samples))
+    for t, e in zip(samples, ex_s):
+        r = integrate_family_walker(f, fds, [t], THETA_BOUNDS,
+                                    THETA_EPS, lanes=THETA_LANES,
+                                    **THETA_KW)
+        solo_bk.append(int(r.kernel_steps) + int(r.metrics.rounds))
+        solo_err.append(abs(float(r.areas[0]) - float(e)))
+    t1_per_theta = float(np.mean(solo_bk))
+    solo_err = np.asarray(solo_err)
+    solo_worst_err = float(np.max(solo_err))
+
+    legs = {}
+    for T in ts:
+        # the batch EMBEDS the solo-sample thetas (first 8 entries) so
+        # the quality bound is the real PER-THETA contract —
+        # batched_err(theta) <= solo_err(theta) + eps at the very
+        # thetas the solo sweep measured — not a cross-theta maximum
+        thetas = np.linspace(lo, hi, int(T))
+        thetas[:THETA_SOLO_SAMPLES] = samples
+        thetas = thetas.reshape(1, int(T))
+        t0 = time.perf_counter()
+        r = integrate_family_walker(
+            f, fds, thetas, THETA_BOUNDS, THETA_EPS,
+            lanes=THETA_LANES, theta_block=int(T), **THETA_KW)
+        wall = time.perf_counter() - t0
+        ex = np.asarray(family_exact(THETA_FAMILY, *THETA_BOUNDS,
+                                     thetas))
+        err = float(np.max(np.abs(np.asarray(r.areas) - ex)))
+        sample_err = np.abs(
+            np.asarray(r.areas)[0, :THETA_SOLO_SAMPLES] - ex_s)
+        bk = int(r.kernel_steps) + int(r.metrics.rounds)
+        attr = r.attribution()
+        legs[str(int(T))] = {
+            "bookkeeping_steps_plus_boundaries": bk,
+            "bookkeeping_per_theta": round(bk / int(T), 4),
+            "reduction_vs_t1": round(
+                t1_per_theta / max(bk / int(T), 1e-12), 2),
+            "theta_tasks_per_s_per_chip": round(
+                int(r.metrics.tasks) / max(wall, 1e-9), 1),
+            "kernel_steps": int(r.kernel_steps),
+            "boundaries_rounds_plus_segs": int(r.metrics.rounds),
+            "cycles": int(r.cycles),
+            "max_abs_err": err,
+            "quality_vs_solo_ok": bool(
+                np.all(sample_err <= solo_err + THETA_EPS)),
+            "theta_overwalk_frac": attr["fractions"]["theta_overwalk"],
+            "reconciles": bool(attr["reconciles"]),
+        }
+    return {
+        "metric": "many-theta amortization proxies",
+        "family": THETA_FAMILY, "eps": THETA_EPS,
+        "bounds": list(THETA_BOUNDS), "lanes": THETA_LANES,
+        "t1_bookkeeping_per_theta": round(t1_per_theta, 2),
+        "t1_solo_samples": THETA_SOLO_SAMPLES,
+        "solo_max_abs_err": solo_worst_err,
+        "theta": legs,
+    }
+
+
+def gate_theta_record(cur: dict, ref: dict) -> List[str]:
+    """Round-13 theta-proxy gate: the T=256 bookkeeping-per-theta
+    reduction must hold the acceptance floor (>= 4x) and stay within
+    GATE_THETA_TOL of the committed reference; the reconciliation
+    invariant (theta_overwalk included) must be green. Returns
+    regression messages (empty = pass). A reference WITHOUT a theta
+    block skips the gate (pre-round-13 refs)."""
+    rt = (ref or {}).get("theta")
+    if not isinstance(rt, dict):
+        return []
+    ct = (cur or {}).get("theta")
+    if not isinstance(ct, dict):
+        # a quick-proxy record without a theta block (bench.py quick
+        # output fed to --gate FILE) simply skips the theta gate; the
+        # CI path uses --gate-run, which always re-measures theta
+        return []
+    fails: List[str] = []
+    for key in ("256",):
+        c, rv = ct.get(key), rt.get(key)
+        if not isinstance(c, dict) or not isinstance(rv, dict):
+            fails.append(f"theta proxy T={key} missing")
+            continue
+        red, red_ref = c.get("reduction_vs_t1"), rv.get(
+            "reduction_vs_t1")
+        if not isinstance(red, (int, float)):
+            fails.append(f"theta T={key}: missing reduction_vs_t1")
+            continue
+        if red < GATE_THETA_MIN_REDUCTION:
+            fails.append(
+                f"REGRESSION theta T={key}: reduction_vs_t1 "
+                f"{red:.2f}x below the {GATE_THETA_MIN_REDUCTION}x "
+                f"acceptance floor")
+        if isinstance(red_ref, (int, float)) \
+                and red < red_ref * (1.0 - GATE_THETA_TOL):
+            fails.append(
+                f"REGRESSION theta T={key}: reduction_vs_t1 "
+                f"{red:.2f}x dropped >{GATE_THETA_TOL:.0%} below the "
+                f"reference's {red_ref:.2f}x; re-record with "
+                f"--update-ref if intended")
+        if c.get("reconciles") is False:
+            fails.append(f"theta T={key}: lane-waste attribution "
+                         f"(theta_overwalk included) does not "
+                         f"reconcile")
+        if c.get("quality_vs_solo_ok") is False:
+            fails.append(f"theta T={key}: per-theta quality fell "
+                         f"below the solo sweep + eps bound")
+    return fails
+
+
 def gate_record(cur: dict, ref: dict,
                 tolerance: float = GATE_STEP_TOL,
                 eff_tolerance: float = GATE_EFF_TOL) -> List[str]:
@@ -349,11 +510,18 @@ def main(argv: List[str]) -> int:
 
     if do_update:
         rec = run_quick_proxies()
+        th = run_theta_proxies()
+        rec["theta"] = th["theta"]
+        rec["theta_meta"] = {k: th[k] for k in (
+            "family", "eps", "bounds", "lanes",
+            "t1_bookkeeping_per_theta", "t1_solo_samples",
+            "solo_max_abs_err")}
         with open(ref_path, "w", encoding="utf-8") as fh:
             json.dump(rec, fh, indent=1, sort_keys=True)
             fh.write("\n")
         print(f"bench_history: reference recorded -> {ref_path}")
         print(json.dumps(rec["walker"]))
+        print(json.dumps(rec["theta"]))
         return 0
 
     if gate_path or do_gate_run:
@@ -369,8 +537,14 @@ def main(argv: List[str]) -> int:
                 cur = json.load(fh)
         else:
             cur = run_quick_proxies()
+            if isinstance(ref.get("theta"), dict):
+                # round 13: the committed ref carries the theta proxy
+                # — re-measure it so the amortization claim is gated
+                th = run_theta_proxies()
+                cur["theta"] = th["theta"]
         fails = gate_record(cur, ref, tolerance=tolerance,
-                            eff_tolerance=eff_tol)
+                            eff_tolerance=eff_tol) \
+            + gate_theta_record(cur, ref)
         for msg in fails:
             print(f"bench_history: GATE {msg}", file=sys.stderr)
         verdict = "TRIPPED" if fails else "passed"

@@ -143,6 +143,21 @@ else
     FAILURES=$((FAILURES + 1))
 fi
 
+# --- 6b. many-theta amortization leg: record must schema-validate ---
+# `bench.py theta --quick` (round 13) measures the bookkeeping-per-
+# theta reduction at T in {32, 256}; its record is gated through the
+# artifact schema so a malformed theta leg cannot silently drop from
+# a future round's trajectory. (The reduction floor itself is gated by
+# step 6's --gate-run via the theta block of bench_quick_ref.json.)
+step "bench theta --quick artifact check"
+if JAX_PLATFORMS=cpu python bench.py theta --quick \
+        | python tools/check_artifacts.py -; then
+    echo "ci: bench theta artifact OK"
+else
+    echo "ci: bench theta artifact FAILED"
+    FAILURES=$((FAILURES + 1))
+fi
+
 # --- 7. C hygiene: csrc must compile warning-free ---
 # The stub-linked MPI binary is part of the tier-1 surface
 # (test_backend.py runs the real farmer/worker protocol through it),
