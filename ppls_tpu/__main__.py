@@ -296,6 +296,35 @@ def build_parser() -> argparse.ArgumentParser:
                           "resume's replay since the last snapshot; "
                           "consumers must dedupe by rid. Size the "
                           "deadline well above a healthy phase")
+    srv.add_argument("--supervise", action="store_true",
+                     help="run the serve loop under the round-14 "
+                          "self-healing Supervisor (runtime.guard): "
+                          "transient failures get deterministic "
+                          "exponential backoff + checkpoint resume, "
+                          "chip loss gets resize-resume onto the "
+                          "surviving mesh, corrupt snapshots fall "
+                          "back to a fresh start, and NaN-poisoned "
+                          "requests are quarantined (implies "
+                          "--quarantine). Auto-enabled when a fault "
+                          "plan is armed. --watchdog then sizes the "
+                          "per-attempt hang deadline")
+    srv.add_argument("--quarantine", action="store_true",
+                     help="per-request NaN quarantine: a request "
+                          "whose area goes non-finite retires as a "
+                          "failed record (failed=true, area=null) "
+                          "while healthy concurrent requests retire "
+                          "normally, instead of an engine-wide "
+                          "FloatingPointError")
+    srv.add_argument("--fault-plan", default=None, metavar="SPEC",
+                     dest="fault_plan",
+                     help="arm seeded fault injection "
+                          "(runtime/faults.py): inline JSON event "
+                          "list, @file.json, or seed:<n>[:<k>]; "
+                          "PPLS_FAULT_PLAN is the env spelling (flag "
+                          "wins). Injected faults fire at phase/"
+                          "checkpoint/admit boundaries, emit "
+                          "fault_injected events, and the supervisor "
+                          "(auto-enabled) recovers the run")
     srv.add_argument("--json", action="store_true", dest="as_json")
 
     qmc = sub.add_parser(
@@ -564,10 +593,26 @@ def _main_serve(args) -> int:
               double_buffer=args.double_buffer,
               reduced_integrands=args.reduced_integrands,
               theta_block=int(getattr(args, "theta_block", 1)),
-              engine=args.engine, n_devices=args.n_devices,
+              engine=args.engine,
               checkpoint_every=args.checkpoint_every)
     if args.lanes:
         kw["lanes"] = args.lanes
+
+    # round 14: seeded fault injection + self-healing supervision.
+    # The injector outlives engine attempts (a consumed fault must not
+    # re-fire in the resumed run); supervision auto-arms with a plan —
+    # an unsupervised fault-plan run would just die on the first
+    # injected fault, which is never what arming a plan means.
+    from ppls_tpu.runtime.faults import FaultInjector, FaultPlan
+    plan = (FaultPlan.from_spec(args.fault_plan)
+            if args.fault_plan else FaultPlan.from_env())
+    supervise = bool(args.supervise or plan is not None
+                     or os.environ.get("PPLS_CHAOS") == "1")
+    quarantine = bool(args.quarantine or supervise)
+    # mesh-size state: the supervisor's resize-resume shrinks it when
+    # a chip is lost, and every later engine build targets the
+    # surviving mesh
+    state = {"n_devices": args.n_devices}
 
     # Unified telemetry (round 10): one Telemetry handle per engine
     # attempt — registry (served live on --metrics-port) + the --events
@@ -577,8 +622,30 @@ def _main_serve(args) -> int:
     # instead of clobbering the pre-crash timeline.
     holder = {}
 
+    class _TelProxy:
+        """Forwarder onto the CURRENT attempt's telemetry handle: the
+        injector and supervisor outlive engine attempts, each of which
+        owns a fresh Telemetry (registry replay + appended events
+        segment), so they address it by indirection."""
+
+        def event(self, name, **attrs):
+            if "tel" in holder:
+                holder["tel"].event(name, **attrs)
+
+        @property
+        def registry(self):
+            from ppls_tpu.obs import MetricsRegistry
+            if "tel" in holder:
+                return holder["tel"].registry
+            return holder.setdefault("_early_reg", MetricsRegistry())
+
+    tel_proxy = _TelProxy()
+    injector = (FaultInjector(plan, telemetry=tel_proxy)
+                if plan is not None else None)
+
     def make_engine():
         from ppls_tpu.obs import Telemetry
+        from ppls_tpu.runtime.checkpoint import CheckpointCorruptError
         from ppls_tpu.runtime.stream import StreamEngine
         resuming = bool(args.checkpoint
                         and os.path.exists(args.checkpoint))
@@ -597,12 +664,30 @@ def _main_serve(args) -> int:
                   "requests": len(reqs), "resumed": resuming},
             append=resuming)
         holder["tel"] = tel
+        ekw = dict(kw, n_devices=state["n_devices"],
+                   quarantine=quarantine, fault_injector=injector,
+                   telemetry=tel)
         if resuming:
-            return StreamEngine.resume(args.checkpoint, args.family,
-                                       args.eps, telemetry=tel, **kw)
+            try:
+                # mesh_resize: after a chip loss the surviving-mesh
+                # engine resumes the bigger mesh's snapshot through
+                # the elastic checkpoint rule (no-op at equal sizes)
+                return StreamEngine.resume(
+                    args.checkpoint, args.family, args.eps,
+                    mesh_resize=True, **ekw)
+            except CheckpointCorruptError as e:
+                # self-healing fallback: a damaged snapshot cannot be
+                # resumed — discard it and start fresh (rids are
+                # deterministic, so the re-run drains to a correct
+                # summary; pre-crash JSONL lines dedupe by rid)
+                print(f"serve: {e}; starting fresh", file=sys.stderr,
+                      flush=True)
+                tel.event("checkpoint_corrupt", path=args.checkpoint,
+                          detail=str(e)[:200])
+                if os.path.exists(args.checkpoint):
+                    os.unlink(args.checkpoint)
         return StreamEngine(args.family, args.eps,
-                            checkpoint_path=args.checkpoint,
-                            telemetry=tel, **kw)
+                            checkpoint_path=args.checkpoint, **ekw)
 
     metrics_srv = None
     if args.metrics_port is not None:
@@ -639,9 +724,15 @@ def _main_serve(args) -> int:
                     "theta": (list(c.theta)
                               if isinstance(c.theta, (tuple, list))
                               else c.theta),
-                    **({"areas": c.areas} if c.areas is not None
+                    **({"areas": c.areas}
+                       if c.areas is not None and not c.failed
                        else {}),
-                    "bounds": list(c.bounds), "area": c.area,
+                    "bounds": list(c.bounds),
+                    # a quarantined request reports area null (the
+                    # non-finite payload is not strict JSON) + the
+                    # failed marker consumers must honor
+                    "area": (None if c.failed else c.area),
+                    **({"failed": True} if c.failed else {}),
                     "admit_phase": c.admit_phase,
                     "retire_phase": c.retire_phase,
                     "phases_in_flight": c.phases_in_flight,
@@ -650,12 +741,29 @@ def _main_serve(args) -> int:
         span.close(phases=eng.phase, completed=len(eng.completed))
         return eng, time.perf_counter() - t0
 
+    supervisor = None
     try:
-        if args.watchdog:
+        if supervise:
+            from ppls_tpu.runtime.guard import Supervisor
+
+            def resize_fn(exc):
+                # chip loss: every later engine build (the resumed
+                # serve_loop's make_engine) targets the surviving mesh
+                state["n_devices"] = exc.surviving
+                return serve_loop
+
+            supervisor = Supervisor(
+                serve_loop, resize_fn=resize_fn,
+                deadline=args.watchdog, telemetry=tel_proxy,
+                backoff_base=0.25, backoff_cap=30.0)
+            eng, wall = supervisor.run()
+        elif args.watchdog:
             from ppls_tpu.runtime.guard import run_with_watchdog
             eng, wall = run_with_watchdog(
                 serve_loop, args.watchdog, what="serve loop",
-                resume_fn=serve_loop if args.checkpoint else None)
+                resume_fn=serve_loop if args.checkpoint else None,
+                telemetry=tel_proxy,
+                checkpoint_path=args.checkpoint)
         else:
             eng, wall = serve_loop()
 
@@ -677,6 +785,19 @@ def _main_serve(args) -> int:
             "occupancy": res.occupancy_summary(eng.lanes),
             "totals": res.totals,
         }
+        failed = sum(1 for c in res.completed if c.failed)
+        if quarantine or failed:
+            summary["failed"] = failed
+        if supervisor is not None:
+            summary["supervised"] = True
+            summary["attempts"] = supervisor.attempts
+            summary["recoveries"] = [
+                {"kind": k, "action": a}
+                for k, a in supervisor.recoveries]
+        if injector is not None:
+            summary["faults_injected"] = [
+                ev.describe() for ev in injector.plan.events
+                if ev.fired]
         if metrics_srv is not None:
             summary["metrics_port"] = metrics_srv.port
             summary["metrics_url"] = metrics_srv.url

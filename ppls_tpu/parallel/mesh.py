@@ -8,14 +8,69 @@ coordinator chip (coordination is collectives, not a role).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh
 
 FRONTIER_AXIS = "d"
+
+
+def host_strided_redeal(cols: Dict[str, np.ndarray],
+                        counts: np.ndarray, n_new: int,
+                        fills: Dict[str, object],
+                        sort_key: Optional[np.ndarray] = None
+                        ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """HOST twin of :func:`strided_reshard` for elastic resume
+    (round 14): re-deal an n-chip snapshot's live prefixes onto
+    ``n_new`` chips.
+
+    ``cols`` maps column name -> (n_old, b) per-chip live-prefix
+    arrays (the shape ``save_family_checkpoint`` banks); ``counts`` is
+    the (n_old,) per-chip live-row counts. The dense global prefix is
+    built in chip-block order (chip 0's rows, then chip 1's, ... —
+    the same order the device ``all_gather`` produces), optionally
+    STABLY ordered by ``sort_key`` (a matching (n_old, b) per-row
+    column; the resume path passes task depth, the same stratification
+    key ``phase_reshard`` deals by every boundary), and chip d of the
+    new mesh takes dense rows d, d + n_new, d + 2*n_new, ... — the
+    identical deal rule, executed once on host at resume instead of
+    per boundary on device.
+
+    Returns ``(new_cols, new_counts)``: (n_new, b_new) arrays (rows
+    past each chip's count hold the matching ``fills`` value) and the
+    (n_new,) per-chip counts. Works for n_new < n_old (chip loss) and
+    n_new > n_old (scale-up) alike.
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    n_old = counts.shape[0]
+    n_new = int(n_new)
+    if n_new < 1:
+        raise ValueError(f"cannot redeal onto {n_new} chips")
+    dense = {
+        k: np.concatenate([np.asarray(v)[c][:counts[c]]
+                           for c in range(n_old)])
+        for k, v in cols.items()}
+    total = int(counts.sum())
+    if sort_key is not None:
+        key_dense = np.concatenate(
+            [np.asarray(sort_key)[c][:counts[c]] for c in range(n_old)])
+        order = np.argsort(key_dense, kind="stable")
+        dense = {k: v[order] for k, v in dense.items()}
+    new_counts = np.array(
+        [(total - d + n_new - 1) // n_new for d in range(n_new)],
+        dtype=np.int64)
+    b_new = max(int(new_counts.max(initial=0)), 1)
+    out = {}
+    for k, v in dense.items():
+        col = np.full((n_new, b_new), fills[k], dtype=v.dtype)
+        for d in range(n_new):
+            col[d, :new_counts[d]] = v[d::n_new]
+        out[k] = col
+    return out, new_counts.astype(np.int32)
 
 
 def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs,

@@ -151,6 +151,14 @@ class _DDCarry(NamedTuple):
     overflow: jnp.ndarray   # bool (replicated via psum)
 
 
+# the 11 per-chip i64 cycle counters, in carry/snapshot order. Most
+# are mesh totals (summed over chips at reporting); "rounds" reports
+# as the per-chip max and "crounds" is replicated by construction.
+CTR64 = ("tasks", "splits", "btasks", "wtasks", "wsplits", "roots",
+         "rounds", "segs", "wsteps", "srows", "crounds")
+_CTR64_MAX = ("rounds", "crounds")
+
+
 def _local_bag(c: _DDCarry, m: int) -> BagState:
     return BagState(
         bag_l=c.bag_l, bag_r=c.bag_r, bag_th=c.bag_th, bag_meta=c.bag_meta,
@@ -493,8 +501,12 @@ def build_dd_walker_run(mesh: Mesh, family: str, eps: float,
         cnt = c.count + n_adm
         local_ovf = cnt > jnp.asarray(capacity, jnp.int32)
         any_ovf = lax.psum(local_ovf.astype(jnp.int32), axis) > 0
+        # acc=acc2, not c.acc: the round-14 chaos lane caught the clear
+        # being computed and DROPPED here — a recycled slot kept its
+        # previous request's partial (double-counted area, or a
+        # quarantined NaN leaking into the slot's next tenant)
         return c._replace(bag_l=bl, bag_r=br, bag_th=bth, bag_meta=bm,
-                          count=cnt,
+                          count=cnt, acc=acc2,
                           overflow=jnp.logical_or(c.overflow, any_ovf))
 
     def _fam_live_local(c: _DDCarry) -> jnp.ndarray:
@@ -635,6 +647,10 @@ def integrate_family_walker_dd(
         #                             come back (m, T); requires
         #                             refill_slots > 0 + trapezoid
         interpret: Optional[bool] = None,
+        nan_policy: str = "raise",  # round 14: "quarantine" marks
+        #                             non-finite families on
+        #                             WalkerResult.failed instead of
+        #                             raising engine-wide
         mesh: Optional[Mesh] = None,
         n_devices: Optional[int] = None,
         checkpoint_path: Optional[str] = None,
@@ -716,8 +732,6 @@ def integrate_family_walker_dd(
     # All per-chip counters live on-device and are passed back in across
     # legs, so totals are simply the latest values and a resumed run
     # reports exact cumulative metrics.
-    CTR64 = ("tasks", "splits", "btasks", "wtasks", "wsplits", "roots",
-             "rounds", "segs", "wsteps", "srows", "crounds")
     per_chip = {k: np.zeros(n_dev, dtype=np.int64) for k in CTR64}
     per_chip["maxd"] = np.zeros(n_dev, dtype=np.int32)
     # round-11 lane-waste buckets, (n_dev, 4) — per-chip, unlike the
@@ -855,10 +869,8 @@ def integrate_family_walker_dd(
     areas = np.sum(acc_h, axis=0)      # fixed chip order: deterministic
     if theta_block > 1:
         areas = areas.reshape(m, theta_block)
-    if not np.all(np.isfinite(areas)):
-        bad = int(np.sum(~np.isfinite(areas)))
-        raise FloatingPointError(
-            f"dd walker produced {bad}/{areas.size} non-finite areas")
+    from ppls_tpu.parallel.walker import quarantine_failed_mask
+    failed = quarantine_failed_mask(areas, nan_policy, "walker-dd")
     from ppls_tpu.parallel.bag_engine import _clear_snapshot
     _clear_snapshot(checkpoint_path)
 
@@ -929,6 +941,7 @@ def integrate_family_walker_dd(
         scout_evals=sevals,
         confirm_evals=cevals if sevals else int(waste_tot[0]),
         evals_estimated=evals_estimated,
+        failed=failed,
     )
 
 
@@ -962,11 +975,79 @@ def _dd_ckpt_identity(family: str, eps: float, m: int, theta: np.ndarray,
     return ident
 
 
+def _resize_dd_totals(totals: dict, acc: np.ndarray, n_old: int,
+                      n_new: int) -> dict:
+    """Reshard a dd snapshot's per-chip totals onto an n_new-chip mesh
+    (elastic resume).
+
+    Summed counters (tasks, wsteps, waste buckets, the accumulator
+    partials, ...) land as their column sums on chip 0 — mesh totals
+    are exactly preserved, and the per-chip waste-reconciliation
+    invariant (sum(buckets) == lanes * wsteps per chip) keeps holding
+    because waste and wsteps collapse together. Replicated/maximum
+    counters (crounds — replicated by construction; rounds and maxd —
+    reported as per-chip maxima) replicate their stored maximum to
+    every new chip, so the continued run keeps accumulating on the
+    same baseline. Post-resize per-chip BALANCE attribution is
+    deliberately skewed toward chip 0 for the pre-resize prefix: the
+    pre-crash history cannot be attributed to chips that no longer
+    exist."""
+    out = dict(totals)
+
+    def place_sum(vec, dtype):
+        v = np.asarray(vec, dtype=dtype)
+        res = np.zeros((n_new,) + v.shape[1:], dtype=dtype)
+        res[0] = v.sum(axis=0)
+        return res
+
+    def replicate_max(vec, dtype):
+        v = np.asarray(vec, dtype=dtype)
+        return np.full(n_new, v.max(initial=0), dtype=dtype)
+
+    for k in CTR64:
+        key = "pc_" + k
+        if key not in out:
+            continue
+        out[key] = (replicate_max(out[key], np.int64)
+                    if k in _CTR64_MAX
+                    else place_sum(out[key], np.int64)).tolist()
+    if "pc_maxd" in out:
+        out["pc_maxd"] = replicate_max(out["pc_maxd"],
+                                       np.int32).tolist()
+    if "waste" in out:
+        out["waste"] = place_sum(
+            np.asarray(out["waste"]).reshape(n_old, -1),
+            np.int64).tolist()
+    if "evals" in out:
+        out["evals"] = place_sum(
+            np.asarray(out["evals"]).reshape(n_old, -1),
+            np.int64).tolist()
+    acc = np.asarray(acc, dtype=np.float64).reshape(n_old, -1)
+    acc2 = np.zeros((n_new, acc.shape[1]), dtype=np.float64)
+    # collapsing the partials re-associates the cross-chip sum: exact
+    # (dyadic) workloads stay bit-identical through a resize, ds
+    # workloads move within the documented ~1e-9 schedule contract
+    acc2[0] = acc.sum(axis=0)
+    out["acc_per_chip"] = acc2
+    return out
+
+
 def resume_family_walker_dd(
         path: str, family: str, theta: Sequence[float], bounds,
-        eps: float, **kwargs) -> WalkerResult:
+        eps: float, mesh_resize: bool = False,
+        **kwargs) -> WalkerResult:
     """Continue an interrupted checkpointed demand-driven run from its
-    last leg snapshot (identity-checked, mesh size included)."""
+    last leg snapshot (identity-checked, mesh size included).
+
+    ``mesh_resize=True`` (round 14) enables ELASTIC resume: a snapshot
+    taken on an n-chip virtual mesh may resume onto the m != n chips
+    of THIS call's mesh. The per-chip live prefixes are re-dealt
+    depth-stratified through the host twin of the phase boundary's
+    ``strided_reshard`` (``mesh.host_strided_redeal``), the per-chip
+    accumulators/counters reshard sum-preserving onto the new mesh
+    (replicated counters — crounds, maxd — replicate their maxima),
+    and ``_dd_sizing`` is recomputed for the new chip count. Without
+    the flag a mesh-size mismatch refuses, exactly as before."""
     from ppls_tpu.runtime.checkpoint import load_family_checkpoint
 
     theta_np, _rep = normalize_theta_batch(
@@ -990,7 +1071,30 @@ def resume_family_walker_dd(
         double_buffer=bool(kwargs.get("double_buffer", False)),
         reduced=bool(kwargs.get("reduced_integrands", False)),
         theta_block=int(kwargs.get("theta_block", 1)))
-    bag_cols, _count, acc, totals = load_family_checkpoint(path, identity)
+    bag_cols, _count, acc, totals = load_family_checkpoint(
+        path, identity, mesh_resize=mesh_resize)
+    n_old = int(np.asarray(bag_cols["counts"]).shape[0])
+    totals = dict(totals)
+    if n_old != n_dev:
+        # elastic resume (round 14): re-deal the n_old-chip snapshot
+        # onto this call's n_dev-chip mesh before the store rebuild
+        from ppls_tpu.parallel.mesh import host_strided_redeal
+        fill_l0 = float(0.5 * (bounds_np[0, 0] + bounds_np[0, 1]))
+        fill_th0 = float(_rep[0])
+        cols = {k: np.asarray(bag_cols[k])
+                for k in ("l", "r", "th", "meta")}
+        dealt, new_counts = host_strided_redeal(
+            cols, bag_cols["counts"], n_dev,
+            fills={"l": fill_l0, "r": fill_l0, "th": fill_th0,
+                   "meta": 0},
+            # the same depth stratification the phase boundary deals
+            # by: each surviving chip receives a comparable
+            # shallow/deep work mix
+            sort_key=np.asarray(bag_cols["meta"]) & DEPTH_MASK)
+        bag_cols = dict(dealt, counts=new_counts)
+        totals = _resize_dd_totals(totals, np.asarray(acc), n_old,
+                                   n_dev)
+        acc = np.asarray(totals["acc_per_chip"])
 
     # rebuild full-width per-chip stores around the saved live prefixes
     lanes = int(kwargs.get("lanes", 1 << 12))

@@ -3141,6 +3141,14 @@ class WalkerResult:
     #                              the device counters (resumed old
     #                              snapshot) and the eval numbers fall
     #                              back to the host-side model
+    failed: Optional[np.ndarray] = None   # round 14, nan_policy=
+    #                              "quarantine" only: boolean mask over
+    #                              `areas` marking per-family (per-
+    #                              theta in theta_block mode) NON-
+    #                              FINITE results — quarantined, not
+    #                              reported as integrals; None when
+    #                              every area is finite or under the
+    #                              default raise policy
     # (The streaming engine's per-family done-mask / phase-counter
     # surface lives on runtime.stream.StreamResult, fed by this
     # module's run_stream_cycle / family_live_counts hooks.)
@@ -3254,6 +3262,7 @@ class WalkerDispatch(NamedTuple):
     rule: Rule = Rule.TRAPEZOID
     refill_slots: int = 0
     theta_block: int = 1
+    nan_policy: str = "raise"
 
 
 # NOTE on pipelined wall times: a WalkerDispatch's t0 is its DISPATCH
@@ -3321,6 +3330,12 @@ def integrate_family_walker(
         #                             the trapezoid rule
         #                             (validate_theta_block)
         interpret: Optional[bool] = None,
+        nan_policy: str = "raise",  # round 14: "quarantine" returns a
+        #                             per-family failed mask
+        #                             (WalkerResult.failed) instead of
+        #                             the engine-wide
+        #                             FloatingPointError when some
+        #                             areas are non-finite
         checkpoint_path: Optional[str] = None,
         checkpoint_every: int = 1,
         _state_override=None,
@@ -3441,7 +3456,8 @@ def integrate_family_walker(
         d = WalkerDispatch(out=out, t0=t0, lanes=int(lanes),
                            rule=Rule(rule),
                            refill_slots=int(refill_slots),
-                           theta_block=int(theta_block))
+                           theta_block=int(theta_block),
+                           nan_policy=str(nan_policy))
         return d if _dispatch_only else collect_family_walker(d)
     else:
         from ppls_tpu.parallel.bag_engine import _family_ckpt_identity
@@ -3550,14 +3566,48 @@ def integrate_family_walker(
         left=left, overflow=overflow, wall=wall, lanes=lanes,
         seg_stats=seg_stats_np, cyc_stats=cyc_stats_np, rule=Rule(rule),
         refill_slots=int(refill_slots), checkpoint_path=checkpoint_path,
-        theta_block=int(theta_block))
+        theta_block=int(theta_block), nan_policy=str(nan_policy))
+
+
+def quarantine_failed_mask(areas: np.ndarray, nan_policy: str,
+                           engine: str):
+    """THE per-family NaN containment decision, shared by the batch
+    engines (round 14). ``nan_policy="raise"`` keeps the historical
+    loud contract: any non-finite area is an engine-wide
+    ``FloatingPointError``. ``"quarantine"`` instead returns the
+    boolean failed-mask over ``areas`` (None when all finite) — each
+    family's accumulator is an independent slot, so a poisoned family
+    CANNOT have contaminated the others' credits; the caller reports
+    healthy areas normally and marks the failures. Quarantines count
+    into ``ppls_quarantined_total{engine}``."""
+    if nan_policy not in ("raise", "quarantine"):
+        raise ValueError(
+            f"nan_policy must be 'raise' or 'quarantine', got "
+            f"{nan_policy!r}")
+    finite = np.isfinite(areas)
+    if np.all(finite):
+        return None
+    if nan_policy == "raise":
+        bad = int(np.sum(~finite))
+        raise FloatingPointError(
+            f"{engine} produced {bad}/{areas.size} non-finite areas "
+            f"(NaN/inf) — refusing to report garbage")
+    failed = ~finite
+    from ppls_tpu.obs.telemetry import default_telemetry
+    default_telemetry().registry.counter(
+        "ppls_quarantined_total",
+        "per-family results quarantined as non-finite "
+        "(nan_policy='quarantine')",
+        ("engine",)).labels(engine=engine).inc(int(failed.sum()))
+    return failed
 
 
 def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
                      seg_stats, cyc_stats, rule: Rule = Rule.TRAPEZOID,
                      refill_slots: int = 0,
                      checkpoint_path=None,
-                     theta_block: int = 1) -> WalkerResult:
+                     theta_block: int = 1,
+                     nan_policy: str = "raise") -> WalkerResult:
     """Validate a finished run and build its :class:`WalkerResult`."""
     if bool(overflow):
         raise RuntimeError(
@@ -3573,11 +3623,7 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
     if theta_block > 1:
         # (m, T): one row of per-user areas per frontier slot
         acc = acc.reshape(-1, int(theta_block))
-    if not np.all(np.isfinite(acc)):
-        bad = int(np.sum(~np.isfinite(acc)))
-        raise FloatingPointError(
-            f"walker produced {bad}/{acc.size} non-finite areas "
-            f"(NaN/inf) — refusing to report garbage")
+    failed = quarantine_failed_mask(acc, nan_policy, "walker")
     # A finished run must not leave its last mid-run snapshot behind
     # (ADVICE r3: re-invoking would silently resume and replay the tail).
     from ppls_tpu.parallel.bag_engine import _clear_snapshot
@@ -3665,6 +3711,7 @@ def _assemble_result(acc, tot: dict, *, left, overflow, wall, lanes,
         scout_evals=sevals,
         confirm_evals=cevals if sevals else int(waste_arr[0]),
         evals_estimated=evals_estimated,
+        failed=failed,
     )
     # run-completion telemetry boundary (host values already in hand —
     # no extra device fetch; the registry is the process default, so
@@ -3705,7 +3752,7 @@ def collect_family_walker(d: WalkerDispatch) -> WalkerResult:
         wall=time.perf_counter() - d.t0, lanes=d.lanes, rule=d.rule,
         refill_slots=d.refill_slots,
         seg_stats=seg_stats_np, cyc_stats=cyc_stats_np,
-        theta_block=d.theta_block)
+        theta_block=d.theta_block, nan_policy=d.nan_policy)
 
 
 def dispatch_family_walker(
@@ -3749,6 +3796,7 @@ def resume_family_walker(
         double_buffer: bool = False,
         theta_block: int = 1,
         interpret: Optional[bool] = None,
+        nan_policy: str = "raise",
         checkpoint_every: int = 1) -> WalkerResult:
     """Continue an interrupted checkpointed walker run from its last
     cycle-boundary snapshot (identity-checked; see
@@ -3820,6 +3868,7 @@ def resume_family_walker(
         refill_slots=refill_slots, sort_skip_ratio=sort_skip_ratio,
         scout_dtype=scout_dtype, double_buffer=double_buffer,
         theta_block=theta_block, interpret=interpret,
+        nan_policy=nan_policy,
         checkpoint_path=path, checkpoint_every=checkpoint_every,
         _state_override=state, _totals_override=totals)
 

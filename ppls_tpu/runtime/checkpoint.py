@@ -18,6 +18,7 @@ Usage::
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import tempfile
@@ -30,6 +31,70 @@ from ppls_tpu.utils.metrics import RoundStats, RunMetrics
 
 _META_KEYS = ("tasks", "splits", "leaves", "rounds", "max_depth",
               "integrand_evals", "wall_time_s", "n_chips")
+
+# Round 14: snapshot payloads are integrity-checked. The meta record
+# carries a format-version field plus a sha256 per payload array, so a
+# truncated or bit-flipped snapshot raises CheckpointCorruptError (with
+# the offending path) instead of unpickling garbage into a resumed run.
+# Version history: absent = pre-round-14 (loaded unverified for
+# back-compat); 1 = checksummed.
+CKPT_FORMAT_VERSION = 1
+
+
+class CheckpointCorruptError(ValueError):
+    """A snapshot file failed integrity verification (truncation,
+    bit-flip, or an unparseable container). Carries the offending
+    ``path`` so operators/supervisors know which file to discard."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(
+            f"checkpoint {path!r} is corrupt: {detail} (refusing to "
+            f"resume from damaged state; delete the file to start "
+            f"fresh)")
+        self.path = path
+        self.detail = detail
+
+
+def _array_sha(arr: np.ndarray) -> str:
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _payload_checksums(arrays: dict) -> dict:
+    return {k: _array_sha(np.asarray(v)) for k, v in arrays.items()}
+
+
+def _verify_payload(path: str, z, meta: dict) -> None:
+    """Verify every payload array against the stored checksums.
+    Snapshots predating CKPT_FORMAT_VERSION carry no checksums and are
+    loaded unverified (back-compat)."""
+    sums = meta.get("checksums")
+    if meta.get("format_version") is None or sums is None:
+        return
+    for k, want in sums.items():
+        if k not in z.files:
+            raise CheckpointCorruptError(path, f"payload {k!r} missing")
+        got = _array_sha(np.asarray(z[k]))
+        if got != want:
+            raise CheckpointCorruptError(
+                path, f"payload {k!r} checksum mismatch "
+                      f"(stored {want}, recomputed {got})")
+
+
+def _chaos_verify_on_write(path: str) -> None:
+    """PPLS_CHAOS=1 lane (mirrors PPLS_SCOUT): every snapshot write is
+    immediately re-opened and checksum-verified, so serialization rot
+    surfaces at the save site of whichever test wrote it instead of at
+    some later resume."""
+    if os.environ.get("PPLS_CHAOS") != "1":
+        return
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        _verify_payload(path, z, meta)
 
 
 def _config_identity(config: QuadConfig) -> dict:
@@ -52,6 +117,12 @@ def save_checkpoint(path: str, frontier: np.ndarray,
     meta["per_round"] = [dataclasses.asdict(s) for s in metrics.per_round]
     if config is not None:
         meta["config"] = _config_identity(config)
+    payload = {
+        "frontier": np.asarray(frontier, dtype=np.float64).reshape(-1, 2),
+        "acc": np.asarray(area_acc, dtype=np.float64),
+    }
+    meta["format_version"] = CKPT_FORMAT_VERSION
+    meta["checksums"] = _payload_checksums(payload)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
@@ -59,22 +130,34 @@ def save_checkpoint(path: str, frontier: np.ndarray,
         with os.fdopen(fd, "wb") as fh:
             np.savez(
                 fh,
-                frontier=np.asarray(frontier, dtype=np.float64).reshape(-1, 2),
-                acc=np.asarray(area_acc, dtype=np.float64),
                 meta=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+                **payload,
             )
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    _chaos_verify_on_write(path)
 
 
 def load_checkpoint(path: str):
-    """Returns (frontier, (s, c), RunMetrics, stored_config_or_None)."""
-    with np.load(path) as z:
-        frontier = z["frontier"]
-        s, c = (float(x) for x in z["acc"])
-        meta = json.loads(bytes(z["meta"]).decode())
+    """Returns (frontier, (s, c), RunMetrics, stored_config_or_None).
+    Raises :class:`CheckpointCorruptError` on a truncated, bit-flipped,
+    or otherwise unreadable snapshot."""
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            _verify_payload(path, z, meta)
+            frontier = z["frontier"]
+            s, c = (float(x) for x in z["acc"])
+    except (CheckpointCorruptError, FileNotFoundError):
+        raise                 # a MISSING snapshot is not a corrupt one
+    except Exception as e:  # noqa: BLE001 — any container damage
+        raise CheckpointCorruptError(
+            path, f"unreadable container ({type(e).__name__}: {e})"
+        ) from e
+    meta.pop("format_version", None)
+    meta.pop("checksums", None)
     stored_cfg = meta.pop("config", None)
     per_round = [RoundStats(**d) for d in meta.pop("per_round")]
     metrics = RunMetrics(**meta, per_round=per_round)
@@ -137,7 +220,12 @@ def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
     ``bag_cols`` maps column name -> live-prefix array (host); ``totals``
     are the accumulated integer counters (tasks, splits, ...).
     """
-    meta = {"identity": identity, "count": int(count), "totals": totals}
+    payload = {"acc": np.asarray(acc, dtype=np.float64)}
+    payload.update({f"bag_{k}": np.asarray(v)
+                    for k, v in bag_cols.items()})
+    meta = {"identity": identity, "count": int(count), "totals": totals,
+            "format_version": CKPT_FORMAT_VERSION,
+            "checksums": _payload_checksums(payload)}
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
@@ -145,32 +233,54 @@ def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
         with os.fdopen(fd, "wb") as fh:
             np.savez(
                 fh,
-                acc=np.asarray(acc, dtype=np.float64),
                 meta=np.frombuffer(json.dumps(meta).encode(),
                                    dtype=np.uint8),
-                **{f"bag_{k}": np.asarray(v) for k, v in bag_cols.items()},
+                **payload,
             )
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    _chaos_verify_on_write(path)
 
 
-def load_family_checkpoint(path: str, identity: dict):
-    """Returns (bag_cols, count, acc, totals); raises ValueError when the
-    snapshot belongs to a different problem identity."""
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        acc = np.asarray(z["acc"], dtype=np.float64)
-        bag_cols = {k[len("bag_"):]: np.asarray(z[k])
-                    for k in z.files if k.startswith("bag_")}
+def load_family_checkpoint(path: str, identity: dict, *,
+                           mesh_resize: bool = False):
+    """Returns (bag_cols, count, acc, totals); raises ValueError when
+    the snapshot belongs to a different problem identity and
+    :class:`CheckpointCorruptError` when the payload fails its
+    integrity check.
+
+    ``mesh_resize=True`` enables the round-14 ELASTIC compatibility
+    rule: the stored identity may differ from the requested one in
+    ``n_dev`` ONLY (a snapshot taken on an n-chip mesh resuming onto
+    m != n chips). Everything else — problem, engine, mode flags,
+    per-chip sizing — must still match exactly; the caller owns
+    re-dealing the per-chip state onto the new mesh
+    (``mesh.host_strided_redeal``).
+    """
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            _verify_payload(path, z, meta)
+            acc = np.asarray(z["acc"], dtype=np.float64)
+            bag_cols = {k[len("bag_"):]: np.asarray(z[k])
+                        for k in z.files if k.startswith("bag_")}
+    except (CheckpointCorruptError, FileNotFoundError):
+        raise                 # a MISSING snapshot is not a corrupt one
+    except Exception as e:  # noqa: BLE001 — any container damage
+        raise CheckpointCorruptError(
+            path, f"unreadable container ({type(e).__name__}: {e})"
+        ) from e
     stored = meta["identity"]
     if stored != identity:
-        diff = {k: (stored.get(k), identity[k]) for k in identity
+        diff = {k: (stored.get(k), identity.get(k))
+                for k in set(stored) | set(identity)
                 if stored.get(k) != identity.get(k)}
-        raise ValueError(
-            f"checkpoint {path!r} belongs to a different run; refusing "
-            f"to blend (stored vs requested): {diff}")
+        if not (mesh_resize and set(diff) == {"n_dev"}):
+            raise ValueError(
+                f"checkpoint {path!r} belongs to a different run; "
+                f"refusing to blend (stored vs requested): {diff}")
     return bag_cols, int(meta["count"]), acc, meta["totals"]
 
 

@@ -48,10 +48,78 @@ class HangTimeout(RuntimeError):
     """A device section exceeded its watchdog deadline (hung device)."""
 
 
+class InjectedCrash(RuntimeError):
+    """A fault plan fired a phase-boundary crash (runtime/faults.py).
+    Classified RECOVERABLE: the engine state on disk is exactly a
+    crashed run's, so the supervisor resumes from the last snapshot."""
+
+
+class ChipLossError(RuntimeError):
+    """A chip (or host) left the mesh mid-run. The surviving-mesh size
+    rides on the exception so the supervisor can resize-resume; on real
+    hardware this is the classified face of a dead-device XLA error, in
+    fault-plan runs it is injected at a phase boundary."""
+
+    def __init__(self, chip: int, n_dev: int, detail: str = ""):
+        self.chip = int(chip)
+        self.n_dev = int(n_dev)
+        self.surviving = max(int(n_dev) - 1, 0)
+        super().__init__(
+            f"chip {chip} lost from the {n_dev}-chip mesh"
+            + (f" ({detail})" if detail else "")
+            + f"; {self.surviving} chip(s) survive")
+
+
+class RetryBudgetExhausted(RuntimeError):
+    """The retry loop's total-deadline budget ran out before the next
+    backoff could be paid; carries the last underlying failure."""
+
+
 def is_transient(msg: str) -> bool:
     """True when an exception message matches a known transient
     infrastructure failure (retry) rather than a numerical one (fail)."""
     return any(marker in msg for marker in TRANSIENT_MARKERS)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Failure taxonomy of the round-14 supervisor:
+
+    * ``chip_loss``  — a :class:`ChipLossError`: recover by resuming the
+      latest snapshot onto the surviving (smaller) mesh;
+    * ``poison``     — a ``FloatingPointError`` (the engines' NaN
+      guard): data, not infrastructure — never retried; engines running
+      with quarantine enabled retire the poisoned request as a failed
+      record instead of surfacing this at all;
+    * ``transient``  — watchdog expiry, injected phase-boundary
+      crashes, and the tunnel/connection failure strings of
+      :data:`TRANSIENT_MARKERS`: recover by deterministic exponential
+      backoff + resume;
+    * ``fatal``      — everything else (bugs, sizing errors): propagate.
+    """
+    if isinstance(exc, ChipLossError):
+        return "chip_loss"
+    if isinstance(exc, FloatingPointError):
+        return "poison"
+    if isinstance(exc, RetryBudgetExhausted):
+        # the budget is already spent — its message EMBEDS the last
+        # transient failure's text, so the marker scan below would
+        # misread it as retryable and retry past the exhausted budget
+        return "fatal"
+    if isinstance(exc, (HangTimeout, InjectedCrash)):
+        return "transient"
+    if is_transient(f"{type(exc).__name__}: {exc}"):
+        return "transient"
+    return "fatal"
+
+
+def backoff_seconds(attempt: int, base: float = 10.0,
+                    cap: float = 120.0) -> float:
+    """DETERMINISTIC exponential backoff: base * 2^(attempt-1), capped.
+    No jitter by design — recovery schedules must replay identically
+    under a seeded fault plan (the same reproducibility contract as
+    every other schedule in this package)."""
+    return min(float(base) * (2.0 ** (max(int(attempt), 1) - 1)),
+               float(cap))
 
 
 def default_watchdog_seconds() -> float:
@@ -96,15 +164,39 @@ def with_deadline(fn, seconds: float, what: str = "device section"):
     return box.get("value")
 
 
+def _count_retry(reason: str) -> None:
+    """Registry face of the retry loop (round 14): every retried
+    failure increments ``ppls_retries_total{reason}`` on the process
+    default telemetry, so recovery activity is a scrapeable signal and
+    not only a stderr line."""
+    from ppls_tpu.obs.telemetry import default_telemetry
+    default_telemetry().registry.counter(
+        "ppls_retries_total",
+        "retried transient failures by classified reason",
+        ("reason",)).labels(reason=reason).inc()
+
+
 def with_retry(fn, attempts_log, what="device section",
-               deadline: float = None, log=_log):
+               deadline: float = None, log=_log,
+               backoff_base: float = 10.0, backoff_cap: float = 120.0,
+               total_deadline: float = None):
     """Run ``fn`` under the watchdog deadline with up to MAX_ATTEMPTS
     tries, retrying ONLY transient infra errors (including watchdog
     expiry). FloatingPointError (the engines' NaN guard) and any
     non-transient exception propagate immediately. Each retried error is
-    appended to ``attempts_log`` for the caller's record."""
+    appended to ``attempts_log`` for the caller's record.
+
+    Round 14: the retry delay is DETERMINISTIC exponential backoff
+    (:func:`backoff_seconds` — base * 2^(attempt-1), capped; the
+    historical fixed 10 s is attempt 1 of the default schedule), every
+    retry counts into ``ppls_retries_total{reason}``, and
+    ``total_deadline`` bounds the WHOLE loop: when the elapsed wall
+    plus the next backoff would exceed it, the loop raises
+    :class:`RetryBudgetExhausted` instead of sleeping into a budget it
+    cannot keep."""
     if deadline is None:
         deadline = default_watchdog_seconds()
+    t_start = time.monotonic()
     for attempt in range(1, MAX_ATTEMPTS + 1):
         if attempt == 1 and os.environ.pop("PPLS_BENCH_INJECT_TRANSIENT",
                                            None):
@@ -114,6 +206,7 @@ def with_retry(fn, attempts_log, what="device section",
             attempts_log.append("injected: INTERNAL: simulated tunnel drop")
             log(f"[guard] {what}: injected transient error "
                 f"(attempt 1/{MAX_ATTEMPTS}); retrying")
+            _count_retry("injected")
             continue
         target = fn
         if attempt == 1 and os.environ.pop("PPLS_BENCH_INJECT_HANG", None):
@@ -128,11 +221,24 @@ def with_retry(fn, attempts_log, what="device section",
         except Exception as e:         # noqa: BLE001 — classified below
             msg = f"{type(e).__name__}: {e}"
             if is_transient(msg) and attempt < MAX_ATTEMPTS:
+                delay = backoff_seconds(attempt, backoff_base,
+                                        backoff_cap)
+                if total_deadline is not None and \
+                        time.monotonic() - t_start + delay \
+                        > total_deadline:
+                    raise RetryBudgetExhausted(
+                        f"{what}: total retry deadline "
+                        f"{total_deadline:.0f}s would be exceeded by "
+                        f"the next {delay:.0f}s backoff (attempt "
+                        f"{attempt}/{MAX_ATTEMPTS}); last failure: "
+                        f"{msg[:200]}") from e
                 attempts_log.append(msg[:300])
+                _count_retry("watchdog" if isinstance(e, HangTimeout)
+                             else "transient")
                 log(f"[guard] {what}: transient infra error "
                     f"(attempt {attempt}/{MAX_ATTEMPTS}): "
-                    f"{msg[:120]} ... retrying in 10s")
-                time.sleep(10)
+                    f"{msg[:120]} ... retrying in {delay:.0f}s")
+                time.sleep(delay)
                 continue
             raise
     raise RuntimeError(f"{what}: all {MAX_ATTEMPTS} attempts consumed "
@@ -140,7 +246,8 @@ def with_retry(fn, attempts_log, what="device section",
 
 
 def run_with_watchdog(run_fn, seconds: float, what: str = "engine run",
-                      resume_fn=None, log=_log):
+                      resume_fn=None, log=_log, telemetry=None,
+                      checkpoint_path: str = None):
     """CLI-level watchdog: run an engine under a deadline; on expiry,
     fall back to ``resume_fn`` (typically a checkpoint resume) once.
 
@@ -160,6 +267,11 @@ def run_with_watchdog(run_fn, seconds: float, what: str = "engine run",
     hang detector, not a scheduler); the bench's 900 s default
     (PPLS_BENCH_WATCHDOG_S) was sized to cover a cold compile on the
     slowest observed rig.
+
+    ``telemetry`` (round 14): when given, the recovery records its
+    PROVENANCE in the events timeline — a ``watchdog_resume`` event
+    naming which checkpoint the retry resumed from and which attempt
+    this was — so a post-mortem can attribute every resumed leg.
     """
     try:
         return with_deadline(run_fn, seconds, what)
@@ -167,4 +279,153 @@ def run_with_watchdog(run_fn, seconds: float, what: str = "engine run",
         if resume_fn is None:
             raise
         log(f"[guard] {what}: {e}; resuming from checkpoint")
+        if telemetry is not None:
+            telemetry.event(
+                "watchdog_resume", what=what, attempt=2,
+                deadline_s=float(seconds),
+                checkpoint=checkpoint_path or "",
+                reason=str(e)[:200])
+        _count_retry("watchdog")
         return with_deadline(resume_fn, seconds, f"{what} (resume)")
+
+
+class Supervisor:
+    """Self-healing recovery loop around a resumable engine run.
+
+    The round-14 growth of ``with_retry``/``run_with_watchdog``: one
+    loop that CLASSIFIES every failure (:func:`classify_failure`) and
+    applies the matching recovery instead of a single retry policy:
+
+    * ``transient`` (watchdog expiry, injected phase-boundary crash,
+      tunnel drops) — deterministic exponential backoff
+      (:func:`backoff_seconds`), then re-run ``run_fn``. ``run_fn``
+      must be SELF-RESUMING: a checkpointed serve loop that picks up
+      its own latest snapshot (the CLI's make-engine shape);
+    * ``chip_loss`` — call ``resize_fn(exc)``, which re-targets the
+      run at the surviving mesh (resize-resume through the elastic
+      ``mesh_resize`` checkpoint rule) and returns the replacement
+      ``run_fn``; a loss on a 1-chip mesh is fatal (nothing survives);
+    * ``poison`` — never retried here: engines running under this
+      supervisor quarantine poisoned requests at the retire boundary
+      (``StreamEngine(quarantine=True)``), so a surfacing
+      ``FloatingPointError`` means quarantine was off — re-raised with
+      that hint;
+    * ``fatal`` — re-raised.
+
+    Every classification and recovery emits a telemetry event
+    (``supervisor_failure`` / ``supervisor_recovery``) and counts into
+    ``ppls_supervisor_failures_total{kind}`` /
+    ``ppls_supervisor_recoveries_total{action}`` on the supervisor's
+    registry, so a fault-plan run's recovery story is fully
+    attribution-backed.
+
+    ``deadline`` (seconds) arms a per-attempt hang watchdog
+    (:func:`with_deadline`) around every run; size it well above a
+    healthy phase (the deadline-sizing contract above).
+    ``total_deadline`` bounds the whole supervised run: when the next
+    backoff would exceed it, :class:`RetryBudgetExhausted` is raised.
+    """
+
+    def __init__(self, run_fn, *, resize_fn=None,
+                 deadline: float = None,
+                 max_attempts: int = 2 * MAX_ATTEMPTS,
+                 backoff_base: float = 1.0, backoff_cap: float = 60.0,
+                 total_deadline: float = None,
+                 telemetry=None, log=_log, sleep=time.sleep):
+        self.run_fn = run_fn
+        self.resize_fn = resize_fn
+        self.deadline = deadline
+        self.max_attempts = int(max_attempts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.total_deadline = total_deadline
+        self.telemetry = telemetry
+        self._log = log
+        self._sleep = sleep
+        self.attempts = 0
+        self.recoveries = []      # (kind, action) history, for tests
+
+    def _event(self, name: str, **attrs) -> None:
+        if self.telemetry is not None:
+            self.telemetry.event(name, **attrs)
+
+    def _count(self, metric: str, label: str, value: str) -> None:
+        if self.telemetry is not None:
+            self.telemetry.registry.counter(
+                metric, "supervisor failure/recovery accounting",
+                (label,)).labels(**{label: value}).inc()
+
+    def _attempt(self):
+        if self.deadline is not None:
+            return with_deadline(self.run_fn, self.deadline,
+                                 "supervised run")
+        return self.run_fn()
+
+    def run(self):
+        t_start = time.monotonic()
+        backoff_attempt = 0       # resets after a successful resize
+        while True:
+            self.attempts += 1
+            try:
+                return self._attempt()
+            except BaseException as e:  # noqa: BLE001 — classified
+                kind = classify_failure(e)
+                msg = f"{type(e).__name__}: {e}"
+                self._event("supervisor_failure", kind=kind,
+                            attempt=self.attempts, error=msg[:200])
+                self._count("ppls_supervisor_failures_total", "kind",
+                            kind)
+                if kind == "chip_loss" and self.resize_fn is not None:
+                    surviving = getattr(e, "surviving", 0)
+                    if surviving < 1:
+                        self._log(f"[supervisor] {msg}: no chips "
+                                  f"survive; giving up")
+                        raise
+                    self._log(f"[supervisor] {msg}: resize-resuming "
+                              f"onto {surviving} chip(s)")
+                    self.run_fn = self.resize_fn(e)
+                    self.recoveries.append((kind, "resize_resume"))
+                    self._event("supervisor_recovery",
+                                action="resize_resume",
+                                surviving=surviving,
+                                attempt=self.attempts)
+                    self._count("ppls_supervisor_recoveries_total",
+                                "action", "resize_resume")
+                    backoff_attempt = 0
+                    continue
+                if kind == "transient" \
+                        and self.attempts < self.max_attempts:
+                    backoff_attempt += 1
+                    delay = backoff_seconds(
+                        backoff_attempt, self.backoff_base,
+                        self.backoff_cap)
+                    if self.total_deadline is not None and \
+                            time.monotonic() - t_start + delay \
+                            > self.total_deadline:
+                        raise RetryBudgetExhausted(
+                            f"supervised run: total deadline "
+                            f"{self.total_deadline:.0f}s would be "
+                            f"exceeded by the next {delay:.0f}s "
+                            f"backoff; last failure: {msg[:200]}"
+                        ) from e
+                    self._log(f"[supervisor] transient failure "
+                              f"(attempt {self.attempts}/"
+                              f"{self.max_attempts}): {msg[:120]} "
+                              f"... resuming in {delay:.1f}s")
+                    self.recoveries.append((kind, "backoff_resume"))
+                    self._event("supervisor_recovery",
+                                action="backoff_resume",
+                                backoff_s=delay,
+                                attempt=self.attempts)
+                    self._count("ppls_supervisor_recoveries_total",
+                                "action", "backoff_resume")
+                    self._count("ppls_retries_total", "reason",
+                                "supervisor")
+                    self._sleep(delay)
+                    continue
+                if kind == "poison":
+                    self._log(f"[supervisor] poisoned data surfaced "
+                              f"({msg[:120]}); enable engine-level "
+                              f"quarantine to retire it as a failed "
+                              f"record instead")
+                raise

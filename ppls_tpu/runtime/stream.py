@@ -73,7 +73,8 @@ from jax import lax
 from ppls_tpu.config import Rule
 from ppls_tpu.obs.flight import ChipFlightRecorder
 from ppls_tpu.obs.telemetry import Telemetry
-from ppls_tpu.parallel.bag_engine import DEPTH_BITS, BagState
+from ppls_tpu.parallel.bag_engine import (DEPTH_BITS, DEPTH_MASK,
+                                          BagState)
 from ppls_tpu.parallel.walker import (
     DEFAULT_LANES,
     N_WASTE,
@@ -135,6 +136,12 @@ class CompletedRequest:
     # submission order (len == len(request.theta)); None on scalar
     # engines so pre-round-13 snapshots replay unchanged
     areas: Optional[List[float]] = None
+    # round 14: True when the request retired through the QUARANTINE
+    # path (non-finite area on an engine running with quarantine=True)
+    # — the area fields then carry the non-finite values for the
+    # record, and consumers must treat the request as FAILED, not
+    # integrate-d. Default False keeps pre-round-14 snapshots loading.
+    failed: bool = False
 
     @property
     def phases_in_flight(self) -> int:
@@ -324,7 +331,9 @@ class StreamEngine:
                  mesh=None, n_devices: Optional[int] = None,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 8,
-                 telemetry: Optional[Telemetry] = None):
+                 telemetry: Optional[Telemetry] = None,
+                 quarantine: bool = False,
+                 fault_injector=None):
         from ppls_tpu.models.integrands import get_family, get_family_ds
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -459,6 +468,20 @@ class StreamEngine:
         # dead-slot fill can be an in-domain point of a real request)
         self._dev = None
         self._fill = None            # (fill_x, fill_th)
+
+        # round 14: per-request NaN quarantine — a non-finite area at
+        # retirement emits a FAILED CompletedRequest and frees the slot
+        # while every healthy concurrent request retires normally,
+        # instead of the engine-wide FloatingPointError (which stays
+        # the default: loud is right when nobody supervises)
+        self.quarantine = bool(quarantine)
+        self._c_quarantined = tel.registry.counter(
+            "ppls_stream_quarantined_total",
+            "requests retired as failed through the NaN quarantine")
+        # round 14: seeded fault injection (runtime/faults.py) — hooks
+        # fire at the boundaries this engine already owns; None = no
+        # plan armed, zero overhead
+        self.fault_injector = fault_injector
 
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = max(int(checkpoint_every), 1)
@@ -710,6 +733,15 @@ class StreamEngine:
             if self._theta_block > 1:
                 pad = row + (row[0],) * (self._theta_block - len(row))
                 self._theta_table[slot] = pad
+            if self.fault_injector is not None \
+                    and self.fault_injector.on_admit(req.rid):
+                # nan_poison: corrupt the admitted theta payload AFTER
+                # submit-time validation — poison that slipped the
+                # gate; the engine genuinely computes with it and the
+                # slot's area goes non-finite at retirement
+                sth[i] = float("nan")
+                if self._theta_block > 1:
+                    self._theta_table[slot] = float("nan")
             sm[i] = np.int32(slot << DEPTH_BITS)
             clear[slot] = True       # recycle: zero the slot's acc pair
             self._slot_req[slot] = req
@@ -921,11 +953,20 @@ class StreamEngine:
                 f"{self.engine}-stream", int(cache_size()),
                 wall_s=step_wall_s)
 
+    def _mesh_width(self) -> int:
+        return self._mesh.devices.size if self._mesh is not None else 1
+
     def step(self) -> List[CompletedRequest]:
         """One phase: admit -> cycle -> retire. Returns the requests
         retired this phase (empty when idle)."""
         tel = self.telemetry
         t_step0 = time.perf_counter()
+        if self.fault_injector is not None:
+            # phase-OPEN fault boundary (before admission, before the
+            # phase span): a crash/chip-loss here is the worst resume
+            # point — this phase's admissions replay in the recovery
+            self.fault_injector.on_phase_open(self.phase,
+                                              n_dev=self._mesh_width())
         span = tel.span("phase", phase=self.phase)
         self._admit()
         if self._count == 0 and not self._slot_req:
@@ -981,13 +1022,23 @@ class StreamEngine:
                 areas = None
                 area = float(acc[slot] + acc_c[slot])
                 finite = np.isfinite(area)
-            if not finite:
+            if not finite and not self.quarantine:
                 tel.event("nan_retire", rid=req.rid, slot=slot,
                           phase=self.phase)
                 span.close(error="nan_retire")
                 raise FloatingPointError(
                     f"stream request {req.rid} produced a non-finite "
                     f"area — refusing to report garbage")
+            if not finite:
+                # round 14 quarantine: the poison stays contained in
+                # this slot's accumulator lane, which the recycle path
+                # clears at the slot's next admission — every healthy
+                # concurrent request retires through the branch below
+                # untouched. The failed record keeps the request's
+                # latency accounting so SLO math sees the failure.
+                tel.event("quarantine", rid=req.rid, slot=slot,
+                          phase=self.phase)
+                self._c_quarantined.inc()
             c = CompletedRequest(
                 rid=req.rid, theta=req.theta, bounds=req.bounds,
                 area=area, areas=areas,
@@ -996,7 +1047,8 @@ class StreamEngine:
                 retire_phase=self.phase,
                 latency_s=now - req.submit_t,
                 first_seeded_phase=int(self._fam_first[slot]),
-                last_credited_phase=int(fam_last[slot]))
+                last_credited_phase=int(fam_last[slot]),
+                failed=not finite)
             retired.append(c)
             self._free.append(slot)
             self._c_retired.inc()
@@ -1004,9 +1056,13 @@ class StreamEngine:
             self._h_lat_seconds.observe(c.latency_s)
             # every attr below except latency_s is device-counted or
             # schedule-determined: bit-stable across rerun and resume
-            tel.event("retire", rid=c.rid, slot=slot, area=c.area,
-                      **({"areas": c.areas} if c.areas is not None
-                         else {}),
+            # (failed retirements carry area=None — the non-finite
+            # payload would not be strict JSON)
+            tel.event("retire", rid=c.rid, slot=slot,
+                      area=(c.area if finite else None),
+                      **({"areas": c.areas}
+                         if c.areas is not None and finite else {}),
+                      failed=c.failed,
                       submit_phase=c.submit_phase,
                       admit_phase=c.admit_phase,
                       retire_phase=c.retire_phase,
@@ -1024,6 +1080,13 @@ class StreamEngine:
         if self.checkpoint_path and \
                 self.phase % self.checkpoint_every == 0:
             self.snapshot()
+        if self.fault_injector is not None:
+            # phase-CLOSE fault boundary (after the snapshot, so a
+            # close-keyed crash resumes from this phase's freshest
+            # state); self.phase already advanced — key on the phase
+            # that just closed
+            self.fault_injector.on_phase_close(
+                self.phase - 1, n_dev=self._mesh_width())
         return retired
 
     def drain(self, max_phases: int = 1 << 14,
@@ -1176,6 +1239,11 @@ class StreamEngine:
             "checkpoint", phase=self.phase, count=count,
             pending=len(self._pending), resident=len(self._slot_req),
             completed=len(self.completed))
+        if self.fault_injector is not None:
+            # checkpoint-write fault boundary: ckpt_truncate /
+            # ckpt_corrupt damage the snapshot just renamed into place
+            self.fault_injector.on_checkpoint_write(
+                self.checkpoint_path)
 
     def _snapshot_dd_state(self):
         """Per-chip device state for a dd-stream snapshot: live bag
@@ -1220,16 +1288,24 @@ class StreamEngine:
 
     @classmethod
     def resume(cls, checkpoint_path: str, family: str, eps: float,
-               **kwargs) -> "StreamEngine":
+               mesh_resize: bool = False, **kwargs) -> "StreamEngine":
         """Rebuild a StreamEngine from its last snapshot. The engine
         configuration kwargs must match the snapshotted run (identity-
         checked); the continued stream replays the identical per-phase
-        computation."""
+        computation.
+
+        ``mesh_resize=True`` (round 14, ``engine="walker-dd"``):
+        elastic resume — a snapshot taken on an n-chip mesh may resume
+        onto this engine's m != n chips. The per-chip queues re-deal
+        depth-stratified (``mesh.host_strided_redeal``), counters
+        reshard sum-preserving, and the queue/slot/latency bookkeeping
+        carries over untouched; retirement and per-request areas
+        continue seamlessly on the surviving mesh."""
         from ppls_tpu.runtime.checkpoint import load_family_checkpoint
         eng = cls(family, eps, checkpoint_path=checkpoint_path,
                   **kwargs)
         bag_cols, count, acc_pair, totals = load_family_checkpoint(
-            checkpoint_path, eng._identity())
+            checkpoint_path, eng._identity(), mesh_resize=mesh_resize)
         eng.phase = int(totals["phase"])
         eng._next_rid = int(totals["next_rid"])
         eng._fam_first = np.asarray(totals["fam_first"],
@@ -1310,6 +1386,8 @@ class StreamEngine:
             self._c_admitted.inc(n_admitted)
         for c in self.completed:
             self._c_retired.inc()
+            if c.failed:
+                self._c_quarantined.inc()
             self._h_lat_phases.observe(c.latency_phases)
             self._h_lat_seconds.observe(c.latency_s)
         self._publish_gauges()
@@ -1328,6 +1406,13 @@ class StreamEngine:
         counts = np.asarray(bag_cols.get("counts",
                                          np.zeros(n_dev, np.int32)),
                             dtype=np.int32)
+        n_old = counts.shape[0]
+        if n_old != n_dev:
+            # elastic resume (round 14): the snapshot's mesh size
+            # differs — re-deal queues and reshard counters onto THIS
+            # engine's mesh before the store rebuild below
+            bag_cols, counts, acc, dd = self._resize_dd_snapshot(
+                bag_cols, counts, acc, dd, n_old)
         if bag_cols:
             bl = device_store(n_dev, store, fill_x, bag_cols["l"])
             br = device_store(n_dev, store, fill_x, bag_cols["r"])
@@ -1383,6 +1468,97 @@ class StreamEngine:
         if "flight_streak" in dd:
             self._flight._streak = [int(v)
                                     for v in dd["flight_streak"]]
+
+    def _resize_dd_snapshot(self, bag_cols, counts, acc, dd,
+                            n_old: int):
+        """Re-target an n_old-chip dd-stream snapshot at this engine's
+        mesh (elastic resume): depth-stratified host re-deal of the
+        per-chip queues (the same key ``phase_reshard`` deals by),
+        sum-preserving counter reshard (replicated counters — crounds,
+        maxd — replicate their maxima), and the host delta trackers
+        REBUILT from the new layout so the first post-resize phase row
+        reports exact deltas. The straggler streak resets: per-chip
+        history cannot be attributed across a topology change."""
+        from ppls_tpu.parallel.mesh import host_strided_redeal
+        from ppls_tpu.parallel.sharded_walker import (CTR64, _CTR64_MAX)
+        n_dev, store = self._dd_n_dev, self._dd_store
+        fill_x, fill_th = self._fill
+        m_eff = self.slots * self._theta_block
+
+        if bag_cols:
+            cols = {k: np.asarray(bag_cols[k])
+                    for k in ("l", "r", "th", "meta")}
+            dealt, counts = host_strided_redeal(
+                cols, counts, n_dev,
+                fills={"l": fill_x, "r": fill_x, "th": fill_th,
+                       "meta": 0},
+                sort_key=np.asarray(bag_cols["meta"]) & DEPTH_MASK)
+            b_new = dealt["l"].shape[1]
+            if b_new > store or int(counts.max(initial=0)) > store:
+                raise ValueError(
+                    f"mesh-resize resume: the re-dealt per-chip queue "
+                    f"({b_new} rows) does not fit the {store}-row "
+                    f"store of the {n_dev}-chip engine; raise "
+                    f"capacity (or resume onto more chips)")
+            bag_cols = dict(dealt, counts=counts)
+        else:
+            counts = np.zeros(n_dev, np.int32)
+
+        def place_sum(vec, dtype):
+            v = np.asarray(vec, dtype=dtype).reshape(n_old, -1)
+            res = np.zeros((n_dev, v.shape[1]), dtype=dtype)
+            res[0] = v.sum(axis=0)
+            return res
+
+        ctr_new = []
+        for k, v in zip(CTR64, dd["ctr"]):
+            if k in _CTR64_MAX:
+                ctr_new.append(np.full(
+                    n_dev, np.asarray(v, np.int64).max(initial=0),
+                    np.int64))
+            else:
+                ctr_new.append(place_sum(v, np.int64)[:, 0])
+        waste_new = place_sum(dd["waste"], np.int64)
+        evals_new = place_sum(dd.get("evals",
+                                     np.zeros((n_old, 2))), np.int64)
+        maxd_new = np.full(
+            n_dev, np.asarray(dd["maxd"], np.int32).max(initial=0),
+            np.int32)
+        ovf_new = np.full(n_dev, bool(np.any(np.asarray(dd["ovf"]))),
+                          dtype=bool)
+        acc = np.asarray(acc, np.float64).reshape(n_old, m_eff)
+        acc_new = np.zeros((n_dev, m_eff), np.float64)
+        # re-associating the cross-chip sum: exact (dyadic) workloads
+        # stay bit-identical, ds workloads move within the documented
+        # ~1e-9 schedule contract
+        acc_new[0] = acc.sum(axis=0)
+
+        dd = dict(dd)
+        dd["ctr"] = [c.tolist() for c in ctr_new]
+        dd["waste"] = waste_new.tolist()
+        dd["evals"] = evals_new.tolist()
+        dd["maxd"] = maxd_new.tolist()
+        dd["ovf"] = ovf_new.tolist()
+        # delta trackers: recomputed from the NEW layout (the stored
+        # ones describe the old mesh — crounds' per-chip sum changes
+        # with the chip count even though the replicated value did not)
+        dd["prev"] = [int(c.sum()) for c in ctr_new]
+        dd["prev_waste"] = waste_new.sum(axis=0).tolist()
+        dd["prev_evals"] = evals_new.sum(axis=0).tolist()
+        dd["prev_acc"] = acc_new.sum(axis=0).tolist()
+        idx = {k: i for i, k in enumerate(CTR64)}
+        dd["prev_chip"] = {
+            "wsteps": ctr_new[idx["wsteps"]].tolist(),
+            "tasks": ctr_new[idx["tasks"]].tolist(),
+            "crounds": ctr_new[idx["crounds"]].tolist(),
+            "waste": waste_new.tolist(),
+        }
+        dd["prev_count"] = counts.astype(np.int64).tolist()
+        dd["flight_streak"] = [0] * n_dev
+        self.telemetry.event(
+            "mesh_resize", n_old=n_old, n_new=n_dev,
+            rows=int(counts.sum()))
+        return bag_cols, counts, acc_new, dd
 
     def _restore_device(self, bag_cols, count, acc_pair, fam_last):
         d = self._dev

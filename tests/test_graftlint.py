@@ -708,3 +708,55 @@ def test_gl05_theta_block_loop_fed_static_flagged(tmp_path):
     got = [v for v in run_lint(pkg) if v.code == "GL05"]
     assert [v.symbol for v in got] == \
         ["sweep:run_theta.theta_block:loop-varying"], got
+
+
+# ---------------------------------------------------------------------------
+# Round 14 — the elastic mesh_resize compat rule vs the GL01 surface
+# ---------------------------------------------------------------------------
+
+GL01_RESIZE_BROKEN = """
+    from typing import NamedTuple
+
+    class _ElasticCarry(NamedTuple):
+        bag_l: object
+        acc: object
+        n_dev: object    # <- mesh size: per-chip state the resume
+        #                   must re-deal, so it is identity
+
+    def run_cycles(c: _ElasticCarry):
+        return c
+
+    def integrate(state, checkpoint_path):
+        out = run_cycles(state)
+        identity = {"engine": "walker-dd", "eps": 1e-6}
+        save_family_checkpoint(
+            checkpoint_path, identity=identity,
+            bag_cols={"l": out.bag_l}, count=1, acc=out.acc,
+            totals={})
+        return out
+
+    def resume(path, identity):
+        return load_family_checkpoint(path, identity,
+                                      mesh_resize=True)
+"""
+
+
+def test_gl01_mesh_resize_keyword_does_not_cover_n_dev(tmp_path):
+    # the round-14 compat rule relaxes the n_dev COMPARISON at load
+    # time — it must not relax the GL01 surface: a dd carry whose
+    # n_dev never reaches the identity dict still fires even though
+    # the resume path spells "mesh_resize"
+    pkg = _mkpkg(tmp_path,
+                 {"parallel/sharded_walker.py": GL01_RESIZE_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL01"]
+    assert [v.symbol for v in got] == ["_ElasticCarry.n_dev"], got
+
+
+def test_gl01_mesh_resize_fixed_by_identity_key(tmp_path):
+    # the real engines' shape: n_dev ON the identity (the elastic
+    # loader then relaxes exactly that one key under mesh_resize)
+    fixed = GL01_RESIZE_BROKEN.replace(
+        '{"engine": "walker-dd", "eps": 1e-6}',
+        '{"engine": "walker-dd", "eps": 1e-6, "n_dev": 8}')
+    pkg = _mkpkg(tmp_path, {"parallel/sharded_walker.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL01"] == []

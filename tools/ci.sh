@@ -6,12 +6,27 @@
 #       walker run is forced through the round-12 f32 scouting kernel
 #       (mirroring the PPLS_DEBUG_NANS opt-in lane), so the scout path
 #       cannot rot between TPU-attached rounds
+#   1c. the same tier-1 suite with PPLS_CHAOS=1 — every checkpoint
+#       write immediately re-opens and checksum-verifies itself
+#       (runtime/checkpoint.py's verify-on-write lane) and the serve
+#       CLI always routes through the Supervisor, so the round-14
+#       integrity/recovery machinery re-proves itself suite-wide
 #   2. `pip install -e .` smoke + `ppls-tpu --help` console script
 #   3. artifact schema check (BENCH_r*/MULTICHIP_r* round JSONs)
 #   4. graftlint static analysis (GL01-GL06 vs the committed baseline)
 #   5. serve telemetry smoke: a short seeded synthetic Poisson load
 #      through `ppls-tpu serve --events`, then the event-log schema
 #      check (the round-10 timeline artifact must stay valid end-to-end)
+#   5b. seeded CHAOS drain (round 14): `ppls-tpu serve` under the
+#       committed fault plans — stage 1 (tools/chaos_plan.json, dd
+#       stream on the virtual 8-mesh): NaN poison + injected hang +
+#       chip loss, the supervisor must quarantine / watchdog-resume /
+#       resize-resume onto 7 chips and drain green; stage 2
+#       (tools/chaos_plan_ckpt.json, single chip): snapshot corruption
+#       + phase-boundary crash, the resume must detect the corrupt
+#       file and self-heal by starting fresh. Both timelines validate
+#       through tools/check_artifacts.py --events (crashed prefixes
+#       allowed), and the summaries' recovery records are asserted.
 #   6. bench observatory: tools/bench_history.py --check over the
 #      committed round artifacts + the quick-proxy regression gate
 #      (device-counted proxies vs tools/bench_quick_ref.json)
@@ -60,6 +75,24 @@ echo "SCOUT_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
     /tmp/_t1_scout.log | tr -cd . | wc -c)"
 if [ "$rc" -ne 0 ]; then
     echo "ci: PPLS_SCOUT=1 lane FAILED (rc=$rc)"
+    FAILURES=$((FAILURES + 1))
+fi
+
+# --- 1c. tier-1 again with the CHAOS lane armed (PPLS_CHAOS=1) ---
+# Verify-on-write for every snapshot + supervisor-routed serve CLI:
+# the integrity machinery runs on every checkpointed test instead of
+# only the dedicated corruption tests.
+step "tier-1 suite under PPLS_CHAOS=1 (checkpoint-integrity lane)"
+rm -f /tmp/_t1_chaos.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu PPLS_CHAOS=1 \
+    python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1_chaos.log
+rc=${PIPESTATUS[0]}
+echo "CHAOS_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_t1_chaos.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "ci: PPLS_CHAOS=1 lane FAILED (rc=$rc)"
     FAILURES=$((FAILURES + 1))
 fi
 
@@ -127,6 +160,83 @@ else
     FAILURES=$((FAILURES + 1))
 fi
 rm -f "$EV_FILE"
+
+# --- 5b. seeded chaos drain: committed fault plans must recover ---
+step "serve --fault-plan chaos drain (hang + chip-loss + corrupt ckpt + NaN)"
+CH_DIR="$(mktemp -d)"
+chaos_fail=0
+# stage 1: dd stream on the virtual 8-mesh — NaN poison (quarantine),
+# injected hang (watchdog resume), chip loss (resize-resume onto 7)
+# timeout wrapper: this stage INJECTS a hang — if the watchdog/
+# supervisor plumbing it exists to test ever regresses, the hang must
+# fail CI, not wedge it
+if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m ppls_tpu serve \
+        --engine walker-dd --n-devices 8 \
+        --synthetic 6 --arrival-rate 2 --seed 0 --eps 1e-6 \
+        -a 1e-2 -b 1.0 --slots 8 --chunk 256 --capacity 65536 \
+        --lanes 256 --refill-slots 2 \
+        --checkpoint "$CH_DIR/s1.ckpt" --checkpoint-every 1 \
+        --watchdog 60 --events "$CH_DIR/s1.jsonl" \
+        --fault-plan @tools/chaos_plan.json \
+        > "$CH_DIR/s1.out" 2> "$CH_DIR/s1.err"; then
+    python - "$CH_DIR/s1.out" <<'PYEOF' || chaos_fail=1
+import json, sys
+lines = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+s = lines[-1]
+assert s.get("summary") and s.get("supervised"), "not supervised"
+assert s["completed"] == 6, s["completed"]
+assert s.get("failed") == 1, ("quarantine", s.get("failed"))
+actions = [r["action"] for r in s["recoveries"]]
+assert "resize_resume" in actions, actions      # chip loss recovered
+assert "backoff_resume" in actions, actions     # hang recovered
+kinds = {e["kind"] for e in s["faults_injected"]}
+assert kinds == {"nan_poison", "hang", "chip_loss"}, kinds
+print("ci: chaos stage 1 OK (quarantine + watchdog + resize-resume)")
+PYEOF
+else
+    echo "ci: chaos stage 1 serve FAILED"
+    chaos_fail=1
+fi
+python tools/check_artifacts.py --events "$CH_DIR/s1.jsonl" \
+    --unbalanced-ok || chaos_fail=1
+# stage 2: snapshot corruption + phase-boundary crash — the resume
+# must refuse the damaged file (CheckpointCorruptError) and self-heal
+# by starting fresh
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m ppls_tpu serve \
+        --synthetic 6 --arrival-rate 2 --seed 0 --eps 1e-6 \
+        -a 1e-2 -b 1.0 --slots 8 --chunk 512 --capacity 65536 \
+        --lanes 256 --refill-slots 2 \
+        --checkpoint "$CH_DIR/s2.ckpt" --checkpoint-every 1 \
+        --watchdog 120 --events "$CH_DIR/s2.jsonl" \
+        --fault-plan @tools/chaos_plan_ckpt.json \
+        > "$CH_DIR/s2.out" 2> "$CH_DIR/s2.err"; then
+    python - "$CH_DIR/s2.out" "$CH_DIR/s2.err" <<'PYEOF' || chaos_fail=1
+import json, sys
+lines = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+s = lines[-1]
+assert s.get("summary") and s.get("supervised"), "not supervised"
+assert s["completed"] == 6, s["completed"]
+kinds = {e["kind"] for e in s["faults_injected"]}
+assert kinds == {"ckpt_corrupt", "crash"}, kinds
+err = open(sys.argv[2]).read()
+assert "starting fresh" in err, "corrupt-snapshot fresh start not taken"
+print("ci: chaos stage 2 OK (corrupt snapshot -> fresh start)")
+PYEOF
+else
+    echo "ci: chaos stage 2 serve FAILED"
+    chaos_fail=1
+fi
+python tools/check_artifacts.py --events "$CH_DIR/s2.jsonl" \
+    --unbalanced-ok || chaos_fail=1
+rm -rf "$CH_DIR"
+if [ "$chaos_fail" -ne 0 ]; then
+    echo "ci: seeded chaos drain FAILED"
+    FAILURES=$((FAILURES + 1))
+else
+    echo "ci: seeded chaos drain OK"
+fi
 
 # --- 6. bench observatory: trajectory check + quick-proxy gate ---
 # tools/bench_history.py --check normalizes the committed
