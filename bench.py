@@ -1193,6 +1193,22 @@ def bench_stream(k: int = 24, quick=None) -> dict:
             f"{rs.requests_per_sec:.2f} req/s, p50/p99 "
             f"{lat.get('p50_phases')}/{lat.get('p99_phases')} phases")
 
+    # --- round 16: multi-tenant overload SLO proxies (owned by
+    # tools/bench_history.run_stream_slo_proxies — the same function
+    # feeds the committed gate reference and the CI --gate-run
+    # measurement, so the gate can never measure a different
+    # workload): Poisson overload at ~8 req/phase over three priority
+    # classes, bounded queue, chaos injected (NaN poison +
+    # straggler). Shed fraction + per-class tail latency are the
+    # regression-guarded numbers.
+    from tools.bench_history import run_stream_slo_proxies
+    log("[bench-stream] multi-tenant overload leg (chaos armed) ...")
+    mt = run_stream_slo_proxies()
+    log(f"[bench-stream] multi-tenant: {mt['completed']} completed, "
+        f"{mt['shed']} shed (fraction {mt['shed_fraction']}), "
+        f"{mt['failed']} quarantined, per-class p99 "
+        f"{ {k: v['p99_phases'] for k, v in mt['latency_by_class'].items()} }")
+
     lat = res.latency_percentiles()
     return {
         "metric": "stream requests/sec (saturated)",
@@ -1225,6 +1241,7 @@ def bench_stream(k: int = 24, quick=None) -> dict:
         "p99_latency_phases": lat.get("p99_phases"),
         "occupancy": res.occupancy_summary(lanes),
         "offered_load_sweep": sweep,
+        "multi_tenant": mt,
     }
 
 
@@ -1325,9 +1342,28 @@ def main_theta():
 
 
 def main_stream():
-    """Standalone mode (``python bench.py stream [--quick]``)."""
+    """Standalone mode (``python bench.py stream [--quick]
+    [--tenants]``). ``--tenants`` runs ONLY the round-16 multi-tenant
+    overload leg (mixed tenants + priorities, bounded queue, chaos
+    injected) and prints its standalone record — the fast spelling of
+    the dispatcher-tier bench target."""
     from ppls_tpu.utils.artifact_schema import validate_record
     quick = True if "--quick" in sys.argv else None
+    if "--tenants" in sys.argv:
+        from tools.bench_history import run_stream_slo_proxies
+        try:
+            mt = run_stream_slo_proxies()
+        except Exception as e:  # noqa: BLE001 — one JSON line always
+            print(json.dumps(validate_record(
+                {"metric": "multi-tenant overload SLO proxies",
+                 "value": 0.0, "unit": "requests/s",
+                 "vs_baseline": 0.0, "error": str(e)})))
+            return 1
+        rec = dict(mt, value=float(mt["requests_per_sec"]),
+                   unit="requests/s (mixed tenants, chaos injected)",
+                   vs_baseline=float(mt["shed_fraction"]))
+        print(json.dumps(validate_record(rec)))
+        return 0
     try:
         rec = bench_stream(quick=quick)
     except Exception as e:  # noqa: BLE001 — one JSON line always

@@ -37,6 +37,59 @@ def theta_batch_arg(s: str):
     return float(s)
 
 
+def tenant_quotas_arg(s: str) -> dict:
+    """``--tenant-quotas`` argparse type: inline JSON or ``@file.json``
+    mapping tenant name -> {"rate": R, "burst": B} token-bucket quota
+    (``"*"`` is the default for tenants without their own entry)."""
+    s = s.strip()
+    try:
+        if s.startswith("@"):
+            with open(s[1:], encoding="utf-8") as fh:
+                data = json.load(fh)
+        else:
+            data = json.loads(s)
+    except (OSError, json.JSONDecodeError) as e:
+        raise argparse.ArgumentTypeError(
+            f"tenant quotas must be JSON or @file: {e}")
+    if not isinstance(data, dict) or not all(
+            isinstance(v, dict) for v in data.values()):
+        raise argparse.ArgumentTypeError(
+            "tenant quotas must be an object of per-tenant "
+            '{"rate": R, "burst": B} objects')
+    return data
+
+
+def tenants_arg(s: str) -> list:
+    """``--tenants`` argparse type (synthetic load): either an integer
+    N (tenants t0..tN-1, weight 1, priority i mod 3) or a
+    ``name:weight:priority`` comma list — the deterministic tenant mix
+    the bench/CI overload legs drive."""
+    s = s.strip()
+    if s.isdigit():
+        if int(s) < 1:
+            raise argparse.ArgumentTypeError(
+                "tenant count must be >= 1")
+        return [(f"t{i}", 1, i % 3) for i in range(int(s))]
+    out = []
+    for part in s.split(","):
+        bits = part.strip().split(":")
+        name = bits[0]
+        try:
+            weight = int(bits[1]) if len(bits) > 1 else 1
+            pri = int(bits[2]) if len(bits) > 2 else 1
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"bad tenant spec {part!r}: want name:weight:priority")
+        if not name or weight < 1:
+            raise argparse.ArgumentTypeError(
+                f"bad tenant spec {part!r}: non-empty name, "
+                f"weight >= 1")
+        out.append((name, weight, pri))
+    if not out:
+        raise argparse.ArgumentTypeError("empty tenant spec")
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m ppls_tpu",
@@ -315,6 +368,51 @@ def build_parser() -> argparse.ArgumentParser:
                           "while healthy concurrent requests retire "
                           "normally, instead of an engine-wide "
                           "FloatingPointError")
+    srv.add_argument("--ingest-port", type=int, default=None,
+                     metavar="PORT", dest="ingest_port",
+                     help="round 16: accept request records over HTTP "
+                          "for the lifetime of the run (POST /submit, "
+                          "JSONL body; one JSONL verdict per line — "
+                          "rid ack, shed record, or per-line "
+                          "rejection; 0 = ephemeral port, announced "
+                          "on stderr and the summary line). An "
+                          "accepted ack means the request is in the "
+                          "checkpointed queue: a SIGTERM after it is "
+                          "never lost. The loop then runs until "
+                          "SIGTERM/SIGINT")
+    srv.add_argument("--queue-limit", type=int, default=None,
+                     dest="queue_limit",
+                     help="bound the pending queue: an arrival that "
+                          "would overflow it triggers the "
+                          "deterministic shed policy (lowest-priority-"
+                          "oldest victim; the arrival itself when it "
+                          "does not outrank one), each shed an "
+                          "explicit JSONL rejection record + "
+                          "request_shed event (default: unbounded)")
+    srv.add_argument("--tenant-quotas", type=tenant_quotas_arg,
+                     default=None, dest="tenant_quotas",
+                     metavar="JSON|@FILE",
+                     help="per-tenant token-bucket admission quotas: "
+                          '{"pro": {"rate": 4, "burst": 8}, '
+                          '"*": {...}} — rate tokens/phase up to '
+                          "burst; an out-of-tokens tenant's requests "
+                          "wait, they are not shed")
+    srv.add_argument("--deadline-phases", type=int, default=None,
+                     dest="deadline_phases",
+                     help="default per-request deadline (device "
+                          "phases from submit): a queued request that "
+                          "can no longer meet it is shed, an in-"
+                          "flight one retires failed with "
+                          "deadline_exceeded and its work is "
+                          "cancelled; JSONL requests may override "
+                          "per-request")
+    srv.add_argument("--tenants", type=tenants_arg, default=None,
+                     metavar="N|SPEC",
+                     help="synthetic load only: assign tenants/"
+                          "priorities to the generated requests — an "
+                          "integer N (t0..tN-1, priority i mod 3) or "
+                          "a name:weight:priority comma list "
+                          "(deterministic weighted round-robin)")
     srv.add_argument("--fault-plan", default=None, metavar="SPEC",
                      dest="fault_plan",
                      help="arm seeded fault injection "
@@ -531,22 +629,32 @@ def _main_serve(args) -> int:
     from ppls_tpu.config import Rule
 
     # ---- materialize the request list + open-loop arrival schedule ----
+    # Round 16: every request is a (theta, bounds, kwargs) triple —
+    # kwargs carry tenant/priority/deadline_phases. A malformed JSONL
+    # line emits a per-line rejection record and the loop CONTINUES
+    # (the never-crash ingest contract); the same parser backs the
+    # --ingest-port HTTP path.
+    from ppls_tpu.runtime.ingest import parse_request_record
+    T = int(getattr(args, "theta_block", 1))
     if args.requests:
         fh = sys.stdin if args.requests == "-" else open(args.requests)
         try:
             reqs, arrivals = [], []
-            for line in fh:
+            for lineno, line in enumerate(fh, 1):
                 line = line.strip()
                 if not line:
                     continue
-                d = json.loads(line)
-                th = d["theta"]
-                th = (tuple(float(x) for x in th)
-                      if isinstance(th, list) else float(th))
-                reqs.append((th,
-                             (float(d["bounds"][0]),
-                              float(d["bounds"][1]))))
-                arrivals.append(int(d.get("arrival_phase", 0)))
+                try:
+                    rec = parse_request_record(json.loads(line),
+                                               theta_block=T)
+                except (json.JSONDecodeError, ValueError) as e:
+                    print(json.dumps({
+                        "rejected": True, "line": lineno,
+                        "error": str(e)[:200]}), flush=True)
+                    continue
+                arrivals.append(int(rec.pop("arrival_phase", 0)))
+                reqs.append((rec.pop("theta"), rec.pop("bounds"),
+                             rec))
         finally:
             if fh is not sys.stdin:
                 fh.close()
@@ -554,7 +662,6 @@ def _main_serve(args) -> int:
         # deterministic Poisson-ish open-loop load: exponential
         # interarrivals at --arrival-rate requests/phase, seeded
         rng = np.random.default_rng(args.seed)
-        T = int(getattr(args, "theta_block", 1))
         k = int(args.synthetic)
         if args.theta is not None:
             tv = args.theta
@@ -572,11 +679,22 @@ def _main_serve(args) -> int:
             thetas = np.linspace(args.theta0, args.theta1, k * max(T, 1),
                                  endpoint=False)
             blocks = [tuple(thetas[i * T:(i + 1) * T]) for i in range(k)]
-        gaps = rng.exponential(1.0 / max(args.arrival_rate, 1e-9), k)
-        arrivals = np.floor(np.cumsum(gaps) - gaps[0]).astype(int)
-        reqs = [((b if T > 1 else float(b[0])), (args.a, args.b))
-                for b in blocks]
-        arrivals = [int(p) for p in arrivals]
+        if k:
+            gaps = rng.exponential(1.0 / max(args.arrival_rate, 1e-9),
+                                   k)
+            arrivals = [int(p) for p in
+                        np.floor(np.cumsum(gaps) - gaps[0]).astype(int)]
+        else:
+            arrivals = []          # pure-ingest service: no batch load
+        # deterministic weighted round-robin tenant/priority mix
+        cycle = [("default", 1)]
+        if args.tenants:
+            cycle = [(name, pri) for name, weight, pri in args.tenants
+                     for _ in range(weight)]
+        reqs = [((b if T > 1 else float(b[0])), (args.a, args.b),
+                 {"tenant": cycle[i % len(cycle)][0],
+                  "priority": cycle[i % len(cycle)][1]})
+                for i, b in enumerate(blocks)]
 
     # the serve loop admits in list order gated on arrival_phase — an
     # out-of-order JSONL entry would head-of-line block everything
@@ -594,7 +712,10 @@ def _main_serve(args) -> int:
               reduced_integrands=args.reduced_integrands,
               theta_block=int(getattr(args, "theta_block", 1)),
               engine=args.engine,
-              checkpoint_every=args.checkpoint_every)
+              checkpoint_every=args.checkpoint_every,
+              queue_limit=args.queue_limit,
+              tenant_quotas=args.tenant_quotas,
+              default_deadline_phases=args.deadline_phases)
     if args.lanes:
         kw["lanes"] = args.lanes
 
@@ -643,6 +764,28 @@ def _main_serve(args) -> int:
     injector = (FaultInjector(plan, telemetry=tel_proxy)
                 if plan is not None else None)
 
+    # one lock for every stdout JSONL line: shed records print from
+    # ingest handler threads (inside eng.submit) while retire records
+    # print from the serve loop — print() is two write() calls, so
+    # unlocked concurrent lines could interleave mid-record and
+    # corrupt the ledger
+    import threading
+    io_lock = threading.Lock()
+
+    def _print_shed(rec):
+        # the explicit JSONL rejection record every shed request gets
+        # (the overload contract): same stream as the retirements, so
+        # a consumer can account for every acknowledged rid
+        with io_lock:
+            print(json.dumps({
+                "rid": rec.rid, "shed": True, "reason": rec.reason,
+                "tenant": rec.tenant, "priority": rec.priority,
+                "phase": rec.phase,
+                "theta": (list(rec.theta)
+                          if isinstance(rec.theta, (tuple, list))
+                          else rec.theta),
+                "bounds": list(rec.bounds)}), flush=True)
+
     def make_engine():
         from ppls_tpu.obs import Telemetry
         from ppls_tpu.runtime.checkpoint import CheckpointCorruptError
@@ -666,7 +809,7 @@ def _main_serve(args) -> int:
         holder["tel"] = tel
         ekw = dict(kw, n_devices=state["n_devices"],
                    quarantine=quarantine, fault_injector=injector,
-                   telemetry=tel)
+                   telemetry=tel, on_shed=_print_shed)
         if resuming:
             try:
                 # mesh_resize: after a chip loss the surviving-mesh
@@ -705,44 +848,144 @@ def _main_serve(args) -> int:
         print(f"serve: metrics on {metrics_srv.url}", file=sys.stderr,
               flush=True)
 
+    # round 16: cooperative SIGTERM/SIGINT — the loop checks the flag
+    # at phase boundaries and winds down with a final checkpoint +
+    # balanced span close + summary (the zero-downtime-restart half);
+    # the engine lock serializes the phase loop against the ingest
+    # handler threads (the engine itself is single-threaded by design)
+    from ppls_tpu.runtime.guard import GracefulShutdown
+    stop = GracefulShutdown()
+    eng_lock = threading.RLock()
+
+    ingest_srv = None
+    if args.ingest_port is not None:
+        from ppls_tpu.runtime.ingest import IngestServer
+
+        def ingest_submit(d):
+            rec = parse_request_record(d, theta_block=T)
+            rec.pop("arrival_phase", None)     # live ingest is "now"
+            with eng_lock:
+                eng = holder.get("eng")
+                if eng is None or stop.requested:
+                    raise ValueError("service not accepting requests")
+                n0 = len(eng.shed)
+                rid = eng.submit(rec.pop("theta"),
+                                 rec.pop("bounds"), **rec)
+                if len(eng.shed) > n0 and eng.shed[-1].rid == rid:
+                    return {"rid": rid, "accepted": False,
+                            "shed": True,
+                            "reason": eng.shed[-1].reason}
+                return {"rid": rid, "accepted": True}
+
+        def ingest_stats():
+            eng = holder.get("eng")
+            if eng is None:
+                return {"ready": False}
+            return {"ready": True, "phase": eng.phase,
+                    "pending": eng.pending, "resident": eng.resident,
+                    "completed": len(eng.completed),
+                    "shed": len(eng.shed)}
+
+        ingest_srv = IngestServer(ingest_submit,
+                                  port=args.ingest_port,
+                                  stats_fn=ingest_stats)
+        print(f"serve: ingest on {ingest_srv.url}", file=sys.stderr,
+              flush=True)
+
     def serve_loop():
         t0 = time.perf_counter()
         eng = make_engine()
+        with eng_lock:
+            holder["eng"] = eng
         span = eng.telemetry.span("run", mode="serve",
                                   engine=f"{args.engine}-stream",
                                   requests=len(reqs))
-        # rids are assigned in submission order, so a resumed engine
-        # skips the prefix it already submitted before the crash
-        k = eng.next_rid
-        while k < len(reqs) or not eng.idle:
-            while k < len(reqs) and arrivals[k] <= eng.phase:
-                eng.submit(*reqs[k])
-                k += 1
-            for c in eng.step():
-                print(json.dumps({
-                    "rid": c.rid,
-                    "theta": (list(c.theta)
-                              if isinstance(c.theta, (tuple, list))
-                              else c.theta),
-                    **({"areas": c.areas}
-                       if c.areas is not None and not c.failed
-                       else {}),
-                    "bounds": list(c.bounds),
-                    # a quarantined request reports area null (the
-                    # non-finite payload is not strict JSON) + the
-                    # failed marker consumers must honor
-                    "area": (None if c.failed else c.area),
-                    **({"failed": True} if c.failed else {}),
-                    "admit_phase": c.admit_phase,
-                    "retire_phase": c.retire_phase,
-                    "phases_in_flight": c.phases_in_flight,
-                    "latency_phases": c.latency_phases,
-                    "latency_s": round(c.latency_s, 4)}), flush=True)
-        span.close(phases=eng.phase, completed=len(eng.completed))
+        # resumed engines skip the batch-list prefix they already
+        # submitted before the crash. The cursor rides the snapshot's
+        # client_state (sheds AND live ingest submissions consume
+        # rids, so next_rid alone would mis-skip once --ingest-port
+        # traffic interleaves with a request list). setdefault seeds
+        # it on the FIRST attempt — a fresh engine gets 0 (next_rid
+        # is 0 before any submission) and every later snapshot then
+        # carries the key, so ingest-only traffic before the first
+        # batch submission cannot poison a restart; only pre-round-16
+        # snapshots (no key ever written) fall back to the historical
+        # next_rid prefix.
+        k = int(eng.client_state.setdefault("batch_cursor",
+                                            eng.next_rid))
+        ingest_on = ingest_srv is not None
+        while (k < len(reqs) or not eng.idle or ingest_on) \
+                and not stop.requested:
+            with eng_lock:
+                try:
+                    while k < len(reqs) and arrivals[k] <= eng.phase:
+                        r = reqs[k]
+                        eng.submit(r[0], r[1],
+                                   **(r[2] if len(r) > 2 else {}))
+                        k += 1
+                        eng.client_state["batch_cursor"] = k
+                    idle_wait = ingest_on and k >= len(reqs) \
+                        and eng.idle
+                    retired = [] if idle_wait else eng.step()
+                except BaseException:
+                    # a failed attempt's engine is DEAD state: its
+                    # resume restores the last snapshot, so an ingest
+                    # ack landing in it between the crash and the
+                    # supervisor's rebuilt attempt would be silently
+                    # lost. Clearing the handle UNDER THE LOCK makes
+                    # ingest_submit refuse (clients retry) until the
+                    # next attempt publishes a live engine.
+                    holder.pop("eng", None)
+                    raise
+            with io_lock:
+                for c in retired:
+                    print(json.dumps({
+                        "rid": c.rid,
+                        "theta": (list(c.theta)
+                                  if isinstance(c.theta, (tuple, list))
+                                  else c.theta),
+                        **({"areas": c.areas}
+                           if c.areas is not None and not c.failed
+                           else {}),
+                        "bounds": list(c.bounds),
+                        # a failed request (NaN quarantine, deadline
+                        # expiry) reports area null (the non-finite
+                        # payload is not strict JSON) + the failed
+                        # marker + its failure reason
+                        "area": (None if c.failed else c.area),
+                        **({"failed": True} if c.failed else {}),
+                        **({"failure": c.failure}
+                           if c.failure else {}),
+                        "tenant": c.tenant, "priority": c.priority,
+                        "admit_phase": c.admit_phase,
+                        "retire_phase": c.retire_phase,
+                        "phases_in_flight": c.phases_in_flight,
+                        "latency_phases": c.latency_phases,
+                        "latency_s": round(c.latency_s, 4)}),
+                        flush=True)
+            if idle_wait:
+                time.sleep(0.02)
+        if stop.requested:
+            # graceful shutdown: the ingest backlog (engine pending
+            # queue) rides the final snapshot, so `serve --checkpoint`
+            # restart resumes with ZERO lost acknowledged requests
+            holder["stopped"] = stop.signal_name or "signal"
+            with eng_lock:
+                if args.checkpoint:
+                    eng.snapshot()
+                eng.telemetry.event(
+                    "graceful_shutdown", signal=holder["stopped"],
+                    phase=eng.phase, pending=eng.pending,
+                    resident=eng.resident,
+                    completed=len(eng.completed))
+        span.close(phases=eng.phase, completed=len(eng.completed),
+                   **({"terminated": holder["stopped"]}
+                      if stop.requested else {}))
         return eng, time.perf_counter() - t0
 
     supervisor = None
     try:
+        stop.__enter__()
         if supervise:
             from ppls_tpu.runtime.guard import Supervisor
 
@@ -767,7 +1010,10 @@ def _main_serve(args) -> int:
         else:
             eng, wall = serve_loop()
 
-        if args.checkpoint:
+        if args.checkpoint and not holder.get("stopped"):
+            # a graceful shutdown KEEPS its snapshot — that file IS
+            # the zero-downtime restart state; only a drained run
+            # clears it
             eng.clear_snapshot()
         res = eng.result(wall_s=wall)
         summary = {
@@ -782,12 +1028,28 @@ def _main_serve(args) -> int:
             # values the --metrics-port endpoint serves and bench.py
             # stream reports (identical numbers on identical runs)
             "latency": res.latency_percentiles(),
+            # round 16: the per-class/per-tenant SLO surface (same
+            # bucket quantile as the labeled /metrics histograms)
+            "latency_by_class": res.class_latency_percentiles(),
+            "tenants": res.tenant_summary(),
+            "shed": len(res.shed),
             "occupancy": res.occupancy_summary(eng.lanes),
             "totals": res.totals,
         }
+        if res.shed:
+            reasons = {}
+            for s in res.shed:
+                reasons[s.reason] = reasons.get(s.reason, 0) + 1
+            summary["shed_reasons"] = reasons
+        if holder.get("stopped"):
+            summary["terminated"] = holder["stopped"]
         failed = sum(1 for c in res.completed if c.failed)
         if quarantine or failed:
             summary["failed"] = failed
+        deadline_failed = sum(1 for c in res.completed
+                              if c.failure == "deadline_exceeded")
+        if deadline_failed:
+            summary["deadline_exceeded"] = deadline_failed
         if supervisor is not None:
             summary["supervised"] = True
             summary["attempts"] = supervisor.attempts
@@ -801,9 +1063,15 @@ def _main_serve(args) -> int:
         if metrics_srv is not None:
             summary["metrics_port"] = metrics_srv.port
             summary["metrics_url"] = metrics_srv.url
+        if ingest_srv is not None:
+            summary["ingest_port"] = ingest_srv.port
+            summary["ingest_url"] = ingest_srv.url
         print(json.dumps(summary))
         return 0
     finally:
+        stop.__exit__()
+        if ingest_srv is not None:
+            ingest_srv.close()
         if "tel" in holder:
             holder["tel"].close()
         if metrics_srv is not None:

@@ -232,6 +232,28 @@ class Telemetry:
             "request latency submit->retire in seconds",
             buckets=SECONDS_BUCKETS)
 
+    # round-16 multi-tenant SLO surface: one registration site so the
+    # stream engine, the serve summary, bench.py stream, and
+    # analyze_occupancy all read the same labeled metric names
+
+    def shed_counter(self):
+        return self.registry.counter(
+            "ppls_requests_shed_total",
+            "requests shed by admission control, by tenant and reason",
+            ("tenant", "reason"))
+
+    def class_latency_histogram(self):
+        return self.registry.histogram(
+            "ppls_stream_class_retire_latency_phases",
+            "request latency submit->retire in phases, by priority "
+            "class", buckets=PHASE_BUCKETS, labelnames=("priority",))
+
+    def tenant_latency_histogram(self):
+        return self.registry.histogram(
+            "ppls_stream_tenant_retire_latency_phases",
+            "request latency submit->retire in phases, by tenant",
+            buckets=PHASE_BUCKETS, labelnames=("tenant",))
+
 
 _default_lock = threading.Lock()
 _default: Optional[Telemetry] = None

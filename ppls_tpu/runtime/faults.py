@@ -32,6 +32,12 @@ Fault taxonomy (``FAULT_KINDS``):
   gate): the engine genuinely computes with it, the slot's area goes
   non-finite, and the quarantine retire path must contain it while
   healthy co-resident requests retire normally;
+* ``sigterm``       — deliver SIGTERM to this process at a phase
+  boundary (round 16): the deterministic spelling of the orchestrator
+  kill the zero-downtime-restart contract is tested against — the
+  serve loop's GracefulShutdown must final-checkpoint, close the span
+  timeline balanced, and exit 0, and the ``serve --checkpoint``
+  restart must resume with zero lost acknowledged requests;
 * ``ckpt_truncate`` — truncate the snapshot file just written (a
   crash mid-upload / out-of-disk shape);
 * ``ckpt_corrupt``  — flip one byte in the middle of the snapshot
@@ -65,12 +71,16 @@ import numpy as np
 from ppls_tpu.runtime.guard import ChipLossError, InjectedCrash
 
 FAULT_KINDS = ("chip_loss", "crash", "hang", "straggler", "nan_poison",
-               "ckpt_truncate", "ckpt_corrupt")
+               "ckpt_truncate", "ckpt_corrupt", "sigterm")
 
 # kinds keyed on the PHASE index (fire at a phase boundary); the
 # others key on the request rid (nan_poison) or the checkpoint-write
-# index (ckpt_*)
+# index (ckpt_*). NOTE: sigterm is phase-keyed too but deliberately
+# NOT in PHASE_KINDS — seeded schedule generation draws from
+# PHASE_KINDS, and appending there would silently change every
+# existing seed's schedule (the same-seed-same-schedule contract).
 PHASE_KINDS = ("chip_loss", "crash", "hang", "straggler")
+_EDGE_KINDS = PHASE_KINDS + ("sigterm",)
 
 # an injected hang must outlive any plausible watchdog deadline: the
 # wedged thread is daemonized and must sleep until process exit, never
@@ -213,7 +223,7 @@ class FaultInjector:
             for ev in self.plan.events:
                 if ev.fired or ev.kind not in kinds or ev.at != at:
                     continue
-                if edge is not None and ev.kind in PHASE_KINDS \
+                if edge is not None and ev.kind in _EDGE_KINDS \
                         and ev.edge != edge:
                     continue
                 ev.fired = True
@@ -232,9 +242,15 @@ class FaultInjector:
     # -- engine hooks ------------------------------------------------------
 
     def _phase_edge(self, phase: int, edge: str, n_dev: int) -> None:
-        for ev in self._take(PHASE_KINDS, int(phase), edge=edge):
+        for ev in self._take(_EDGE_KINDS, int(phase), edge=edge):
             self._emit(ev, phase=int(phase))
-            if ev.kind == "straggler":
+            if ev.kind == "sigterm":
+                # the orchestrator-kill shape: deliver the real signal
+                # so the serve loop's GracefulShutdown machinery (not
+                # a test double) handles it at the next boundary
+                import signal as _signal
+                os.kill(os.getpid(), _signal.SIGTERM)
+            elif ev.kind == "straggler":
                 time.sleep(ev.seconds)
             elif ev.kind == "hang":
                 # a wedged device: block this (daemonizable) thread

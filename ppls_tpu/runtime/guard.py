@@ -289,6 +289,60 @@ def run_with_watchdog(run_fn, seconds: float, what: str = "engine run",
         return with_deadline(resume_fn, seconds, f"{what} (resume)")
 
 
+class GracefulShutdown:
+    """Cooperative SIGTERM/SIGINT handling for long-running serve
+    loops (round 16, the zero-downtime-restart half).
+
+    A context manager that installs signal handlers which only SET A
+    FLAG — the loop checks :attr:`requested` at its phase boundaries
+    and winds down in order: stop accepting ingest, write the final
+    checkpoint (queue snapshot included), close the span timeline
+    balanced, print the summary, exit 0. Killing mid-phase therefore
+    never tears a span or loses an acknowledged request: the signal
+    lands whenever it lands, the reaction happens at the next boundary.
+
+    Installing a handler is only legal on the main thread; off the
+    main thread (e.g. an engine attempt under ``with_deadline``'s
+    worker) the manager degrades to a no-op flag holder so the serve
+    loop can use it unconditionally.
+    """
+
+    def __init__(self, signals=None):
+        import signal as _signal
+        self._signal = _signal
+        self.signals = tuple(signals) if signals is not None else (
+            _signal.SIGTERM, _signal.SIGINT)
+        self._old = {}
+        self.signal_name: str = ""
+        self._flag = threading.Event()
+        self._installed = False
+
+    @property
+    def requested(self) -> bool:
+        return self._flag.is_set()
+
+    def _handler(self, signum, frame):
+        try:
+            self.signal_name = self._signal.Signals(signum).name
+        except ValueError:
+            self.signal_name = str(signum)
+        self._flag.set()
+
+    def __enter__(self) -> "GracefulShutdown":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.signals:
+                self._old[s] = self._signal.signal(s, self._handler)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            for s, old in self._old.items():
+                self._signal.signal(s, old)
+            self._old.clear()
+            self._installed = False
+
+
 class Supervisor:
     """Self-healing recovery loop around a resumable engine run.
 

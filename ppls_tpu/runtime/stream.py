@@ -55,6 +55,47 @@ demand-driven sharded walker one cycle per phase; admission is folded
 into ``mesh.phase_reshard``'s occupancy decision (rebalance / admit /
 terminate) so admitted seeds join the same depth-stratified cross-chip
 deal the phase boundary already pays (``sharded_walker.py``).
+
+Round 16 — OVERLOAD-HARDENED MULTI-TENANCY. Requests carry a
+``tenant``, a ``priority`` class, and an optional ``deadline_phases``
+budget, and the engine grows the dispatcher-tier controls the
+"millions of users" direction needs:
+
+* **Admission control**: per-tenant TOKEN BUCKETS (``tenant_quotas``:
+  ``rate`` tokens refilled per phase up to ``burst``) gate slot
+  allocation, and admission picks by ``(-priority, rid)`` — higher
+  classes admit first, FIFO within a class — instead of raw FIFO. A
+  tenant out of tokens is SKIPPED (its requests stay queued), never
+  crashed.
+* **Load shedding**: ``queue_limit`` bounds the pending queue. An
+  arriving request that would overflow it triggers the deterministic
+  shed policy — the LOWEST-PRIORITY, OLDEST queued request is the
+  victim; if the arrival does not strictly outrank it, the arrival
+  itself is shed. Every shed consumes a rid (so resume prefix-skip
+  stays aligned), emits a ``request_shed`` event +
+  ``ppls_requests_shed_total{tenant,reason}``, lands in
+  ``StreamEngine.shed`` / ``StreamResult.shed``, and fires the
+  ``on_shed`` callback (the serve CLI's explicit JSONL rejection
+  record).
+* **Deadlines**: a request must retire by phase ``submit_phase +
+  deadline_phases``. A QUEUED request that can no longer meet its
+  deadline is shed (``deadline_exceeded``); an IN-FLIGHT request that
+  misses it retires through the round-14 failed-record path
+  (``failed=True, failure="deadline_exceeded"``) and its live bag rows
+  are COMPACTED OUT by a jitted cancel program (stable partition —
+  surviving rows keep their order, so the continued schedule replays
+  deterministically), freeing the slot immediately.
+* **Per-tenant SLO accounting**: retire-latency histograms labeled by
+  tenant and by priority class on the same registry bench/serve/
+  ``/metrics`` read, so p50/p99 per class is one quantile path
+  everywhere.
+
+All of it is host-side boundary policy: the compiled cycle program is
+untouched, the compile-once invariant holds (the cancel program is its
+own one-shape jit, like the admit program), and every decision is a
+pure function of the schedule + device-counted state, so the round-8
+determinism contracts (rerun, kill-and-resume) extend to shed and
+deadline behavior unchanged.
 """
 
 from __future__ import annotations
@@ -97,18 +138,52 @@ class StreamRequest:
     """One pending integration request: one 1D integral (scalar
     ``theta``), or — on a ``theta_block`` > 1 engine (round 13) — a
     THETA BATCH: up to T per-user thetas scored over one shared
-    union-refinement frontier (``theta`` is then a tuple)."""
+    union-refinement frontier (``theta`` is then a tuple).
+
+    Round 16: ``tenant``/``priority`` drive admission control and the
+    shed policy; ``deadline_phases`` is the request's phase budget
+    (retire by ``submit_phase + deadline_phases`` or fail). Defaults
+    keep pre-round-16 snapshots and callers unchanged."""
 
     rid: int
     theta: object                 # float, or tuple of floats (batch)
     bounds: Tuple[float, float]
     submit_phase: int
     submit_t: float
+    tenant: str = "default"
+    priority: int = 1
+    deadline_phases: Optional[int] = None
 
     @property
     def thetas(self) -> Tuple[float, ...]:
         t = self.theta
         return tuple(t) if isinstance(t, (tuple, list)) else (float(t),)
+
+    @property
+    def deadline_phase(self) -> Optional[int]:
+        """Last phase index at which this request may retire."""
+        if self.deadline_phases is None:
+            return None
+        return self.submit_phase + int(self.deadline_phases)
+
+
+@dataclasses.dataclass
+class ShedRecord:
+    """A request refused by admission control (round 16): the explicit
+    rejection record the overload contract demands — every shed
+    request is visible as a JSONL line / ``request_shed`` event /
+    ``ppls_requests_shed_total{tenant,reason}`` increment, never a
+    silent drop. Shed requests CONSUME a rid, so the resume driver's
+    next_rid prefix-skip stays aligned with the submission order."""
+
+    rid: int
+    theta: object
+    bounds: Tuple[float, float]
+    tenant: str
+    priority: int
+    reason: str                   # "queue_full" | "deadline_exceeded"
+    phase: int                    # phase index the shed happened at
+    submit_phase: int
 
 
 @dataclasses.dataclass
@@ -142,6 +217,11 @@ class CompletedRequest:
     # record, and consumers must treat the request as FAILED, not
     # integrate-d. Default False keeps pre-round-14 snapshots loading.
     failed: bool = False
+    # round 16: tenancy + the failure taxonomy ("nan" quarantine vs
+    # "deadline_exceeded" expiry); defaults keep old snapshots loading
+    tenant: str = "default"
+    priority: int = 1
+    failure: Optional[str] = None
 
     @property
     def phases_in_flight(self) -> int:
@@ -174,6 +254,10 @@ class StreamResult:
     # shared per-round record (satellite 1): one RoundStats per phase,
     # from the device-counted phase rows
     per_round: List = dataclasses.field(default_factory=list)
+    # round 16: every request refused by admission control (queue
+    # overflow / unmeetable deadline) — the overload accounting
+    # invariant is len(completed) + len(shed) == requests submitted
+    shed: List = dataclasses.field(default_factory=list)
 
     @property
     def areas(self) -> np.ndarray:
@@ -216,6 +300,51 @@ class StreamResult:
             "p50_s": float(hs.quantile(0.5)),
             "p99_s": float(hs.quantile(0.99)),
         }
+
+    def class_latency_percentiles(self) -> dict:
+        """p50/p99 retire latency (phases) PER PRIORITY CLASS, through
+        the same deterministic bucket-edge quantile as
+        :meth:`latency_percentiles` — the per-class SLO numbers the
+        serve summary, ``/metrics`` (labeled histograms), and
+        ``bench.py stream`` all report identically. Failed retirements
+        (quarantine, deadline) are included: SLO math must see the
+        failures, not only the successes."""
+        from ppls_tpu.obs.registry import PHASE_BUCKETS, Histogram
+        by_class: dict = {}
+        for c in self.completed:
+            h = by_class.setdefault(int(c.priority),
+                                    Histogram(PHASE_BUCKETS))
+            h.observe(c.latency_phases)
+        return {
+            str(p): {
+                "count": h.count,
+                "p50_phases": float(h.quantile(0.5)),
+                "p99_phases": float(h.quantile(0.99)),
+            } for p, h in sorted(by_class.items())}
+
+    def tenant_summary(self) -> dict:
+        """Per-tenant accounting: retired / failed / shed counts and
+        shed reasons — the registry's labeled counters, recomputed from
+        the deterministic record so hand-assembled results report the
+        identical numbers."""
+        out: dict = {}
+
+        def row(tenant):
+            return out.setdefault(str(tenant), {
+                "completed": 0, "failed": 0, "shed": 0,
+                "shed_reasons": {}})
+
+        for c in self.completed:
+            r = row(c.tenant)
+            r["completed"] += 1
+            if c.failed:
+                r["failed"] += 1
+        for s in self.shed:
+            r = row(s.tenant)
+            r["shed"] += 1
+            r["shed_reasons"][s.reason] = \
+                r["shed_reasons"].get(s.reason, 0) + 1
+        return out
 
     def occupancy_summary(self, lanes: int) -> dict:
         """Steady-state occupancy from the device-counted phase rows."""
@@ -269,6 +398,63 @@ def _admit_program(bag: BagState, acc, acc_c, fam_last,
             jnp.where(clear_acc, 0.0, acc),
             jnp.where(clear_acc, 0.0, acc_c),
             jnp.where(clear, jnp.int32(-1), fam_last))
+
+
+@jax.jit
+def _cancel_program(bag: BagState, kill):
+    """Compact the live prefix, dropping every row whose family slot is
+    in the ``kill`` mask (deadline expiry, round 16). A STABLE
+    partition: surviving rows keep their relative bag order, so the
+    continued phase schedule is the deterministic function of state the
+    resume/rerun contracts rely on. Dropped rows become dead fill past
+    the new count — they were real in-domain intervals, which is
+    exactly the benign-fill requirement. One compiled shape (the whole
+    store), like the admit program."""
+    n = bag.bag_l.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    live = idx < bag.count
+    slot = jnp.right_shift(bag.bag_meta, DEPTH_BITS)
+    killed = jnp.logical_and(
+        kill[jnp.clip(slot, 0, kill.shape[0] - 1)], live)
+    keep = jnp.logical_and(live, jnp.logical_not(killed))
+    order = jnp.argsort(jnp.where(keep, 0, 1).astype(jnp.int32),
+                        stable=True)
+    return bag._replace(
+        bag_l=jnp.take(bag.bag_l, order),
+        bag_r=jnp.take(bag.bag_r, order),
+        bag_th=jnp.take(bag.bag_th, order),
+        bag_meta=jnp.take(bag.bag_meta, order),
+        count=jnp.sum(keep).astype(jnp.int32))
+
+
+@jax.jit
+def _dd_cancel_program(bl, br, bth, bm, counts, kill):
+    """Per-chip twin of :func:`_cancel_program` over the flattened
+    ``(n_dev * store,)`` dd stores: each chip's local queue compacts
+    independently (element-wise, zero collectives)."""
+    n_dev = counts.shape[0]
+    store = bl.shape[0] // n_dev
+
+    def one(l, r, th, m, cnt, kill):
+        idx = jnp.arange(store, dtype=jnp.int32)
+        live = idx < cnt
+        slot = jnp.right_shift(m, DEPTH_BITS)
+        killed = jnp.logical_and(
+            kill[jnp.clip(slot, 0, kill.shape[0] - 1)], live)
+        keep = jnp.logical_and(live, jnp.logical_not(killed))
+        order = jnp.argsort(jnp.where(keep, 0, 1).astype(jnp.int32),
+                            stable=True)
+        return (jnp.take(l, order), jnp.take(r, order),
+                jnp.take(th, order), jnp.take(m, order),
+                jnp.sum(keep).astype(jnp.int32))
+
+    l2, r2, th2, m2, cnt2 = jax.vmap(
+        one, in_axes=(0, 0, 0, 0, 0, None))(
+        bl.reshape(n_dev, store), br.reshape(n_dev, store),
+        bth.reshape(n_dev, store), bm.reshape(n_dev, store),
+        counts, kill)
+    return (l2.reshape(-1), r2.reshape(-1), th2.reshape(-1),
+            m2.reshape(-1), cnt2)
 
 
 def _stream_identity(engine: str, family: str, eps: float, rule: Rule,
@@ -333,7 +519,11 @@ class StreamEngine:
                  checkpoint_every: int = 8,
                  telemetry: Optional[Telemetry] = None,
                  quarantine: bool = False,
-                 fault_injector=None):
+                 fault_injector=None,
+                 queue_limit: Optional[int] = None,
+                 tenant_quotas: Optional[dict] = None,
+                 default_deadline_phases: Optional[int] = None,
+                 on_shed=None):
         from ppls_tpu.models.integrands import get_family, get_family_ds
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -450,6 +640,61 @@ class StreamEngine:
                 f"bucket-edge quantile)")
             for q in (0.5, 0.99) for unit in ("phases", "seconds")}
 
+        # round 16: admission control + load shedding + deadlines.
+        # queue_limit bounds the PENDING queue (None = the historical
+        # unbounded queue); tenant_quotas maps tenant -> {"rate": R,
+        # "burst": B} token buckets refilled per phase ("*" is the
+        # default quota for tenants without their own entry; no dict =
+        # no gating); default_deadline_phases applies to requests that
+        # do not carry their own budget. All host-side policy — none
+        # of it touches the compiled cycle program or the snapshot
+        # identity (a resume must be driven with the same policy flags
+        # for the shed schedule to replay, same as the arrival
+        # schedule itself).
+        self.queue_limit = (None if queue_limit is None
+                            else int(queue_limit))
+        if self.queue_limit is not None and self.queue_limit < 1:
+            raise ValueError(
+                f"queue_limit must be >= 1, got {queue_limit}")
+        self.tenant_quotas = None
+        if tenant_quotas:
+            self.tenant_quotas = {}
+            for name, q in tenant_quotas.items():
+                rate = float(q.get("rate", 1.0))
+                burst = float(q.get("burst", max(rate, 1.0)))
+                if rate <= 0 or burst < 1.0:
+                    # rate 0 would starve the tenant FOREVER (its
+                    # queued requests never admit, never shed, and
+                    # the drain loop never terminates) — quota
+                    # throttles pacing; refusal is the queue bound's
+                    # job
+                    raise ValueError(
+                        f"tenant quota {name!r}: rate must be > 0 "
+                        f"and burst >= 1, got rate={rate} "
+                        f"burst={burst}")
+                self.tenant_quotas[str(name)] = {"rate": rate,
+                                                 "burst": burst}
+        self.default_deadline_phases = (
+            None if default_deadline_phases is None
+            else int(default_deadline_phases))
+        if self.default_deadline_phases is not None \
+                and self.default_deadline_phases < 1:
+            # fail at construction, not at the first submit inside a
+            # supervised serve loop (where it would burn the whole
+            # retry budget re-crashing deterministically)
+            raise ValueError(
+                f"default_deadline_phases must be >= 1, got "
+                f"{default_deadline_phases}")
+        self.on_shed = on_shed
+        self.shed: List[ShedRecord] = []
+        self._tokens: dict = {}
+        # round 16: a JSON-serializable scratch dict for the DRIVER'S
+        # resume bookkeeping, carried by every snapshot. The serve CLI
+        # stores its batch-list cursor here — rids alone cannot serve
+        # as the list prefix once live ingest traffic (which also
+        # consumes rids) interleaves with a request list.
+        self.client_state: dict = {}
+
         # host bookkeeping
         self._pending: List[StreamRequest] = []
         self._free = list(range(self.slots))
@@ -478,6 +723,21 @@ class StreamEngine:
         self._c_quarantined = tel.registry.counter(
             "ppls_stream_quarantined_total",
             "requests retired as failed through the NaN quarantine")
+        # round 16: per-tenant SLO accounting on the same registry —
+        # shed counter by (tenant, reason), deadline-expiry counter,
+        # per-tenant retired counter, and latency histograms labeled
+        # by tenant and by priority class (the summary's per-class
+        # p50/p99 reads the identical bucket quantile)
+        self._c_shed = tel.shed_counter()
+        self._c_deadline = tel.registry.counter(
+            "ppls_stream_deadline_exceeded_total",
+            "in-flight requests retired failed at their phase "
+            "deadline", ("tenant",))
+        self._c_tenant_retired = tel.registry.counter(
+            "ppls_stream_tenant_retired_total",
+            "requests retired, by tenant", ("tenant",))
+        self._h_class_lat = tel.class_latency_histogram()
+        self._h_tenant_lat = tel.tenant_latency_histogram()
         # round 14: seeded fault injection (runtime/faults.py) — hooks
         # fire at the boundaries this engine already owns; None = no
         # plan armed, zero overhead
@@ -523,14 +783,27 @@ class StreamEngine:
     # request intake
     # ------------------------------------------------------------------
 
-    def submit(self, theta, bounds) -> int:
+    def submit(self, theta, bounds, tenant: str = "default",
+               priority: int = 1,
+               deadline_phases: Optional[int] = None) -> int:
         """Queue one integration request; returns its request id.
 
         On a ``theta_block`` = T > 1 engine (round 13) ``theta`` may be
         a sequence of up to T per-user thetas — the request becomes a
         THETA BATCH scored over one shared union-refinement frontier,
         retiring with per-theta areas (``CompletedRequest.areas``).
-        Scalar theta stays valid on every engine."""
+        Scalar theta stays valid on every engine.
+
+        Round 16: ``tenant``/``priority``/``deadline_phases`` feed
+        admission control. A malformed submission (bad domain, over-
+        limit theta batch, bad priority/deadline) raises ``ValueError``
+        BEFORE a rid is consumed — the caller owns the rejection
+        record. A well-formed submission always consumes a rid; under
+        a full ``queue_limit`` the deterministic shed policy then
+        refuses either the lowest-priority-oldest queued request or
+        this one (whichever ranks lower), recording it on
+        ``self.shed`` — check the returned rid against the shed
+        records to learn this request's fate."""
         from ppls_tpu.models.integrands import check_ds_domain
         bounds = (float(bounds[0]), float(bounds[1]))
         if isinstance(theta, (tuple, list, np.ndarray)):
@@ -549,12 +822,65 @@ class StreamEngine:
         check_ds_domain(self.f_ds,
                         np.tile(np.array([bounds]), (len(thetas), 1)),
                         np.array(thetas))
+        tenant = str(tenant)
+        if not tenant or len(tenant) > 128:
+            raise ValueError(
+                f"tenant must be a non-empty string of <= 128 chars, "
+                f"got {tenant!r}")
+        priority = int(priority)
+        if deadline_phases is None:
+            deadline_phases = self.default_deadline_phases
+        if deadline_phases is not None:
+            deadline_phases = int(deadline_phases)
+            if deadline_phases < 1:
+                raise ValueError(
+                    f"deadline_phases must be >= 1, got "
+                    f"{deadline_phases}")
         rid = self._next_rid
         self._next_rid += 1
-        self._pending.append(StreamRequest(
+        req = StreamRequest(
             rid=rid, theta=theta_store, bounds=bounds,
-            submit_phase=self.phase, submit_t=time.perf_counter()))
+            submit_phase=self.phase, submit_t=time.perf_counter(),
+            tenant=tenant, priority=priority,
+            deadline_phases=deadline_phases)
+        if self.queue_limit is not None \
+                and len(self._pending) >= self.queue_limit:
+            # deterministic shed policy: the victim is the lowest-
+            # priority OLDEST queued request; the arrival must
+            # STRICTLY outrank it to displace it, else the arrival
+            # itself is shed. Either way the queue never exceeds the
+            # limit and every refusal is an explicit record.
+            victim = min(self._pending,
+                         key=lambda r: (r.priority, r.rid))
+            if victim.priority < req.priority:
+                self._pending.remove(victim)
+                self._shed(victim, "queue_full")
+            else:
+                self._shed(req, "queue_full")
+                return rid
+        self._pending.append(req)
         return rid
+
+    def _quota_for(self, tenant: str) -> Optional[dict]:
+        if self.tenant_quotas is None:
+            return None
+        return self.tenant_quotas.get(tenant,
+                                      self.tenant_quotas.get("*"))
+
+    def _shed(self, req: StreamRequest, reason: str) -> ShedRecord:
+        rec = ShedRecord(
+            rid=req.rid, theta=req.theta, bounds=req.bounds,
+            tenant=req.tenant, priority=req.priority, reason=reason,
+            phase=self.phase, submit_phase=req.submit_phase)
+        self.shed.append(rec)
+        self._c_shed.labels(tenant=req.tenant, reason=reason).inc()
+        self.telemetry.event(
+            "request_shed", rid=req.rid, tenant=req.tenant,
+            priority=req.priority, reason=reason, phase=self.phase,
+            submit_phase=req.submit_phase)
+        if self.on_shed is not None:
+            self.on_shed(rec)
+        return rec
 
     @property
     def next_rid(self) -> int:
@@ -694,24 +1020,85 @@ class StreamEngine:
     # the phase loop
     # ------------------------------------------------------------------
 
-    def _admissible(self) -> int:
-        """How many pending requests fit this phase: free slots, the
-        admit window, and bag-capacity headroom for the seed rows."""
+    def _refill_tokens(self) -> None:
+        """Phase-open token-bucket refill: deterministic, rate tokens
+        per phase up to burst, for every tenant seen so far."""
+        if self.tenant_quotas is None:
+            return
+        for tenant in self._tokens:
+            q = self._quota_for(tenant)
+            if q is not None:
+                self._tokens[tenant] = min(
+                    q["burst"], self._tokens[tenant] + q["rate"])
+
+    def _shed_unmeetable(self) -> None:
+        """Shed queued requests whose deadline can no longer be met
+        (deadline phase already behind the current phase): spending a
+        slot on them would only burn capacity the live requests need —
+        the canonical overload-shedding move."""
+        victims = [r for r in self._pending
+                   if r.deadline_phase is not None
+                   and r.deadline_phase < self.phase]
+        for req in victims:
+            self._pending.remove(req)
+            self._shed(req, "deadline_exceeded")
+
+    def _select_for_admission(self) -> List[StreamRequest]:
+        """Pick this phase's admissions (round 16): budget = free
+        slots x admit window x bag headroom, order = (-priority, rid)
+        — higher classes first, FIFO within a class — gated by the
+        per-tenant token buckets (an out-of-tokens tenant's requests
+        are SKIPPED, not shed; they keep their queue position).
+        Selected requests are removed from the pending queue and a
+        token is consumed per admission."""
+        import heapq
         cap = self._capacity
         if self.engine == "walker-dd" and self._mesh is not None:
             cap *= self._mesh.devices.size      # per-chip capacity
         room = cap - self._count
-        return max(0, min(len(self._pending), len(self._free),
-                          self._admit_window, room))
+        budget = max(0, min(len(self._free), self._admit_window, room))
+        if not budget or not self._pending:
+            return []
+        chosen: List[StreamRequest] = []
+        if self.tenant_quotas is None:
+            # no token gating: the selection is exactly the budget-many
+            # best-ranked requests — O(n log budget) instead of a full
+            # sort every phase (the pending queue is the thing that
+            # grows under the overload this tier exists for)
+            chosen = heapq.nsmallest(
+                budget, self._pending,
+                key=lambda r: (-r.priority, r.rid))
+        else:
+            for req in sorted(self._pending,
+                              key=lambda r: (-r.priority, r.rid)):
+                if len(chosen) >= budget:
+                    break
+                q = self._quota_for(req.tenant)
+                if q is not None:
+                    if req.tenant not in self._tokens:
+                        # first sight (incl. a pending request
+                        # restored from a pre-round-16 snapshot):
+                        # bucket starts full
+                        self._tokens[req.tenant] = q["burst"]
+                    if self._tokens[req.tenant] < 1.0:
+                        continue
+                    self._tokens[req.tenant] -= 1.0
+                chosen.append(req)
+        if chosen:
+            taken = {r.rid for r in chosen}
+            self._pending = [r for r in self._pending
+                             if r.rid not in taken]
+        return chosen
 
     def _admit(self) -> List[StreamRequest]:
-        n_new = self._admissible()
+        chosen = self._select_for_admission()
         if self._dev is None:
-            if not n_new:
+            if not chosen:
                 return []
-            self._ensure_state(self._pending[0])
-        if not n_new and not self._clear_pending():
+            self._ensure_state(chosen[0])
+        if not chosen and not self._clear_pending():
             return []
+        n_new = len(chosen)
         A = self._admit_window
         fill_x, fill_th = self._fill
         sl = np.full(A, fill_x)
@@ -720,8 +1107,7 @@ class StreamEngine:
         sm = np.zeros(A, dtype=np.int32)
         clear = np.zeros(self.slots, dtype=bool)
         admitted = []
-        for i in range(n_new):
-            req = self._pending.pop(0)
+        for i, req in enumerate(chosen):
             slot = self._free.pop(0)
             sl[i], sr[i] = req.bounds
             row = req.thetas
@@ -754,7 +1140,8 @@ class StreamEngine:
                 theta=(list(row) if self._theta_block > 1
                        else req.theta),
                 bounds=list(req.bounds),
-                submit_phase=req.submit_phase)
+                submit_phase=req.submit_phase,
+                tenant=req.tenant, priority=req.priority)
         if n_new:
             self._c_admitted.inc(n_new)
         self._apply_admit(sl, sr, sth, sm, n_new, clear)
@@ -956,6 +1343,64 @@ class StreamEngine:
     def _mesh_width(self) -> int:
         return self._mesh.devices.size if self._mesh is not None else 1
 
+    def _account_retirement(self, c: CompletedRequest,
+                            slot: int) -> None:
+        """Registry + event accounting shared by every retirement path
+        (normal, quarantine, deadline expiry): one place so the global
+        and the tenant/class-labeled surfaces can never drift."""
+        self._c_retired.inc()
+        self._c_tenant_retired.labels(tenant=c.tenant).inc()
+        self._h_lat_phases.observe(c.latency_phases)
+        self._h_lat_seconds.observe(c.latency_s)
+        self._h_class_lat.labels(priority=str(c.priority)) \
+            .observe(c.latency_phases)
+        self._h_tenant_lat.labels(tenant=c.tenant) \
+            .observe(c.latency_phases)
+        ok = not c.failed
+        # every attr below except latency_s is device-counted or
+        # schedule-determined: bit-stable across rerun and resume
+        # (failed retirements carry area=None — the non-finite payload
+        # would not be strict JSON)
+        self.telemetry.event(
+            "retire", rid=c.rid, slot=slot,
+            area=(c.area if ok else None),
+            **({"areas": c.areas}
+               if c.areas is not None and ok else {}),
+            failed=c.failed,
+            **({"failure": c.failure} if c.failure else {}),
+            submit_phase=c.submit_phase,
+            admit_phase=c.admit_phase,
+            retire_phase=c.retire_phase,
+            latency_phases=c.latency_phases,
+            first_seeded_phase=c.first_seeded_phase,
+            last_credited_phase=c.last_credited_phase,
+            latency_s=round(c.latency_s, 6),
+            tenant=c.tenant, priority=c.priority)
+
+    def _cancel_slots(self, kill: np.ndarray) -> None:
+        """Compact the cancelled slots' live rows out of the device
+        bag(s) (deadline expiry). Between phases ALL walk state lives
+        in the bag (lane state folds back at every cycle edge), so
+        after the compaction nothing can credit the freed slots again
+        — the same invariant the recycle path relies on. Rare-path
+        boundary work: one jitted one-shape program + one count fetch."""
+        k = jnp.asarray(kill)
+        if self.engine == "walker-dd":
+            bl, br, bth, bm, counts, acc = self._dd_state
+            bl, br, bth, bm, counts = _dd_cancel_program(
+                bl, br, bth, bm, counts, k)
+            self._dd_state = (bl, br, bth, bm, counts, acc)
+            self._count = int(np.sum(np.asarray(
+                jax.device_get(counts))))
+        else:
+            d = self._dev
+            bag = _cancel_program(d["bag"], k)
+            self._dev = dict(d, bag=bag)
+            self._count = int(jax.device_get(bag.count))
+        # the cancelled slots are drained by construction now — keep
+        # the host-side live view consistent for result()/idle
+        self._last_fam_live = np.where(kill, 0, self._last_fam_live)
+
     def step(self) -> List[CompletedRequest]:
         """One phase: admit -> cycle -> retire. Returns the requests
         retired this phase (empty when idle)."""
@@ -968,6 +1413,11 @@ class StreamEngine:
             self.fault_injector.on_phase_open(self.phase,
                                               n_dev=self._mesh_width())
         span = tel.span("phase", phase=self.phase)
+        # round 16 phase-open policy: refill the tenant token buckets,
+        # then shed queued requests whose deadline is already
+        # unmeetable — both deterministic functions of the phase index
+        self._refill_tokens()
+        self._shed_unmeetable()
         self._admit()
         if self._count == 0 and not self._slot_req:
             # nothing live on device (and nothing was admissible): an
@@ -1048,28 +1498,51 @@ class StreamEngine:
                 latency_s=now - req.submit_t,
                 first_seeded_phase=int(self._fam_first[slot]),
                 last_credited_phase=int(fam_last[slot]),
-                failed=not finite)
+                failed=not finite,
+                tenant=req.tenant, priority=req.priority,
+                failure=(None if finite else "nan"))
             retired.append(c)
             self._free.append(slot)
-            self._c_retired.inc()
-            self._h_lat_phases.observe(c.latency_phases)
-            self._h_lat_seconds.observe(c.latency_s)
-            # every attr below except latency_s is device-counted or
-            # schedule-determined: bit-stable across rerun and resume
-            # (failed retirements carry area=None — the non-finite
-            # payload would not be strict JSON)
-            tel.event("retire", rid=c.rid, slot=slot,
-                      area=(c.area if finite else None),
-                      **({"areas": c.areas}
-                         if c.areas is not None and finite else {}),
-                      failed=c.failed,
-                      submit_phase=c.submit_phase,
-                      admit_phase=c.admit_phase,
-                      retire_phase=c.retire_phase,
-                      latency_phases=c.latency_phases,
-                      first_seeded_phase=c.first_seeded_phase,
-                      last_credited_phase=c.last_credited_phase,
-                      latency_s=round(c.latency_s, 6))
+            self._account_retirement(c, slot)
+        # round 16 DEADLINE EXPIRY: any still-resident request whose
+        # deadline phase is this phase or earlier missed its budget —
+        # retire it as a FAILED record (the round-14 path) and compact
+        # its live rows out of the bag so the engine stops spending
+        # lane-steps on work nobody will accept. The freed slot is
+        # immediately reusable: after the compaction no row can credit
+        # it, and the recycle path clears its accumulator at the next
+        # admission.
+        kill = None
+        for slot in sorted(self._slot_req):
+            req = self._slot_req[slot]
+            dp = req.deadline_phase
+            if dp is None or self.phase < dp:
+                continue
+            self._slot_req.pop(slot)
+            rec = self._records.pop(req.rid)
+            c = CompletedRequest(
+                rid=req.rid, theta=req.theta, bounds=req.bounds,
+                area=float("nan"), areas=None,
+                submit_phase=req.submit_phase,
+                admit_phase=rec["admit_phase"],
+                retire_phase=self.phase,
+                latency_s=now - req.submit_t,
+                first_seeded_phase=int(self._fam_first[slot]),
+                last_credited_phase=int(fam_last[slot]),
+                failed=True, tenant=req.tenant,
+                priority=req.priority, failure="deadline_exceeded")
+            tel.event("deadline_exceeded", rid=req.rid, slot=slot,
+                      phase=self.phase, deadline_phase=dp,
+                      tenant=req.tenant)
+            self._c_deadline.labels(tenant=req.tenant).inc()
+            retired.append(c)
+            self._free.append(slot)
+            if kill is None:
+                kill = np.zeros(self.slots, dtype=bool)
+            kill[slot] = True
+            self._account_retirement(c, slot)
+        if kill is not None:
+            self._cancel_slots(kill)
         self._free.sort()
         self.completed.extend(retired)
         self.phase += 1
@@ -1114,9 +1587,11 @@ class StreamEngine:
             arrival_phase: Optional[Sequence[int]] = None,
             _crash_after_phases: Optional[int] = None) -> StreamResult:
         """Convenience driver: submit ``requests`` (theta, bounds)
-        pairs — all up front, or on the open-loop ``arrival_phase``
-        schedule (one target phase per request, non-decreasing) — and
-        run phases until everything retires."""
+        pairs — or (theta, bounds, kwargs) triples carrying
+        tenant/priority/deadline_phases (round 16) — all up front, or
+        on the open-loop ``arrival_phase`` schedule (one target phase
+        per request, non-decreasing) — and run phases until everything
+        retires or is shed."""
         t0 = time.perf_counter()
         sched = ([0] * len(requests) if arrival_phase is None
                  else [int(p) for p in arrival_phase])
@@ -1132,8 +1607,10 @@ class StreamEngine:
         while k < len(queue) or not self.idle:
             while k < len(queue) and \
                     queue[k][0] <= self.phase - phases0:
-                th, b = queue[k][1]
-                self.submit(th, b)
+                r = queue[k][1]
+                th, b = r[0], r[1]
+                kw2 = r[2] if len(r) > 2 else {}
+                self.submit(th, b, **kw2)
                 k += 1
             self.step()
             phases += 1
@@ -1171,7 +1648,8 @@ class StreamEngine:
                             latency_hist_seconds=self._h_lat_seconds
                             .solo(),
                             per_round=round_stats_from_rows(
-                                rows, STREAM_STAT_FIELDS))
+                                rows, STREAM_STAT_FIELDS),
+                            shed=list(self.shed))
 
     # ------------------------------------------------------------------
     # snapshot / resume
@@ -1227,6 +1705,14 @@ class StreamEngine:
                 for slot, req in self._slot_req.items()},
             "completed": [dataclasses.asdict(c)
                           for c in self.completed],
+            # round 16: the shed record + token-bucket state — a
+            # resumed overload run must replay the same admission/shed
+            # decisions and report the same accounting (the zero-lost-
+            # acks contract covers refusals too: an acknowledged shed
+            # stays a shed after the restart)
+            "shed": [dataclasses.asdict(s) for s in self.shed],
+            "tokens": dict(self._tokens),
+            "client_state": dict(self.client_state),
         }
         if self._theta_block > 1 and self._fill is not None:
             totals["theta_table"] = self._theta_table.tolist()
@@ -1330,22 +1816,34 @@ class StreamEngine:
             # JSON round-trips theta batches as lists
             return tuple(v) if isinstance(v, list) else v
 
-        eng._pending = [StreamRequest(
-            rid=d["rid"], theta=_theta_in(d["theta"]),
-            bounds=tuple(d["bounds"]),
-            submit_phase=d["submit_phase"],
-            submit_t=time.perf_counter()) for d in totals["pending"]]
+        def _req_in(d):
+            # round-16 tenancy fields default for pre-round-16
+            # snapshots (plain dict .get so old files keep loading)
+            return StreamRequest(
+                rid=d["rid"], theta=_theta_in(d["theta"]),
+                bounds=tuple(d["bounds"]),
+                submit_phase=d["submit_phase"],
+                submit_t=time.perf_counter(),
+                tenant=d.get("tenant", "default"),
+                priority=int(d.get("priority", 1)),
+                deadline_phases=d.get("deadline_phases"))
+
+        eng._pending = [_req_in(d) for d in totals["pending"]]
         eng.completed = [CompletedRequest(
             **{k: (tuple(v) if k == "bounds"
                    else _theta_in(v) if k == "theta" else v)
                for k, v in d.items()}) for d in totals["completed"]]
+        eng.shed = [ShedRecord(
+            **{k: (tuple(v) if k == "bounds"
+                   else _theta_in(v) if k == "theta" else v)
+               for k, v in d.items()})
+            for d in totals.get("shed", [])]
+        eng._tokens = {str(k): float(v)
+                       for k, v in totals.get("tokens", {}).items()}
+        eng.client_state = dict(totals.get("client_state", {}))
         for slot_s, d in totals["resident"].items():
             slot = int(slot_s)
-            req = StreamRequest(
-                rid=d["rid"], theta=_theta_in(d["theta"]),
-                bounds=tuple(d["bounds"]),
-                submit_phase=d["submit_phase"],
-                submit_t=time.perf_counter())
+            req = _req_in(d)
             eng._slot_req[slot] = req
             eng._records[req.rid] = dict(slot=slot,
                                          admit_phase=d["admit_phase"])
@@ -1386,10 +1884,24 @@ class StreamEngine:
             self._c_admitted.inc(n_admitted)
         for c in self.completed:
             self._c_retired.inc()
+            self._c_tenant_retired.labels(tenant=c.tenant).inc()
             if c.failed:
-                self._c_quarantined.inc()
+                # failure taxonomy (round 16): deadline expiries have
+                # their own counter; every other failed record is the
+                # round-14 NaN quarantine (old snapshots carry
+                # failure=None)
+                if c.failure == "deadline_exceeded":
+                    self._c_deadline.labels(tenant=c.tenant).inc()
+                else:
+                    self._c_quarantined.inc()
             self._h_lat_phases.observe(c.latency_phases)
             self._h_lat_seconds.observe(c.latency_s)
+            self._h_class_lat.labels(priority=str(c.priority)) \
+                .observe(c.latency_phases)
+            self._h_tenant_lat.labels(tenant=c.tenant) \
+                .observe(c.latency_phases)
+        for s in self.shed:
+            self._c_shed.labels(tenant=s.tenant, reason=s.reason).inc()
         self._publish_gauges()
 
     def _restore_device_dd(self, bag_cols, totals, acc):

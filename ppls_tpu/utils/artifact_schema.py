@@ -236,6 +236,110 @@ def validate_events_text(text: str, *, where: str = "events",
     return problems
 
 
+def validate_serve_output_text(text: str, *, where: str = "serve"
+                               ) -> List[str]:
+    """Validate a ``ppls-tpu serve`` stdout stream (round 16): the
+    third artifact document type — the JSONL request ledger a
+    multi-tenant overload run leaves behind.
+
+    Shape: every JSON line is a RETIRE record (``rid`` + ``area``),
+    a SHED record (``shed: true`` with rid/tenant/reason — the
+    explicit rejection every load-shed request must get), a REJECTION
+    (``rejected: true`` with an error — malformed input lines), or
+    the single SUMMARY line (``summary: true``). Accounting
+    invariants, deduped by rid because a watchdog/supervisor resume
+    may legitimately replay post-snapshot lines: distinct retire rids
+    == ``summary.completed``; distinct shed rids == ``summary.shed``
+    (when reported); no rid both retires and sheds; failed retire
+    records carry ``area: null``. Returns problem strings (empty =
+    clean).
+
+    SCOPE: one ledger must cover one PROCESS LINEAGE's whole request
+    set. In-process supervisor resumes are covered (their stdout
+    accumulates every line). A zero-downtime RESTART (SIGTERM + new
+    process) splits the ledger: the second process's summary counts
+    snapshot-restored records its own stdout never printed —
+    CONCATENATE the processes' outputs (minus the earlier summaries)
+    before validating, as the restart tests do."""
+    problems: List[str] = []
+    summaries = []
+    retire_rids, shed_rids = set(), set()
+    failed_rids = set()
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            problems.append(f"{where}:{i}: unparseable JSON line")
+            continue
+        if not isinstance(rec, dict):
+            problems.append(f"{where}:{i}: not a JSON object")
+            continue
+        if rec.get("summary"):
+            summaries.append((i, rec))
+        elif rec.get("shed"):
+            if not isinstance(rec.get("rid"), int) \
+                    or not isinstance(rec.get("tenant"), str) \
+                    or not isinstance(rec.get("reason"), str):
+                problems.append(f"{where}:{i}: shed record without "
+                                f"rid/tenant/reason")
+            else:
+                shed_rids.add(rec["rid"])
+        elif rec.get("rejected"):
+            if not isinstance(rec.get("error"), str):
+                problems.append(f"{where}:{i}: rejection record "
+                                f"without 'error'")
+        elif "rid" in rec and "area" in rec:
+            if not isinstance(rec["rid"], int):
+                problems.append(f"{where}:{i}: non-int rid")
+                continue
+            retire_rids.add(rec["rid"])
+            if rec.get("failed"):
+                failed_rids.add(rec["rid"])
+                if rec["area"] is not None:
+                    problems.append(
+                        f"{where}:{i}: failed retire record must "
+                        f"carry area null, got {rec['area']!r}")
+            elif not _is_finite_number(rec.get("area")):
+                problems.append(
+                    f"{where}:{i}: retire record with non-finite "
+                    f"area {rec.get('area')!r}")
+        else:
+            problems.append(f"{where}:{i}: unrecognized serve record "
+                            f"shape (not retire/shed/rejected/"
+                            f"summary)")
+    if len(summaries) != 1:
+        problems.append(f"{where}: expected exactly 1 summary line, "
+                        f"found {len(summaries)}")
+        return problems
+    _, s = summaries[0]
+    for key in ("completed", "phases", "totals", "latency"):
+        if key not in s:
+            problems.append(f"{where}: summary missing {key!r}")
+    if isinstance(s.get("completed"), int) \
+            and len(retire_rids) != s["completed"]:
+        problems.append(
+            f"{where}: summary.completed={s['completed']} but "
+            f"{len(retire_rids)} distinct retire rids in the stream")
+    if isinstance(s.get("shed"), int) \
+            and len(shed_rids) != s["shed"]:
+        problems.append(
+            f"{where}: summary.shed={s['shed']} but "
+            f"{len(shed_rids)} distinct shed rids in the stream")
+    both = retire_rids & shed_rids
+    if both:
+        problems.append(f"{where}: rid(s) both retired and shed: "
+                        f"{sorted(both)[:8]}")
+    if isinstance(s.get("failed"), int) \
+            and len(failed_rids) != s["failed"]:
+        problems.append(
+            f"{where}: summary.failed={s['failed']} but "
+            f"{len(failed_rids)} distinct failed retire rids")
+    return problems
+
+
 def _scan_lines(text: str, where: str):
     """Scan a raw log/stdout stream for bench-record JSON lines;
     returns (problems, records_found)."""

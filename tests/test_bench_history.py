@@ -115,6 +115,53 @@ def test_gate_trips_on_broken_reconciliation():
     assert any("reconcile" in f for f in gate_record(bad, ref))
 
 
+def test_stream_gate_round16():
+    """Round-16 multi-tenant SLO gate: passes the committed reference
+    against itself; trips on shed-fraction drift past the absolute
+    band, per-class p99 growth past the band, a vanished priority
+    class, and a broken completed+shed accounting invariant; and
+    SKIPS cleanly for pre-round-16 references/records without the
+    stream block."""
+    from tools.bench_history import (GATE_SHED_ABS_TOL,
+                                     GATE_STREAM_P99_TOL,
+                                     gate_stream_record)
+    ref = _ref()
+    assert isinstance(ref.get("stream"), dict), \
+        "committed quick ref must carry the round-16 stream block"
+    assert gate_stream_record(copy.deepcopy(ref), ref) == []
+
+    bad = copy.deepcopy(ref)
+    bad["stream"]["shed_fraction"] = \
+        ref["stream"]["shed_fraction"] + GATE_SHED_ABS_TOL + 0.01
+    assert any("shed_fraction" in f
+               for f in gate_stream_record(bad, ref))
+
+    bad2 = copy.deepcopy(ref)
+    klass = sorted(ref["stream"]["latency_by_class"])[0]
+    row = bad2["stream"]["latency_by_class"][klass]
+    row["p99_phases"] = (ref["stream"]["latency_by_class"][klass]
+                         ["p99_phases"]
+                         * (1.0 + GATE_STREAM_P99_TOL) * 2)
+    assert any("p99" in f for f in gate_stream_record(bad2, ref))
+
+    bad3 = copy.deepcopy(ref)
+    del bad3["stream"]["latency_by_class"][klass]
+    assert any("vanished" in f for f in gate_stream_record(bad3, ref))
+
+    bad4 = copy.deepcopy(ref)
+    bad4["stream"]["accounting_ok"] = False
+    assert any("completed + shed" in f
+               for f in gate_stream_record(bad4, ref))
+
+    # pre-round-16 shapes skip the gate instead of failing it
+    old_ref = copy.deepcopy(ref)
+    del old_ref["stream"]
+    assert gate_stream_record(copy.deepcopy(ref), old_ref) == []
+    no_cur = copy.deepcopy(ref)
+    del no_cur["stream"]
+    assert gate_stream_record(no_cur, ref) == []
+
+
 @pytest.mark.parametrize("inject,expect_rc", [(False, 0), (True, 1)])
 def test_gate_cli_level(tmp_path, inject, expect_rc):
     """CLI-level twin of the fixture test: the exact invocation ci.sh

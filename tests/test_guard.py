@@ -58,6 +58,104 @@ def test_run_with_watchdog_no_resume_raises():
                                 log=lambda m: None)
 
 
+def test_supervisor_retry_budget_exhaustion_deterministic(monkeypatch):
+    """Satellite (round 16): repeated transient faults exhaust the
+    supervisor's total deadline DETERMINISTICALLY — the backoff
+    schedule is the documented base*2^(n-1) capped sequence, the loop
+    raises RetryBudgetExhausted instead of sleeping past the budget,
+    and the attempts/recoveries record reports every retry. A fake
+    clock advanced by the sleep stub makes the wall-clock budget check
+    exact."""
+    import time as _time
+    sleeps = []
+    attempts_seen = []
+    clock = [1000.0]
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock[0] += s
+
+    # guard.py does `import time` — patching the module attribute
+    # covers both the supervisor's t_start and its budget check
+    monkeypatch.setattr(_time, "monotonic", lambda: clock[0])
+
+    def always_transient():
+        attempts_seen.append(1)
+        raise guard.InjectedCrash("fault plan: phase-boundary crash")
+
+    sup = guard.Supervisor(
+        always_transient, backoff_base=1.0, backoff_cap=4.0,
+        max_attempts=100, total_deadline=10.0,
+        sleep=fake_sleep, log=lambda m: None)
+    with pytest.raises(guard.RetryBudgetExhausted,
+                       match="total deadline") as ei:
+        sup.run()
+    # deterministic schedule: sleeps 1 + 2 + 4 pass (elapsed 7), the
+    # FOURTH backoff (capped at 4: 7 + 4 > 10) is refused
+    assert sleeps == [1.0, 2.0, 4.0]
+    assert sup.attempts == 4 == len(attempts_seen)
+    assert sup.recoveries == [("transient", "backoff_resume")] * 3
+    # the last underlying failure rides on the exception
+    assert "phase-boundary crash" in str(ei.value)
+    # the exhausted budget classifies FATAL: a supervising layer must
+    # not see the embedded transient text and retry past the budget
+    assert guard.classify_failure(ei.value) == "fatal"
+
+
+def test_supervisor_reports_attempts_and_recoveries_on_success():
+    """Two transient failures then success: the summary-facing record
+    (attempts / recoveries) counts every leg correctly."""
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise guard.InjectedCrash("fault plan: crash")
+        return "done"
+
+    sup = guard.Supervisor(flaky, backoff_base=0.0, backoff_cap=0.0,
+                           sleep=lambda s: None, log=lambda m: None)
+    assert sup.run() == "done"
+    assert sup.attempts == 3
+    assert sup.recoveries == [("transient", "backoff_resume")] * 2
+
+
+def test_with_retry_total_deadline_exhaustion(monkeypatch):
+    """with_retry's budget arm: when elapsed + next backoff would
+    exceed total_deadline, RetryBudgetExhausted carries the last
+    underlying failure instead of sleeping into a hopeless wait."""
+    def always_fail():
+        raise RuntimeError("connection reset by peer (tunnel)")
+
+    log = []
+    with pytest.raises(guard.RetryBudgetExhausted,
+                       match="connection reset"):
+        guard.with_retry(always_fail, log, what="t",
+                         deadline=5.0, backoff_base=100.0,
+                         total_deadline=1.0, log=lambda m: None)
+    # refused BEFORE the first 100s backoff: nothing retried yet
+    assert log == []
+
+
+def test_graceful_shutdown_flag_and_restore():
+    """GracefulShutdown (round 16): installs handlers on the main
+    thread, a delivered SIGTERM only sets the flag (no exception), and
+    the previous handlers are restored on exit."""
+    import signal as _signal
+    before = _signal.getsignal(_signal.SIGTERM)
+    with guard.GracefulShutdown() as stop:
+        assert not stop.requested
+        os.kill(os.getpid(), _signal.SIGTERM)
+        # the handler runs synchronously on the main thread at the
+        # next bytecode boundary; the flag is the only effect
+        for _ in range(100):
+            if stop.requested:
+                break
+        assert stop.requested
+        assert stop.signal_name == "SIGTERM"
+    assert _signal.getsignal(_signal.SIGTERM) is before
+
+
 def test_cli_watchdog_hang_injection_resumes_from_checkpoint(
         tmp_path, capsys, monkeypatch):
     """The CLI acceptance (VERDICT r5 #4): a checkpointed family run

@@ -71,6 +71,7 @@ def main_from_events(path: str, lanes: int = 0) -> int:
     open_phase = {}          # id -> (open t)
     names = {}               # id -> span name
     retires = []
+    sheds = []               # request_shed events (round 16)
     checkpoints = 0
     segments = 0
     for line in text.splitlines():
@@ -105,6 +106,8 @@ def main_from_events(path: str, lanes: int = 0) -> int:
                     phase_walls.append(rec.get("t", t0) - t0)
         elif ev == "event" and rec.get("name") == "retire":
             retires.append(rec.get("attrs") or {})
+        elif ev == "event" and rec.get("name") == "request_shed":
+            sheds.append(rec.get("attrs") or {})
         elif ev == "event" and rec.get("name") == "checkpoint":
             checkpoints += 1
 
@@ -149,6 +152,47 @@ def main_from_events(path: str, lanes: int = 0) -> int:
                           for b in WASTE_BUCKETS):
         buckets = {b: tot(b) for b in WASTE_BUCKETS}
         print_attribution(buckets, tot("wsteps"), lanes)
+    # round-16 multi-tenant SLO decomposition: per-class tail latency
+    # + per-tenant retired/failed/shed accounting, offline from the
+    # same retire/request_shed events serve emitted — identical
+    # quantiles to the summary by the shared-histogram construction
+    if any("tenant" in r for r in retires) or sheds:
+        print("=== multi-tenant SLO ===")
+        # dedup by rid first: a resumed (appended-segment) timeline
+        # legitimately replays post-snapshot retire/shed events, and
+        # counting them twice would overstate every number below (the
+        # same rid-dedup rule validate_serve_output_text applies)
+        retires = list({r.get("rid"): r for r in retires}.values())
+        sheds = list({s.get("rid"): s for s in sheds}.values())
+        by_class, tenants = {}, {}
+        for r in retires:
+            pri = r.get("priority", 1)
+            by_class.setdefault(pri, Histogram(PHASE_BUCKETS)) \
+                .observe(int(r.get("latency_phases", 0)))
+            row = tenants.setdefault(str(r.get("tenant", "default")),
+                                     {"completed": 0, "failed": 0,
+                                      "shed": 0, "reasons": {}})
+            row["completed"] += 1
+            if r.get("failed"):
+                row["failed"] += 1
+        for s in sheds:
+            row = tenants.setdefault(str(s.get("tenant", "default")),
+                                     {"completed": 0, "failed": 0,
+                                      "shed": 0, "reasons": {}})
+            row["shed"] += 1
+            reason = str(s.get("reason", "?"))
+            row["reasons"][reason] = row["reasons"].get(reason, 0) + 1
+        for pri, h in sorted(by_class.items()):
+            print(f"  class {pri}: n={h.count} p50={h.quantile(0.5)} "
+                  f"p99={h.quantile(0.99)} (phases)")
+        for name, row in sorted(tenants.items()):
+            extra = (f" reasons={row['reasons']}"
+                     if row["reasons"] else "")
+            print(f"  tenant {name}: completed={row['completed']} "
+                  f"failed={row['failed']} shed={row['shed']}{extra}")
+        print(f"  accounting: retired={len(retires)} "
+              f"shed={len(sheds)} (every submitted rid is one or "
+              f"the other)")
     return 1 if problems else 0
 
 

@@ -89,6 +89,37 @@ THETA_KW = dict(capacity=1 << 16, roots_per_lane=8, refill_slots=8,
 GATE_THETA_MIN_REDUCTION = 4.0
 GATE_THETA_TOL = 0.25
 
+# Round 16: the multi-tenant overload SLO proxy leg (bench.py stream
+# --tenants / the quick record's multi_tenant block). A deterministic
+# Poisson overload (offered load ~8 requests/phase against a 4-slot
+# engine with a bounded queue) over three priority classes, with chaos
+# injected (one NaN-poisoned admission, one straggler boundary): the
+# device/schedule-counted outputs — shed fraction, per-class p50/p99
+# retire latency in phases, the completed+shed accounting invariant —
+# are bit-stable in interpret mode, so the gate can hold the
+# multi-tenant numbers the way it holds lane efficiency.
+STREAM_SLO_FAMILY = "sin_recip_scaled"
+STREAM_SLO_EPS = 1e-6
+STREAM_SLO_BOUNDS = (1e-2, 1.0)
+STREAM_SLO_K = 24
+STREAM_SLO_RATE = 8.0
+STREAM_SLO_QUEUE_LIMIT = 6
+STREAM_SLO_SEED = 23
+STREAM_SLO_KW = dict(slots=4, chunk=1 << 10, capacity=1 << 16,
+                     lanes=256, roots_per_lane=2, refill_slots=2,
+                     seg_iters=32, min_active_frac=0.05)
+STREAM_SLO_TENANTS = (("free", 0), ("std", 1), ("pro", 2))
+# chaos: rid 2 is NaN-poisoned post-validation (quarantine must
+# contain it), one straggler boundary adds recoverable wall noise
+STREAM_SLO_FAULTS = (
+    {"kind": "nan_poison", "at": 2},
+    {"kind": "straggler", "at": 3, "seconds": 0.05},
+)
+# gate bands: shed fraction may drift +-0.15 absolute; per-class p99
+# (phases) may grow <= 25% over the reference
+GATE_SHED_ABS_TOL = 0.15
+GATE_STREAM_P99_TOL = 0.25
+
 # gate tolerances (the "stated tolerance" of the round-11 acceptance)
 GATE_STEP_TOL = 0.5      # kernel_steps / boundaries may grow <= 1.5x
 GATE_EFF_TOL = 0.15      # lane_efficiency may drop <= 15% (relative)
@@ -365,6 +396,118 @@ def run_theta_proxies(ts=THETA_QUICK_T) -> dict:
     }
 
 
+def run_stream_slo_proxies() -> dict:
+    """The ``bench.py stream --tenants`` leg, standalone (one
+    definition for the bench record, the committed gate reference, and
+    the CI gate measurement — the :func:`run_quick_proxies` ownership
+    contract).
+
+    Drives the round-16 multi-tenant StreamEngine through a seeded
+    Poisson overload at ~{rate} requests/phase across three priority
+    classes with a bounded queue and chaos injected (NaN poison +
+    straggler), and reports the SLO proxies the gate holds: shed
+    fraction, per-class p50/p99 retire latency (phases), the
+    completed+shed accounting invariant, and the quarantine count.
+    Every reported number is schedule- or device-counted —
+    deterministic in interpret mode."""
+    import numpy as np
+
+    from ppls_tpu.runtime.faults import FaultInjector, FaultPlan
+    from ppls_tpu.runtime.stream import StreamEngine
+
+    rng = np.random.default_rng(STREAM_SLO_SEED)
+    k = STREAM_SLO_K
+    gaps = rng.exponential(1.0 / STREAM_SLO_RATE, k)
+    arrivals = [int(p) for p in
+                np.floor(np.cumsum(gaps) - gaps[0]).astype(int)]
+    reqs = []
+    for i in range(k):
+        tenant, pri = STREAM_SLO_TENANTS[i % len(STREAM_SLO_TENANTS)]
+        reqs.append((1.0 + i / k, STREAM_SLO_BOUNDS,
+                     {"tenant": tenant, "priority": pri}))
+    injector = FaultInjector(FaultPlan.from_events(
+        [dict(e) for e in STREAM_SLO_FAULTS]))
+    eng = StreamEngine(
+        STREAM_SLO_FAMILY, STREAM_SLO_EPS,
+        queue_limit=STREAM_SLO_QUEUE_LIMIT, quarantine=True,
+        fault_injector=injector, **STREAM_SLO_KW)
+    res = eng.run(reqs, arrival_phase=arrivals)
+    by_class = res.class_latency_percentiles()
+    shed_reasons: dict = {}
+    for s in res.shed:
+        shed_reasons[s.reason] = shed_reasons.get(s.reason, 0) + 1
+    failed = sum(1 for c in res.completed if c.failed)
+    return {
+        "metric": "multi-tenant overload SLO proxies",
+        "family": STREAM_SLO_FAMILY, "eps": STREAM_SLO_EPS,
+        "k_requests": k,
+        "offered_load_req_per_phase": STREAM_SLO_RATE,
+        "queue_limit": STREAM_SLO_QUEUE_LIMIT,
+        "slots": STREAM_SLO_KW["slots"],
+        "tenants": [t for t, _ in STREAM_SLO_TENANTS],
+        "faults_injected": [e.describe()
+                            for e in injector.plan.events if e.fired],
+        "requests_per_sec": round(res.requests_per_sec, 3),
+        "phases": res.phases,
+        "completed": len(res.completed),
+        "shed": len(res.shed),
+        "shed_fraction": round(len(res.shed) / k, 4),
+        "shed_reasons": shed_reasons,
+        "failed": failed,
+        "accounting_ok": len(res.completed) + len(res.shed) == k,
+        "latency_by_class": by_class,
+    }
+
+
+def gate_stream_record(cur: dict, ref: dict) -> List[str]:
+    """Round-16 multi-tenant SLO gate: the accounting invariant must
+    hold, the shed fraction at offered load ~8 must stay within
+    +-GATE_SHED_ABS_TOL (absolute) of the committed reference, and no
+    priority class's p99 retire latency (phases) may grow more than
+    GATE_STREAM_P99_TOL over it. A reference WITHOUT a stream block
+    skips the gate (pre-round-16 refs)."""
+    rs = (ref or {}).get("stream")
+    if not isinstance(rs, dict):
+        return []
+    cs = (cur or {}).get("stream")
+    if not isinstance(cs, dict):
+        # e.g. an offline --gate FILE record without the block; the CI
+        # path uses --gate-run, which always re-measures
+        return []
+    fails: List[str] = []
+    if cs.get("accounting_ok") is False:
+        fails.append("REGRESSION stream: completed + shed != offered "
+                     "requests (lost or duplicated work)")
+    sf, sf_ref = cs.get("shed_fraction"), rs.get("shed_fraction")
+    if not isinstance(sf, (int, float)):
+        fails.append("stream proxy missing shed_fraction")
+    elif isinstance(sf_ref, (int, float)) \
+            and abs(sf - sf_ref) > GATE_SHED_ABS_TOL:
+        fails.append(
+            f"REGRESSION stream: shed_fraction {sf:.3f} drifted "
+            f">{GATE_SHED_ABS_TOL} from the reference's "
+            f"{sf_ref:.3f}; re-record with --update-ref if intended")
+    cl, rl = cs.get("latency_by_class"), rs.get("latency_by_class")
+    if isinstance(cl, dict) and isinstance(rl, dict):
+        for klass, rrow in rl.items():
+            crow = cl.get(klass)
+            if not isinstance(crow, dict):
+                fails.append(f"stream proxy: priority class {klass} "
+                             f"vanished from latency_by_class")
+                continue
+            p99, p99_ref = crow.get("p99_phases"), rrow.get(
+                "p99_phases")
+            if isinstance(p99, (int, float)) \
+                    and isinstance(p99_ref, (int, float)) \
+                    and p99 > p99_ref * (1.0 + GATE_STREAM_P99_TOL):
+                fails.append(
+                    f"REGRESSION stream: class {klass} p99 "
+                    f"{p99:.1f} phases grew "
+                    f">{GATE_STREAM_P99_TOL:.0%} over the "
+                    f"reference's {p99_ref:.1f}")
+    return fails
+
+
 def gate_theta_record(cur: dict, ref: dict) -> List[str]:
     """Round-13 theta-proxy gate: the T=256 bookkeeping-per-theta
     reduction must hold the acceptance floor (>= 4x) and stay within
@@ -516,12 +659,14 @@ def main(argv: List[str]) -> int:
             "family", "eps", "bounds", "lanes",
             "t1_bookkeeping_per_theta", "t1_solo_samples",
             "solo_max_abs_err")}
+        rec["stream"] = run_stream_slo_proxies()
         with open(ref_path, "w", encoding="utf-8") as fh:
             json.dump(rec, fh, indent=1, sort_keys=True)
             fh.write("\n")
         print(f"bench_history: reference recorded -> {ref_path}")
         print(json.dumps(rec["walker"]))
         print(json.dumps(rec["theta"]))
+        print(json.dumps(rec["stream"]))
         return 0
 
     if gate_path or do_gate_run:
@@ -542,9 +687,15 @@ def main(argv: List[str]) -> int:
                 # — re-measure it so the amortization claim is gated
                 th = run_theta_proxies()
                 cur["theta"] = th["theta"]
+            if isinstance(ref.get("stream"), dict):
+                # round 16: the ref carries the multi-tenant SLO
+                # proxies — re-measure so the overload numbers are
+                # regression-guarded like lane efficiency
+                cur["stream"] = run_stream_slo_proxies()
         fails = gate_record(cur, ref, tolerance=tolerance,
                             eff_tolerance=eff_tol) \
-            + gate_theta_record(cur, ref)
+            + gate_theta_record(cur, ref) \
+            + gate_stream_record(cur, ref)
         for msg in fails:
             print(f"bench_history: GATE {msg}", file=sys.stderr)
         verdict = "TRIPPED" if fails else "passed"

@@ -16,6 +16,11 @@ Usage:
     python tools/check_artifacts.py --events EVENTS.jsonl [...]
         # validate event logs (--unbalanced-ok tolerates the unclosed
         # spans a killed run leaves behind)
+    python tools/check_artifacts.py --serve SERVE_STDOUT.jsonl [...]
+        # round 16: validate a serve stdout ledger — every line a
+        # retire/shed/rejection/summary record, with the rid-deduped
+        # accounting invariants (completed/shed/failed counts match
+        # the summary, no rid both retired and shed)
 """
 
 from __future__ import annotations
@@ -30,6 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from ppls_tpu.utils.artifact_schema import (  # noqa: E402
     validate_artifact_text,
     validate_events_text,
+    validate_serve_output_text,
 )
 
 
@@ -48,6 +54,15 @@ def main(argv) -> int:
             return 2
         event_paths.append(args[i + 1])
         del args[i:i + 2]
+    serve_paths = []
+    while "--serve" in args:
+        i = args.index("--serve")
+        if i + 1 >= len(args):
+            print("check_artifacts: --serve requires a FILE",
+                  file=sys.stderr)
+            return 2
+        serve_paths.append(args[i + 1])
+        del args[i:i + 2]
     paths = args
     problems = []
     for p in event_paths:
@@ -55,6 +70,14 @@ def main(argv) -> int:
             problems += validate_events_text(
                 fh.read(), where=os.path.basename(p),
                 require_balanced=balanced)
+    # round 16: serve stdout ledgers (retire/shed/rejection/summary
+    # accounting invariants) — the chaos-under-load CI step's third
+    # artifact document type
+    for p in serve_paths:
+        with open(p) as fh:
+            problems += validate_serve_output_text(
+                fh.read(), where=os.path.basename(p))
+    event_paths = event_paths + serve_paths
     if event_paths and not paths:
         for msg in problems:
             print(f"check_artifacts: {msg}", file=sys.stderr)

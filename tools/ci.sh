@@ -27,6 +27,15 @@
 #       file and self-heal by starting fresh. Both timelines validate
 #       through tools/check_artifacts.py --events (crashed prefixes
 #       allowed), and the summaries' recovery records are asserted.
+#   5c. CHAOS UNDER LOAD (round 16): a seeded Poisson overload beyond
+#       capacity (12 requests at ~8/phase into a 4-slot dd stream with
+#       a 5-deep bounded queue, three tenants across three priority
+#       classes) with chip-loss + NaN poison injected, through
+#       `serve --supervise`. The summary must show the shed/quarantine
+#       /per-class-SLO story (completed + shed == offered, quarantine
+#       == 1, resize-resume recovery) and the stdout ledger + events
+#       timeline validate through tools/check_artifacts.py --serve /
+#       --events (rid-deduped accounting invariants).
 #   6. bench observatory: tools/bench_history.py --check over the
 #      committed round artifacts + the quick-proxy regression gate
 #      (device-counted proxies vs tools/bench_quick_ref.json)
@@ -236,6 +245,57 @@ if [ "$chaos_fail" -ne 0 ]; then
     FAILURES=$((FAILURES + 1))
 else
     echo "ci: seeded chaos drain OK"
+fi
+
+# --- 5c. chaos under load: overload + chip-loss + NaN poison ---
+step "serve multi-tenant chaos under load (overload + chip-loss + NaN)"
+OV_DIR="$(mktemp -d)"
+ov_fail=0
+if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m ppls_tpu serve \
+        --engine walker-dd --n-devices 8 \
+        --synthetic 12 --arrival-rate 8 --seed 0 --eps 1e-6 \
+        -a 1e-2 -b 1.0 --slots 4 --chunk 256 --capacity 65536 \
+        --lanes 256 --refill-slots 2 \
+        --queue-limit 5 --tenants "free:2:0,std:1:1,pro:1:2" \
+        --checkpoint "$OV_DIR/ov.ckpt" --checkpoint-every 1 \
+        --watchdog 120 --events "$OV_DIR/ov.jsonl" \
+        --fault-plan @tools/chaos_plan_overload.json \
+        > "$OV_DIR/ov.out" 2> "$OV_DIR/ov.err"; then
+    python - "$OV_DIR/ov.out" <<'PYEOF' || ov_fail=1
+import json, sys
+lines = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+s = lines[-1]
+assert s.get("summary") and s.get("supervised"), "not supervised"
+# the overload accounting invariant: every offered request either
+# retired (quarantine included) or has an explicit shed record
+assert s["completed"] + s["shed"] == 12, (s["completed"], s["shed"])
+assert s["shed"] >= 1, "overload produced no sheds"
+assert s.get("failed") == 1, ("quarantine", s.get("failed"))
+actions = [r["action"] for r in s["recoveries"]]
+assert "resize_resume" in actions, actions      # chip loss recovered
+kinds = {e["kind"] for e in s["faults_injected"]}
+assert kinds == {"nan_poison", "chip_loss"}, kinds
+assert s["latency_by_class"], "no per-class SLO block"
+shed_lines = [r for r in lines if r.get("shed") is True]
+assert len(shed_lines) == s["shed"], "shed records != summary.shed"
+assert all("tenant" in r and "reason" in r for r in shed_lines)
+print("ci: chaos-under-load OK (shed + quarantine + resize-resume, "
+      f"per-class SLO over {len(s['latency_by_class'])} classes)")
+PYEOF
+else
+    echo "ci: chaos-under-load serve FAILED"
+    ov_fail=1
+fi
+python tools/check_artifacts.py --serve "$OV_DIR/ov.out" \
+    --events "$OV_DIR/ov.jsonl" --unbalanced-ok || ov_fail=1
+rm -rf "$OV_DIR"
+if [ "$ov_fail" -ne 0 ]; then
+    echo "ci: chaos under load FAILED"
+    FAILURES=$((FAILURES + 1))
+else
+    echo "ci: chaos under load OK"
 fi
 
 # --- 6. bench observatory: trajectory check + quick-proxy gate ---
