@@ -850,12 +850,17 @@ def _main_serve(args) -> int:
 
     # round 16: cooperative SIGTERM/SIGINT — the loop checks the flag
     # at phase boundaries and winds down with a final checkpoint +
-    # balanced span close + summary (the zero-downtime-restart half);
-    # the engine lock serializes the phase loop against the ingest
-    # handler threads (the engine itself is single-threaded by design)
+    # balanced span close + summary (the zero-downtime-restart half).
+    # Round 17: the engine handle (which attempt is live, if any) is a
+    # lock-disciplined publication cell — EngineHandle serializes the
+    # phase loop against the ingest handler threads (the engine itself
+    # is single-threaded by design), and graftlint GL11 lints the
+    # discipline so the PR-10 ack-after-engine-death race shape cannot
+    # quietly come back.
     from ppls_tpu.runtime.guard import GracefulShutdown
+    from ppls_tpu.runtime.ingest import EngineHandle
     stop = GracefulShutdown()
-    eng_lock = threading.RLock()
+    handle = EngineHandle()
 
     ingest_srv = None
     if args.ingest_port is not None:
@@ -864,8 +869,8 @@ def _main_serve(args) -> int:
         def ingest_submit(d):
             rec = parse_request_record(d, theta_block=T)
             rec.pop("arrival_phase", None)     # live ingest is "now"
-            with eng_lock:
-                eng = holder.get("eng")
+            with handle.lock():
+                eng = handle.peek()
                 if eng is None or stop.requested:
                     raise ValueError("service not accepting requests")
                 n0 = len(eng.shed)
@@ -878,7 +883,7 @@ def _main_serve(args) -> int:
                 return {"rid": rid, "accepted": True}
 
         def ingest_stats():
-            eng = holder.get("eng")
+            eng = handle.peek()
             if eng is None:
                 return {"ready": False}
             return {"ready": True, "phase": eng.phase,
@@ -895,8 +900,7 @@ def _main_serve(args) -> int:
     def serve_loop():
         t0 = time.perf_counter()
         eng = make_engine()
-        with eng_lock:
-            holder["eng"] = eng
+        handle.publish(eng)
         span = eng.telemetry.span("run", mode="serve",
                                   engine=f"{args.engine}-stream",
                                   requests=len(reqs))
@@ -916,7 +920,7 @@ def _main_serve(args) -> int:
         ingest_on = ingest_srv is not None
         while (k < len(reqs) or not eng.idle or ingest_on) \
                 and not stop.requested:
-            with eng_lock:
+            with handle.lock():
                 try:
                     while k < len(reqs) and arrivals[k] <= eng.phase:
                         r = reqs[k]
@@ -935,7 +939,7 @@ def _main_serve(args) -> int:
                     # lost. Clearing the handle UNDER THE LOCK makes
                     # ingest_submit refuse (clients retry) until the
                     # next attempt publishes a live engine.
-                    holder.pop("eng", None)
+                    handle.clear()
                     raise
             with io_lock:
                 for c in retired:
@@ -970,7 +974,7 @@ def _main_serve(args) -> int:
             # queue) rides the final snapshot, so `serve --checkpoint`
             # restart resumes with ZERO lost acknowledged requests
             holder["stopped"] = stop.signal_name or "signal"
-            with eng_lock:
+            with handle.lock():
                 if args.checkpoint:
                     eng.snapshot()
                 eng.telemetry.event(

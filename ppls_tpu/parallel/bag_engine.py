@@ -463,3 +463,31 @@ def resume_family(path: str, f_theta: Callable, theta: Sequence[float],
                             checkpoint_path=path,
                             checkpoint_every=checkpoint_every,
                             _state_override=state)
+
+
+def deep_trace_probes():
+    """Traceable entry point for the semantic lint tier (round 17):
+    the f64 LIFO bag program (:func:`_run_bag`) with its dynamic
+    ``stop_iters`` leg bound as a traced operand — the GL10 probe
+    varies it (and the seed payload) across traces to pin that leg
+    boundaries never recompile (the documented no-recompile-per-leg
+    contract at the def site). See ``tools/graftlint/deep.py``."""
+    from ppls_tpu.config import Rule
+    from ppls_tpu.models.integrands import FAMILIES
+    f_theta = FAMILIES["sin_scaled"]
+    capacity, chunk = 1 << 9, 1 << 7
+
+    def bag_fn(state, stop_iters):
+        return _run_bag(state, stop_iters, f_theta=f_theta, eps=1e-3,
+                        rule=Rule.TRAPEZOID, chunk=chunk,
+                        capacity=capacity, max_iters=1 << 10)
+
+    def bag_ops(seed: int):
+        bounds = np.array([[0.125, 1.0 + 0.25 * seed]],
+                          dtype=np.float64)
+        theta = np.array([0.5 + 0.125 * seed], dtype=np.float64)
+        state = initial_bag(bounds, capacity, 1, chunk, theta=theta)
+        stop_iters = jnp.asarray(50 + seed, jnp.int64)
+        return (state, stop_iters)
+
+    return [("bag_engine._run_bag", bag_fn, bag_ops)]

@@ -228,3 +228,29 @@ def device_integrate(config: QuadConfig = QuadConfig(),
         metrics=metrics,
         exact=entry.exact(config.a, config.b),
     )
+
+
+def deep_trace_probes():
+    """Traceable entry point for the semantic lint tier (round 17):
+    the legacy XLA-boundary wavefront program (:func:`_run`). ``fill``
+    is DELIBERATELY a traced operand (sweeping panels must not
+    recompile — the GL05 allowlist entry documents it); the GL10 probe
+    varies it across traces to pin that the program really does treat
+    it as data. See ``tools/graftlint/deep.py``."""
+    from ppls_tpu.models.integrands import FAMILIES
+    f_theta = FAMILIES["sin_scaled"]
+    capacity = 1 << 9
+
+    def f(x):
+        return f_theta(x, 1.25)
+
+    def dev_fn(state, fill):
+        return _run(state, f=f, eps=1e-3, rule=Rule.TRAPEZOID,
+                    capacity=capacity, max_rounds=64, fill=fill)
+
+    def dev_ops(seed: int):
+        state = initial_state(0.125, 1.0 + 0.25 * seed, capacity)
+        fill = jnp.asarray(0.5 + 0.125 * seed, jnp.float64)
+        return (state, fill)
+
+    return [("device_engine._run", dev_fn, dev_ops)]

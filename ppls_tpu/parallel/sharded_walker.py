@@ -1148,3 +1148,62 @@ def resume_family_walker_dd(
         checkpoint_path=path,
         _state_override=(bag_l, bag_r, bag_th, bag_meta, counts),
         _totals_override=totals, **kwargs)
+
+
+def deep_trace_probes():
+    """Traceable entry points for the semantic lint tier (round 17).
+
+    Builds the demand-driven shard programs
+    (:func:`build_dd_walker_run`) in BOTH modes — refill (the
+    flagship: chip-local breed + one phase-granular reshard) and
+    legacy (collective breed rounds) — on the virtual mesh, over a
+    tiny per-chip workload. ``tools/graftlint/deep.py`` walks the
+    captured jaxprs: GL07's collective census is the whole point here
+    (GL04's AST view cannot see through the ``shard_map`` body or the
+    breed-dispatch ``lax.cond``), and GL10 pins that differing
+    operand values trace to the identical shard program (the
+    compile-once contract the lru-cached builder exists to keep).
+    """
+    from ppls_tpu.parallel.walker import resolve_cadence
+    n_dev = min(8, len(jax.devices()))
+    mesh = make_mesh(n_dev)
+    family, eps = "sin_scaled", 1e-3
+    lanes, rpl, capacity, chunk, m = 128, 4, 1 << 9, 1 << 7, 1
+    target_local, breed_chunk, store, reshard_window = _dd_sizing(
+        lanes, capacity, chunk, rpl)
+    bounds0 = np.array([[0.125, 1.0]], dtype=np.float64)
+    fill_l = float(0.5 * (bounds0[0, 0] + bounds0[0, 1]))
+    fill_th = 0.5
+
+    def build(refill_slots: int):
+        exit_frac, suspend_frac = resolve_cadence(None, None, False,
+                                                  refill_slots)
+        return build_dd_walker_run(
+            mesh, family, eps, int(breed_chunk), int(capacity), m,
+            lanes, 64, 1 << 10, 0.1, float(exit_frac),
+            float(suspend_frac), int(target_local), True, 2,
+            fill_l, fill_th, Rule.TRAPEZOID, True, 8.0,
+            refill_slots, int(reshard_window) if refill_slots else 0)
+
+    def build_operands(seed: int):
+        bounds = np.array([[0.125, 1.0 + 0.25 * seed]],
+                          dtype=np.float64)
+        theta = np.array([0.5 + 0.125 * seed], dtype=np.float64)
+        bag_l, bag_r, bag_th, bag_meta, count0 = _seed_state(
+            bounds, theta, n_dev, store, capacity, fill_l, fill_th)
+        state = (jnp.asarray(bag_l).reshape(-1),
+                 jnp.asarray(bag_r).reshape(-1),
+                 jnp.asarray(bag_th).reshape(-1),
+                 jnp.asarray(bag_meta).reshape(-1),
+                 jnp.asarray(count0, dtype=jnp.int32),
+                 jnp.full((n_dev, m), 0.25 * seed, jnp.float64))
+        counters = tuple(jnp.zeros(n_dev, jnp.int64) for _ in CTR64) + (
+            jnp.zeros((n_dev, N_WASTE), jnp.int64),
+            jnp.zeros((n_dev, 2), jnp.int64),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros(n_dev, dtype=bool))
+        return state + counters
+
+    return [("sharded_walker.dd_refill", build(4), build_operands),
+            ("sharded_walker.dd_legacy", build(0), build_operands)]

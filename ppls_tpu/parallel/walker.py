@@ -3884,3 +3884,54 @@ def resume_family_walker(
 # over the tunnel, not collectives — so the pmap path's only advantage
 # (no collectives) was worth ~0%, while it could not balance skewed
 # families, could not checkpoint, and rode a deprecation-tracked API.
+
+
+def deep_trace_probes():
+    """Traceable entry points for the semantic lint tier (round 17).
+
+    ``tools/graftlint/deep.py`` traces the REAL jitted engine programs
+    and walks the captured jaxprs (GL07 collective census, GL08
+    dtype-flow audit, GL09 host-interop census, GL10 jaxpr-hash
+    stability). This probe builds the single-chip flagship cycle
+    program (scout + double-buffer + in-kernel refill — the round-12
+    bench configuration) over a TINY workload: tracing never executes
+    the program, so only shapes and statics matter, and the probe
+    keeps them small enough that a full deep-lint run stays inside
+    the CI wall budget. The streaming phase program
+    (:func:`run_stream_cycle`) is probed by ``runtime/stream.py`` —
+    the engine that owns its sizing; the dd programs by
+    ``sharded_walker.py``.
+
+    Returns ``[(name, fn, build_operands), ...]`` where ``fn`` closes
+    over the compile statics and ``build_operands(seed)`` returns
+    operand arrays whose VALUES differ per seed with identical
+    shapes/dtypes — the GL10 contract: two traces of a correctly
+    static-disciplined program are jaxpr-identical across operand
+    values.
+    """
+    from ppls_tpu.models.integrands import FAMILIES, get_family_ds
+    f_theta = FAMILIES["sin_scaled"]
+    f_ds = get_family_ds("sin_scaled")
+    lanes, rpl, capacity, chunk = 128, 4, 1 << 9, 1 << 7
+    target, breed_chunk, slack = walker_sizing(lanes, rpl, capacity,
+                                               chunk)
+    cyc_statics = dict(
+        f_theta=f_theta, f_ds=f_ds, eps=1e-3, m=1, seg_iters=64,
+        max_segments=1 << 10, min_active_frac=0.1, exit_frac=0.95,
+        suspend_frac=0.65, interpret=True, lanes=lanes,
+        capacity=capacity, breed_chunk=breed_chunk, target=target,
+        rule=Rule.TRAPEZOID, sort_roots=True, refill_slots=rpl,
+        sort_skip_ratio=8.0, scout=True, double_buffer=True,
+        theta_block=1)
+
+    def cycles_fn(bag, acc0):
+        return _run_cycles(bag, acc0, None, max_cycles=4, **cyc_statics)
+
+    def cycles_ops(seed: int):
+        bounds = np.array([[0.125, 1.0 + 0.25 * seed]], dtype=np.float64)
+        theta = np.array([0.5 + 0.125 * seed], dtype=np.float64)
+        bag = initial_bag(bounds, capacity, 1, slack, theta=theta)
+        acc0 = jnp.full(1, 0.25 * seed, jnp.float64)
+        return (bag, acc0)
+
+    return [("walker._run_cycles", cycles_fn, cycles_ops)]

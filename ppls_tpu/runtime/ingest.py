@@ -45,6 +45,56 @@ from typing import Optional
 MAX_BODY_BYTES = 1 << 20
 
 
+class EngineHandle:
+    """Lock-guarded publication cell for the live engine.
+
+    The serve loop and the ingest handler threads share exactly one
+    piece of mutable state: WHICH engine attempt (if any) is alive and
+    may receive submissions. Round 16 fixed, by hand review, the race
+    where an ingest ack landed in a dead engine during the
+    supervisor's backoff window — the handle was being cleared outside
+    the lock that the submit path held. This class makes that fix
+    structural: ``_eng`` is touched ONLY inside ``with self._lock``
+    blocks, and graftlint GL11 (``tools/graftlint/rules/locks.py``)
+    lints the discipline so the next edit cannot quietly regress it.
+
+    The lock is REENTRANT and exposed via :meth:`lock`: the serve loop
+    holds it across multi-operation critical sections (submit burst +
+    phase step + clear-on-death) while the methods here re-acquire it
+    harmlessly, so callers compose ``with handle.lock():`` around
+    whatever sequence must be atomic against the handler threads.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._eng = None
+
+    def lock(self):
+        """The owning RLock, for caller-composed critical sections."""
+        return self._lock
+
+    def publish(self, eng) -> None:
+        """Make ``eng`` the live engine the handler threads may use."""
+        with self._lock:
+            self._eng = eng
+
+    def clear(self) -> None:
+        """Un-publish (a failed attempt's engine is DEAD state: its
+        resume restores the last snapshot, so an ack landing in it
+        would be silently lost — callers must clear UNDER the same
+        lock that guards the submit path, which this method does)."""
+        with self._lock:
+            self._eng = None
+
+    def peek(self):
+        """The live engine or None. The reference is only safe to USE
+        while the caller still holds :meth:`lock` (reentrant, so
+        calling this inside a ``with handle.lock():`` block is the
+        intended shape); a bare peek is only for read-only stats."""
+        with self._lock:
+            return self._eng
+
+
 def parse_request_record(d: dict, theta_block: int = 1) -> dict:
     """Validate + normalize one ingest/JSONL request record into the
     ``StreamEngine.submit`` kwargs shape. Raises ``ValueError`` with a

@@ -2092,3 +2092,50 @@ class StreamEngine:
     def clear_snapshot(self):
         if self.checkpoint_path and os.path.exists(self.checkpoint_path):
             os.unlink(self.checkpoint_path)
+
+
+def deep_trace_probes():
+    """Traceable entry point for the semantic lint tier (round 17).
+
+    The streaming engine's jitted phase program is
+    ``walker.run_stream_cycle``; this probe builds it with THIS
+    module's sizing conventions (the same ``walker_sizing`` call
+    ``StreamEngine.__init__`` makes) over a tiny two-slot workload so
+    ``tools/graftlint/deep.py`` can census its jaxpr (GL07-GL09) and
+    pin its jaxpr-hash across differing operand values (GL10 — the
+    semantic twin of the ``compile_once_guard`` fixture: ``phase``,
+    the accumulators, and the bag payload are all traced operands, so
+    two traces with different values must be IDENTICAL programs).
+    """
+    from ppls_tpu.models.integrands import get_family, get_family_ds
+    from ppls_tpu.parallel.bag_engine import initial_bag
+    slots, lanes, rpl, capacity, chunk = 2, 128, 4, 1 << 9, 1 << 7
+    target, breed_chunk, slack = walker_sizing(lanes, rpl, capacity,
+                                               chunk)
+    statics = dict(
+        f_theta=get_family("sin_scaled"),
+        f_ds=get_family_ds("sin_scaled"),
+        eps=1e-3, m=slots, seg_iters=64, max_segments=1 << 10,
+        min_active_frac=0.1, exit_frac=0.80, suspend_frac=0.5,
+        interpret=True, lanes=lanes, capacity=capacity,
+        breed_chunk=breed_chunk, target=target, rule=Rule.TRAPEZOID,
+        sort_roots=True, refill_slots=rpl, sort_skip_ratio=8.0,
+        f64_rounds=0, scout=False, double_buffer=False, theta_block=1)
+
+    def stream_fn(bag, acc, acc_c, fam_last, phase):
+        return run_stream_cycle(bag, acc, acc_c, fam_last, phase, None,
+                                **statics)
+
+    def stream_ops(seed: int):
+        bounds = np.tile(
+            np.array([[0.125, 1.0 + 0.25 * seed]], dtype=np.float64),
+            (slots, 1))
+        theta = np.array([0.5, 0.75 + 0.125 * seed], dtype=np.float64)
+        bag = initial_bag(bounds, capacity, slots, slack, theta=theta)
+        acc = jnp.full(slots, 0.5 * seed, jnp.float64)
+        acc_c = jnp.zeros(slots, jnp.float64)
+        fam_last = jnp.full(slots, -1, jnp.int32)
+        phase = jnp.asarray(3 + seed, jnp.int32)
+        return (bag, acc, acc_c, fam_last, phase)
+
+    return [("stream.run_stream_cycle", stream_fn, stream_ops)]

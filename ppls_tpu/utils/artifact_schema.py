@@ -366,3 +366,88 @@ def _scan_lines(text: str, where: str):
         except ArtifactSchemaError as e:
             problems.append(str(e))
     return problems, found
+
+
+# --- round 17: graftlint --format json documents ---------------------------
+
+def validate_graftlint_json(doc, where: str = "graftlint") -> List[str]:
+    """Validate a ``python -m tools.graftlint --format json`` document:
+    the machine-readable lint ledger ci.sh feeds to annotation tooling.
+    One record per violation with the full line-free key, counts that
+    reconcile with the record list, and an ``ok`` flag consistent with
+    the new-violation count — a malformed or self-inconsistent ledger
+    must fail CI loudly, exactly like a malformed bench record."""
+    import re
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"{where}: document is not a JSON object"]
+    if doc.get("schema") != "graftlint-v1":
+        problems.append(f"{where}: schema != 'graftlint-v1' "
+                        f"({doc.get('schema')!r})")
+    if not isinstance(doc.get("target"), str) or not doc.get("target"):
+        problems.append(f"{where}: missing/empty 'target'")
+    if not isinstance(doc.get("deep"), bool):
+        problems.append(f"{where}: 'deep' must be a bool")
+    vs = doc.get("violations")
+    if not isinstance(vs, list):
+        return problems + [f"{where}: 'violations' must be a list"]
+    code_re = re.compile(r"^GL\d{2}$")
+    n_new = n_known = 0
+    for i, v in enumerate(vs):
+        w = f"{where}: violations[{i}]"
+        if not isinstance(v, dict):
+            problems.append(f"{w}: not an object")
+            continue
+        for k, t in (("key", str), ("code", str), ("path", str),
+                     ("symbol", str), ("message", str), ("line", int),
+                     ("grandfathered", bool)):
+            if not isinstance(v.get(k), t) or (t is str and not v[k]):
+                problems.append(f"{w}: missing/invalid {k!r}")
+        code = v.get("code")
+        if isinstance(code, str) and not code_re.match(code):
+            problems.append(f"{w}: code {code!r} is not GLxx")
+        key = v.get("key")
+        if isinstance(key, str) and isinstance(code, str) \
+                and isinstance(v.get("path"), str) \
+                and isinstance(v.get("symbol"), str) \
+                and key != f"{code}:{v['path']}:{v['symbol']}":
+            problems.append(f"{w}: key {key!r} != code:path:symbol")
+        if v.get("grandfathered") is True:
+            n_known += 1
+            if not isinstance(v.get("reason"), str):
+                problems.append(f"{w}: grandfathered record lacks a "
+                                f"'reason'")
+        elif v.get("grandfathered") is False:
+            n_new += 1
+    stale = doc.get("stale")
+    if not isinstance(stale, list) \
+            or not all(isinstance(s, str) for s in stale):
+        problems.append(f"{where}: 'stale' must be a list of keys")
+    counts = doc.get("counts")
+    if not isinstance(counts, dict):
+        problems.append(f"{where}: missing 'counts'")
+    else:
+        expect = {"total": n_new + n_known, "new": n_new,
+                  "grandfathered": n_known,
+                  "stale": len(stale) if isinstance(stale, list)
+                  else counts.get("stale")}
+        for k, e in expect.items():
+            if counts.get(k) != e:
+                problems.append(
+                    f"{where}: counts.{k}={counts.get(k)!r} does not "
+                    f"reconcile with the record list ({e})")
+    if isinstance(doc.get("ok"), bool) and doc["ok"] != (n_new == 0):
+        problems.append(f"{where}: ok={doc['ok']} but {n_new} new "
+                        f"violation record(s)")
+    elif not isinstance(doc.get("ok"), bool):
+        problems.append(f"{where}: 'ok' must be a bool")
+    return problems
+
+
+def validate_graftlint_text(text: str,
+                            where: str = "graftlint") -> List[str]:
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        return [f"{where}: unparseable JSON: {e}"]
+    return validate_graftlint_json(doc, where=where)

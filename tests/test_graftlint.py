@@ -760,3 +760,307 @@ def test_gl01_mesh_resize_fixed_by_identity_key(tmp_path):
         '{"engine": "walker-dd", "eps": 1e-6, "n_dev": 8}')
     pkg = _mkpkg(tmp_path, {"parallel/sharded_walker.py": fixed})
     assert [v for v in run_lint(pkg) if v.code == "GL01"] == []
+
+
+# ---------------------------------------------------------------------------
+# Round 17 — GL11 lock discipline (the PR-10 ingest race shape)
+# ---------------------------------------------------------------------------
+
+GL11_BROKEN = """
+    import threading
+
+    class EngineHandle:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._eng = None        # construction: not yet shared
+
+        def publish(self, eng):
+            with self._lock:
+                self._eng = eng
+
+        def ack_submit(self, d):
+            # THE PR-10 RACE SHAPE: the shared handle read outside the
+            # engine lock — between this read and eng.submit() the
+            # serve loop can crash and clear the handle, so the ack
+            # lands in a DEAD engine and vanishes at resume
+            eng = self._eng
+            return eng.submit(d)
+
+        def clear_on_death(self):
+            self._eng = None        # write outside the lock: same race
+"""
+
+
+def test_gl11_flags_unlocked_handle_touch(tmp_path):
+    pkg = _mkpkg(tmp_path, {"runtime/ingest.py": GL11_BROKEN})
+    got = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL11")
+    assert got == ["EngineHandle.ack_submit:_eng",
+                   "EngineHandle.clear_on_death:_eng"], got
+
+
+def test_gl11_fixed_by_taking_the_lock(tmp_path):
+    fixed = GL11_BROKEN.replace(
+        "            eng = self._eng\n"
+        "            return eng.submit(d)",
+        "            with self._lock:\n"
+        "                eng = self._eng\n"
+        "                return eng.submit(d)").replace(
+        "            self._eng = None        "
+        "# write outside the lock: same race",
+        "            with self._lock:\n"
+        "                self._eng = None")
+    pkg = _mkpkg(tmp_path, {"runtime/ingest.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL11"] == []
+
+
+def test_gl11_init_is_exempt(tmp_path):
+    # only ack_submit/clear_on_death fire above — __init__'s unlocked
+    # assignment is construction, the object is not yet shared (the
+    # declared unlocked_ok exemption)
+    pkg = _mkpkg(tmp_path, {"runtime/ingest.py": GL11_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL11"]
+    assert not any("__init__" in v.symbol for v in got)
+
+
+def test_gl11_scoped_to_declared_modules(tmp_path):
+    # the same source outside the declared lock-map modules is not in
+    # scope: the map is the reviewed declaration of where shared
+    # mutable state lives
+    pkg = _mkpkg(tmp_path, {"runtime/other.py": GL11_BROKEN})
+    assert [v for v in run_lint(pkg) if v.code == "GL11"] == []
+
+
+def test_gl11_lock_map_entries_carry_reasons():
+    # every declared module must state WHY its guarded set is what it
+    # is — an empty reason is an undocumented threading contract
+    from tools.graftlint.rules import GL11_LOCK_MAP
+    assert "runtime/ingest.py" in GL11_LOCK_MAP
+    assert "runtime/stream.py" in GL11_LOCK_MAP
+    for module, entry in GL11_LOCK_MAP.items():
+        assert entry["locks"], f"{module}: no lock declared"
+        assert isinstance(entry["reason"], str) \
+            and len(entry["reason"]) > 40, \
+            f"{module} lacks a substantive reason"
+    # ingest.py's guarded set is the PR-10 race armor — it must never
+    # silently empty out
+    assert "_eng" in GL11_LOCK_MAP["runtime/ingest.py"]["guarded"]
+
+
+# ---------------------------------------------------------------------------
+# Round 17 — the functools.partial call-graph fix (GL03/GL06 BFS)
+# ---------------------------------------------------------------------------
+
+GL03_PARTIAL_BROKEN = """
+    import functools
+    import jax
+    import numpy as np
+
+    def helper(k, x):
+        return np.asarray(x)          # host sync behind a partial
+
+    @functools.partial(jax.jit, static_argnames=())
+    def entry(x):
+        cb = functools.partial(helper, 2)
+        return cb(x)
+"""
+
+
+def test_gl03_resolves_functools_partial_targets(tmp_path):
+    # pre-round-17 the BFS only followed direct calls: `cb(x)` is an
+    # unresolvable local name, so helper never joined the reachable
+    # set and its np.asarray was silently invisible
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": GL03_PARTIAL_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL03"]
+    assert [v.symbol for v in got] == ["helper:np.asarray"], got
+
+
+def test_gl03_partial_fixed_twin_clean(tmp_path):
+    fixed = GL03_PARTIAL_BROKEN.replace("return np.asarray(x)",
+                                        "return x + k")
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL03"] == []
+
+
+def test_gl03_partial_cross_module(tmp_path):
+    # the partial edge resolves through import bindings like a direct
+    # call: partial(pull, ...) in hot.py reaches helpers.pull
+    pkg = _mkpkg(tmp_path, {
+        "parallel/helpers.py": """
+            import numpy as np
+
+            def pull(k, x):
+                return np.asarray(x)
+        """,
+        "parallel/hot.py": """
+            import functools
+            import jax
+            from pkg.parallel.helpers import pull
+
+            @functools.partial(jax.jit, static_argnames=())
+            def entry(x):
+                cb = functools.partial(pull, 1)
+                return cb(x)
+        """,
+    })
+    got = [v for v in run_lint(pkg) if v.code == "GL03"]
+    assert [v.symbol for v in got] == ["pull:np.asarray"]
+    assert got[0].path.endswith("helpers.py")
+
+
+# ---------------------------------------------------------------------------
+# Round 17 — --prune-stale, --format json, tier-scoped staleness
+# ---------------------------------------------------------------------------
+
+def test_prune_stale_rewrites_baseline(tmp_path):
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    bpath = tmp_path / "baseline.json"
+    bpath.write_text(json.dumps(
+        {"version": 1, "_comment": ["policy text"], "grandfathered": []}))
+    write_baseline(str(bpath), run_lint(pkg))
+    # hand the surviving entry a reason so the prune must preserve it
+    doc = json.loads(bpath.read_text())
+    for e in doc["grandfathered"]:
+        e["reason"] = f"reviewed: {e['key']}"
+    bpath.write_text(json.dumps(doc))
+    # fix one site -> its entry is stale
+    fixed = GL02_BROKEN.replace("x.astype(jnp.float32)", "x")
+    (tmp_path / "pkg/parallel/num.py").write_text(textwrap.dedent(fixed))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", pkg,
+         "--baseline", str(bpath), "--prune-stale", "--quiet"],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pruned 1 stale" in r.stdout
+    data = json.loads(bpath.read_text())
+    # shrink-only: the fixed site's entry dropped, survivors verbatim,
+    # the _comment policy block untouched
+    assert data["_comment"] == ["policy text"]
+    keys = [e["key"] for e in data["grandfathered"]]
+    assert len(keys) == 2 and not any("float32" in k for k in keys)
+    assert all(e["reason"].startswith("reviewed:")
+               for e in data["grandfathered"])
+    # a second prune is a no-op (nothing stale left)
+    r2 = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", pkg,
+         "--baseline", str(bpath), "--prune-stale", "--quiet"],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert r2.returncode == 0 and "pruned 0" in r2.stdout
+
+
+def test_format_json_records_and_schema(tmp_path):
+    from ppls_tpu.utils.artifact_schema import validate_graftlint_json
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", pkg,
+         "--format", "json"],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr   # new violations
+    doc = json.loads(r.stdout)
+    assert validate_graftlint_json(doc) == []
+    # one record per violation, keys match the text-mode identities
+    text_keys = sorted(v.key for v in run_lint(pkg))
+    assert sorted(v["key"] for v in doc["violations"]) == text_keys
+    assert doc["ok"] is False and doc["deep"] is False
+    assert doc["counts"]["new"] == len(text_keys)
+    # grandfathering the lot flips ok without changing the record count
+    bpath = str(tmp_path / "b.json")
+    write_baseline(bpath, run_lint(pkg))
+    r2 = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", pkg,
+         "--baseline", bpath, "--format", "json"],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert r2.returncode == 0
+    doc2 = json.loads(r2.stdout)
+    assert validate_graftlint_json(doc2) == []
+    assert doc2["ok"] is True
+    assert all(v["grandfathered"] and "reason" in v
+               for v in doc2["violations"])
+
+
+def test_graftlint_json_validator_rejects_inconsistency():
+    from ppls_tpu.utils.artifact_schema import validate_graftlint_json
+    doc = {"schema": "graftlint-v1", "target": "pkg", "deep": False,
+           "violations": [
+               {"key": "GL02:pkg/a.py:f:float32", "code": "GL02",
+                "path": "pkg/a.py", "line": 3, "symbol": "f:float32",
+                "message": "m", "grandfathered": False}],
+           "stale": [], "counts": {"total": 1, "new": 1,
+                                   "grandfathered": 0, "stale": 0},
+           "ok": True}    # ok contradicts the 1 new record
+    problems = validate_graftlint_json(doc)
+    assert any("ok=True" in p for p in problems)
+    doc["ok"] = False
+    assert validate_graftlint_json(doc) == []
+    doc["counts"]["new"] = 2        # counts no longer reconcile
+    assert any("counts.new" in p
+               for p in validate_graftlint_json(doc))
+
+
+def test_stale_scoped_to_codes_checked():
+    # a grandfathered DEEP entry must not read as stale on a run that
+    # never executed the deep rules (and vice versa the deep run still
+    # sees it): tier-scoped staleness keeps the shrink-only contract
+    # honest across `--deep` and plain invocations
+    baseline = {"GL07:ppls_tpu/parallel/sharded_walker.py:dd_refill:"
+                "psum": "deep-tier entry"}
+    new, known, stale = split_new_and_known(
+        [], baseline, codes_checked=("GL01", "GL02"))
+    assert stale == []
+    new, known, stale = split_new_and_known(
+        [], baseline, codes_checked=("GL01", "GL07"))
+    assert len(stale) == 1
+
+
+def test_write_baseline_preserves_out_of_scope_tier_entries(tmp_path):
+    # review finding (round 17): an AST-only --write-baseline must
+    # carry the grandfathered DEEP entries (GL07-GL10) forward — their
+    # rules never ran, so regenerating from the AST-only violation
+    # list alone would silently delete reviewed exceptions and fail
+    # the next --deep run
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    bpath = tmp_path / "baseline.json"
+    deep_entry = {"key": "GL07:pkg/parallel/sw.py:dd:psum",
+                  "reason": "reviewed deep exception"}
+    bpath.write_text(json.dumps(
+        {"version": 1, "grandfathered": [deep_entry]}))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", pkg,
+         "--baseline", str(bpath), "--write-baseline"],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    data = json.loads(bpath.read_text())
+    keys = [e["key"] for e in data["grandfathered"]]
+    # the 3 AST violations regenerated AND the deep entry preserved
+    assert "GL07:pkg/parallel/sw.py:dd:psum" in keys
+    assert len(keys) == 4, keys
+    kept = [e for e in data["grandfathered"]
+            if e["key"] == deep_entry["key"]]
+    assert kept[0]["reason"] == "reviewed deep exception"
+
+
+def test_prune_stale_with_json_format_keeps_stdout_parseable(tmp_path):
+    # review finding (round 17): --prune-stale's notice must not
+    # corrupt the --format json ledger on stdout
+    from ppls_tpu.utils.artifact_schema import validate_graftlint_json
+    pkg = _mkpkg(tmp_path, {"parallel/num.py": GL02_BROKEN})
+    bpath = str(tmp_path / "b.json")
+    write_baseline(bpath, run_lint(pkg))
+    fixed = GL02_BROKEN.replace("x.astype(jnp.float32)", "x")
+    (tmp_path / "pkg/parallel/num.py").write_text(textwrap.dedent(fixed))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", pkg,
+         "--baseline", bpath, "--prune-stale", "--format", "json"],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)          # stdout is pure JSON
+    assert validate_graftlint_json(doc) == []
+    assert "pruned 1 stale" in r.stderr  # the notice moved to stderr
+    assert doc["counts"]["stale"] == 0   # pruned before emission

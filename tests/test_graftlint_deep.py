@@ -1,0 +1,336 @@
+"""tools/graftlint/deep.py: the jaxpr-level semantic tier (GL07-GL10).
+
+Two kinds of coverage, mirroring tests/test_graftlint.py's pattern:
+
+* BROKEN+FIXED toy targets per rule — tiny traced programs with an
+  injected uncounted psum (GL07), an undeclared f32→f64 origin (GL08),
+  a left-behind debug callback (GL09), and a value baked through a
+  closure cell (GL10, the `_tt_cell` hazard shape sharded_walker
+  documents) — each tripping its rule, each with a clean twin;
+* the REAL package: every committed engine probe traces, the dd
+  census reconciles with the declared crounds model in BOTH modes,
+  jaxpr hashes are value-stable for `_run_cycles` /
+  `run_stream_cycle` / `build_dd_walker_run`, and the whole deep tier
+  runs clean against the committed baseline (the same check ci.sh's
+  deep-lint step runs).
+
+The real-package traces are collected ONCE per module (the deep
+tier's trace-reuse contract) — this file adds ~10 s to tier-1, not
+~10 s per test.
+"""
+
+import functools
+import importlib.util
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tools.graftlint import deep
+from tools.graftlint.core import load_baseline, split_new_and_known
+from tools.graftlint.deep import (DEEP_CODES, GL07_CROUNDS_MODEL,
+                                  GL08_DTYPE_SURFACE, collect_traces,
+                                  rule_gl07, rule_gl08, rule_gl09,
+                                  rule_gl10, run_deep)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def real_traces():
+    """ONE trace pass over the committed engine probes, shared by
+    every real-package test below (the ci.sh deep step gets the same
+    reuse inside a single CLI invocation)."""
+    return collect_traces()
+
+
+# ---------------------------------------------------------------------------
+# GL07 — collective census vs the crounds model
+# ---------------------------------------------------------------------------
+
+def _toy_dd_probe(extra_psum: bool):
+    """A tiny shard_map program: one counted psum, plus an optionally
+    INJECTED second one (the uncounted-collective shape GL04 cannot
+    see once it hides inside the shard body)."""
+    from ppls_tpu.parallel.mesh import make_mesh, shard_map_compat
+    mesh = make_mesh(2)
+
+    def body(x):
+        s = x + lax.psum(x, "d")
+        if extra_psum:
+            s = s + lax.psum(2.0 * x, "d")   # injected, uncounted
+        return s
+
+    fn = jax.jit(shard_map_compat(body, mesh=mesh, in_specs=(P("d"),),
+                                  out_specs=P("d"), check_vma=False))
+
+    def ops(seed: int):
+        return (jnp.arange(8, dtype=jnp.float64) + seed,)
+
+    return ("toy.dd", fn, ops, "pkg/toy.py")
+
+
+TOY_MODEL = {"toy.dd": {"collectives": {"psum": 1},
+                        "reason": "one counted occupancy psum"}}
+
+
+def test_gl07_trips_on_injected_uncounted_psum():
+    traces = collect_traces([_toy_dd_probe(extra_psum=True)])
+    got = list(rule_gl07(traces, model=TOY_MODEL))
+    assert [v.symbol for v in got] == ["dd:psum"], got
+    assert "UNCOUNTED" in got[0].message
+    assert got[0].key == "GL07:pkg/toy.py:dd:psum"
+
+
+def test_gl07_clean_when_census_matches_model():
+    traces = collect_traces([_toy_dd_probe(extra_psum=False)])
+    assert list(rule_gl07(traces, model=TOY_MODEL)) == []
+
+
+def test_gl07_reports_stale_model_entries():
+    # the model declares MORE than the program pays: the entry must
+    # shrink (the census table follows the baseline's shrink-only
+    # contract, loudly)
+    traces = collect_traces([_toy_dd_probe(extra_psum=False)])
+    fat = {"toy.dd": {"collectives": {"psum": 3}, "reason": "stale"}}
+    got = list(rule_gl07(traces, model=fat))
+    assert [v.symbol for v in got] == ["dd:psum:stale-model"], got
+
+
+def test_gl07_single_chip_programs_must_census_empty():
+    # a target ABSENT from the model gets an implicit empty census: a
+    # collective in a single-chip engine program always flags
+    traces = collect_traces([_toy_dd_probe(extra_psum=False)])
+    got = list(rule_gl07(traces, model={}))
+    assert [v.symbol for v in got] == ["dd:psum"]
+
+
+def test_gl07_real_census_reconciles_both_dd_modes(real_traces):
+    """The acceptance pin: the traced dd programs' collective censuses
+    equal the declared crounds model EXACTLY, refill and legacy."""
+    by_name = {t.name: t for t in real_traces}
+    for name in ("sharded_walker.dd_refill", "sharded_walker.dd_legacy"):
+        tr = by_name[name]
+        assert tr.error is None, tr.error
+        got = deep._census(tr.jaxprs[0].jaxpr, deep.COLLECTIVE_PRIMS)
+        assert got == GL07_CROUNDS_MODEL[name]["collectives"], \
+            (name, got)
+    # and the single-chip programs pay no collectives at all
+    for name in ("walker._run_cycles", "stream.run_stream_cycle",
+                 "bag_engine._run_bag", "device_engine._run"):
+        tr = by_name[name]
+        assert deep._census(tr.jaxprs[0].jaxpr,
+                            deep.COLLECTIVE_PRIMS) == {}, name
+
+
+def test_gl07_model_entries_carry_reasons():
+    for name, entry in GL07_CROUNDS_MODEL.items():
+        assert isinstance(entry["reason"], str) \
+            and len(entry["reason"]) > 40, \
+            f"{name} lacks a substantive reconciliation reason"
+
+
+# ---------------------------------------------------------------------------
+# GL08 — f32→f64 dtype-flow audit
+# ---------------------------------------------------------------------------
+
+def _import_from_file(tmp_path, name: str, src: str):
+    p = tmp_path / f"{name}.py"
+    p.write_text(textwrap.dedent(src))
+    spec = importlib.util.spec_from_file_location(name, str(p))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gl08_trips_on_undeclared_f32_to_f64_origin(tmp_path):
+    # the convert must ORIGINATE in a real source file so the jaxpr's
+    # source_info points somewhere attributable — an undeclared module
+    # promoting f32 into the f64 path flags
+    mod = _import_from_file(tmp_path, "gl08_broken", """
+        import jax.numpy as jnp
+
+        def sneaky_promote(x):
+            return x.astype(jnp.float64) * 2.0
+    """)
+
+    def ops(seed: int):
+        return (jnp.ones(4, jnp.float32) + seed,)
+
+    traces = collect_traces([("toy.conv", mod.sneaky_promote, ops,
+                              "pkg/toy.py")])
+    got = list(rule_gl08(traces))
+    assert [v.symbol for v in got] == ["sneaky_promote:f32-to-f64"], got
+    assert "declared dtype surface" in got[0].message
+
+
+def test_gl08_declared_origin_is_clean(tmp_path):
+    mod = _import_from_file(tmp_path, "gl08_fixed", """
+        import jax.numpy as jnp
+
+        def limb_promote(x):
+            return x.astype(jnp.float64) * 2.0
+    """)
+
+    def ops(seed: int):
+        return (jnp.ones(4, jnp.float32) + seed,)
+
+    traces = collect_traces([("toy.conv", mod.limb_promote, ops,
+                              "pkg/toy.py")])
+    surface = dict(GL08_DTYPE_SURFACE)
+    surface["gl08_fixed.py"] = {
+        "symbols": ("limb_promote",),
+        "reason": "test: declared exact-limb promotion"}
+    assert list(rule_gl08(traces, surface=surface)) == []
+
+
+def test_gl08_real_package_origins_all_declared(real_traces):
+    # every f32→f64 edge in every traced engine program originates in
+    # the declared surface (ds limbs / pow2 / scout / the walker's
+    # reviewed limb functions) — zero baseline entries needed
+    assert list(rule_gl08(real_traces)) == []
+
+
+def test_gl08_surface_entries_carry_reasons():
+    for module, entry in GL08_DTYPE_SURFACE.items():
+        assert entry["symbols"], f"{module}: empty symbol list"
+        assert isinstance(entry["reason"], str) \
+            and len(entry["reason"]) > 30, \
+            f"{module} lacks a substantive reason"
+
+
+# ---------------------------------------------------------------------------
+# GL09 — host-interop census
+# ---------------------------------------------------------------------------
+
+def test_gl09_trips_on_left_behind_debug_callback():
+    def leaky(x):
+        jax.debug.print("x = {x}", x=x)     # fires per execution
+        return x * 2.0
+
+    def ops(seed: int):
+        return (jnp.arange(4, dtype=jnp.float64) + seed,)
+
+    traces = collect_traces([("toy.leak", leaky, ops, "pkg/toy.py")])
+    got = list(rule_gl09(traces))
+    assert [v.symbol for v in got] == ["leak:debug_callback"], got
+
+
+def test_gl09_clean_without_callbacks():
+    def clean(x):
+        return x * 2.0
+
+    def ops(seed: int):
+        return (jnp.arange(4, dtype=jnp.float64) + seed,)
+
+    traces = collect_traces([("toy.clean", clean, ops, "pkg/toy.py")])
+    assert list(rule_gl09(traces)) == []
+
+
+def test_gl09_real_engine_programs_are_interop_free(real_traces):
+    assert list(rule_gl09(real_traces)) == []
+
+
+# ---------------------------------------------------------------------------
+# GL10 — compile-once-by-construction
+# ---------------------------------------------------------------------------
+
+def test_gl10_trips_on_value_baked_through_closure():
+    # the `_tt_cell` hazard shape (sharded_walker binds its theta
+    # table as a per-CALL operand precisely to avoid this): a cell the
+    # operand builder mutates bakes a VALUE into the traced program —
+    # one recompile per distinct value in production
+    cell = {}
+
+    def baked(x):
+        return x * cell["v"]
+
+    def ops(seed: int):
+        cell["v"] = 1.0 + seed
+        return (jnp.arange(4, dtype=jnp.float64),)
+
+    traces = collect_traces([("toy.baked", baked, ops, "pkg/toy.py")])
+    got = list(rule_gl10(traces))
+    assert [v.symbol for v in got] == ["baked:jaxpr-hash"], got
+    assert "recompile" in got[0].message
+
+
+def test_gl10_trips_on_value_fed_static():
+    # the accidental-static shape proper: a per-request value declared
+    # static_argnames — the two traces bake different literals
+    @functools.partial(jax.jit, static_argnames=("v",))
+    def prog(x, *, v: float):
+        return x * v
+
+    def fn(x, seed_v):
+        del seed_v      # the harness passes the value OUT of band...
+        return prog(x, v=float(_gl10_static_cell["v"]))
+
+    _gl10_static_cell = {}
+
+    def ops(seed: int):
+        _gl10_static_cell["v"] = 1.0 + seed
+        return (jnp.arange(4, dtype=jnp.float64),
+                jnp.asarray(seed, jnp.int32))
+
+    traces = collect_traces([("toy.static", fn, ops, "pkg/toy.py")])
+    got = list(rule_gl10(traces))
+    assert [v.symbol for v in got] == ["static:jaxpr-hash"], got
+
+
+def test_gl10_clean_when_value_is_traced_operand():
+    def fixed(x, v):
+        return x * v
+
+    def ops(seed: int):
+        return (jnp.arange(4, dtype=jnp.float64),
+                jnp.asarray(1.0 + seed, jnp.float64))
+
+    traces = collect_traces([("toy.fixed", fixed, ops, "pkg/toy.py")])
+    assert list(rule_gl10(traces)) == []
+
+
+def test_gl10_reports_trace_failures():
+    def broken(x):
+        raise TypeError("unhashable static drifted in")
+
+    def ops(seed: int):
+        return (jnp.arange(4, dtype=jnp.float64),)
+
+    traces = collect_traces([("toy.broken", broken, ops,
+                              "pkg/toy.py")])
+    got = list(rule_gl10(traces))
+    assert [v.symbol for v in got] == ["broken:trace-error"], got
+    assert "unhashable" in got[0].message
+
+
+def test_gl10_real_engine_programs_value_stable(real_traces):
+    """The acceptance pin: `_run_cycles`, `run_stream_cycle`, and
+    `build_dd_walker_run` (both modes) — plus the bag and wavefront
+    programs — trace to IDENTICAL jaxprs across differing operand
+    values. No accidental statics anywhere in the engine surface."""
+    names = {t.name for t in real_traces}
+    for required in ("walker._run_cycles", "stream.run_stream_cycle",
+                     "sharded_walker.dd_refill",
+                     "sharded_walker.dd_legacy",
+                     "bag_engine._run_bag", "device_engine._run"):
+        assert required in names, f"probe {required} missing"
+    assert list(rule_gl10(real_traces)) == []
+
+
+# ---------------------------------------------------------------------------
+# the whole tier vs the committed baseline (ci.sh's deep-lint check)
+# ---------------------------------------------------------------------------
+
+def test_deep_tier_real_package_clean(real_traces):
+    violations = run_deep(traces=real_traces)
+    baseline = load_baseline(
+        os.path.join(REPO, "tools", "graftlint_baseline.json"))
+    new, _known, stale = split_new_and_known(violations, baseline,
+                                             codes_checked=DEEP_CODES)
+    assert new == [], "\n".join(v.render() for v in new)
+    assert stale == [], stale

@@ -21,6 +21,11 @@ Usage:
         # retire/shed/rejection/summary record, with the rid-deduped
         # accounting invariants (completed/shed/failed counts match
         # the summary, no rid both retired and shed)
+    python tools/check_artifacts.py --graftlint LINT.json [...]
+        # round 17: validate a `python -m tools.graftlint --format
+        # json` ledger (one record per violation, counts reconciled,
+        # grandfathered records carry reasons) — the machine-readable
+        # lint output ci.sh's deep-lint step emits for annotations
 """
 
 from __future__ import annotations
@@ -35,6 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from ppls_tpu.utils.artifact_schema import (  # noqa: E402
     validate_artifact_text,
     validate_events_text,
+    validate_graftlint_text,
     validate_serve_output_text,
 )
 
@@ -63,6 +69,15 @@ def main(argv) -> int:
             return 2
         serve_paths.append(args[i + 1])
         del args[i:i + 2]
+    lint_paths = []
+    while "--graftlint" in args:
+        i = args.index("--graftlint")
+        if i + 1 >= len(args):
+            print("check_artifacts: --graftlint requires a FILE",
+                  file=sys.stderr)
+            return 2
+        lint_paths.append(args[i + 1])
+        del args[i:i + 2]
     paths = args
     problems = []
     for p in event_paths:
@@ -77,7 +92,12 @@ def main(argv) -> int:
         with open(p) as fh:
             problems += validate_serve_output_text(
                 fh.read(), where=os.path.basename(p))
-    event_paths = event_paths + serve_paths
+    # round 17: graftlint --format json ledgers (deep-lint CI step)
+    for p in lint_paths:
+        with open(p) as fh:
+            problems += validate_graftlint_text(
+                fh.read(), where=os.path.basename(p))
+    event_paths = event_paths + serve_paths + lint_paths
     if event_paths and not paths:
         for msg in problems:
             print(f"check_artifacts: {msg}", file=sys.stderr)

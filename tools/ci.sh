@@ -13,7 +13,18 @@
 #       integrity/recovery machinery re-proves itself suite-wide
 #   2. `pip install -e .` smoke + `ppls-tpu --help` console script
 #   3. artifact schema check (BENCH_r*/MULTICHIP_r* round JSONs)
-#   4. graftlint static analysis (GL01-GL06 vs the committed baseline)
+#   4. graftlint static analysis (AST tier GL01-GL06 + GL11 vs the
+#      committed baseline)
+#   4b. graftlint DEEP tier (round 17): `--deep` traces the real
+#       jitted engine programs (walker cycle, stream phase, both dd
+#       modes, bag, wavefront) on CPU — interpret mode, virtual
+#       8-mesh for dd — and walks the captured jaxprs: GL07
+#       collective census vs the crounds model, GL08 f32->f64
+#       origin audit, GL09 host-interop census, GL10 jaxpr-hash
+#       stability across operand values. One trace pass serves all
+#       four rules (wall budget enforced below); the machine-readable
+#       --format json ledger is schema-gated by check_artifacts
+#       --graftlint
 #   5. serve telemetry smoke: a short seeded synthetic Poisson load
 #      through `ppls-tpu serve --events`, then the event-log schema
 #      check (the round-10 timeline artifact must stay valid end-to-end)
@@ -135,12 +146,12 @@ else
     FAILURES=$((FAILURES + 1))
 fi
 
-# --- 4. graftlint: project-specific static analysis ---
+# --- 4. graftlint: project-specific static analysis (AST tier) ---
 # New violations fail; grandfathered ones are enumerated in the
 # committed baseline (tools/graftlint_baseline.json). See BASELINE.md
 # "Static analysis & strict modes" for the rule set and the allowlist
 # workflow.
-step "graftlint static analysis (GL01-GL06)"
+step "graftlint static analysis (GL01-GL06 + GL11)"
 if python -m tools.graftlint ppls_tpu \
         --baseline tools/graftlint_baseline.json --quiet; then
     echo "ci: graftlint OK"
@@ -148,6 +159,30 @@ else
     echo "ci: graftlint FAILED (new violations vs the baseline)"
     FAILURES=$((FAILURES + 1))
 fi
+
+# --- 4b. graftlint deep tier: traced-jaxpr semantic analysis ---
+# The --deep run re-traces the real engine programs, so it carries a
+# WALL BUDGET (240 s, ~15x the measured ~16 s: a runaway trace means a
+# probe regressed into executing instead of tracing — that must fail
+# CI, not wedge it). The JSON ledger is the machine-readable artifact
+# (one record per violation) and is schema-gated like every other
+# artifact document in this repo.
+step "graftlint deep tier (GL07-GL10, traced jaxprs)"
+GL_JSON="$(mktemp /tmp/ppls_ci_graftlint.XXXXXX.json)"
+deep_t0=$SECONDS
+if timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m tools.graftlint ppls_tpu --deep \
+        --baseline tools/graftlint_baseline.json \
+        --format json > "$GL_JSON" \
+        && python tools/check_artifacts.py --graftlint "$GL_JSON"; then
+    echo "ci: graftlint deep OK ($((SECONDS - deep_t0))s of 240s budget)"
+else
+    echo "ci: graftlint deep tier FAILED (new semantic violations, "\
+"schema-invalid ledger, or wall budget exceeded)"
+    FAILURES=$((FAILURES + 1))
+fi
+rm -f "$GL_JSON"
 
 # --- 5. serve telemetry smoke: seeded synthetic load + event log ---
 # A short `ppls-tpu serve` run on the deterministic Poisson schedule

@@ -19,13 +19,27 @@ sharded, streaming JAX are all *statically visible*:
   functions reachable from a jitted root: the side effect fires at
   trace time (phantom samples) and its inputs force a host sync —
   publishes belong in the boundary hooks that already hold the
-  fetched values.
+  fetched values;
+* GL11 — lock discipline (round 17): reads/writes of declared
+  cross-thread attributes (the serving runtime's shared engine
+  handle) outside the owning ``with``-lock block — regression armor
+  for the two PR-10 ingest races.
+
+Round 17 adds the SEMANTIC tier (``--deep``, ``deep.py``): GL07-GL10
+trace the real jitted engine programs on CPU (tracing executes
+nothing) and walk the captured jaxprs — collective census vs the
+crounds model, f32→f64 origin audit, host-interop census, and
+jaxpr-hash compile-once stability. The AST rules live one module per
+concern under ``rules/``.
 
 Violations are keyed ``CODE:path:symbol`` (no line numbers, so edits
 elsewhere in a file don't churn the baseline) and grandfathered sites
 live in a committed allowlist (``tools/graftlint_baseline.json``) with
 a reason per entry.  ``python -m tools.graftlint ppls_tpu --baseline
-tools/graftlint_baseline.json`` fails only on NEW violations.
+tools/graftlint_baseline.json`` fails only on NEW violations;
+``--prune-stale`` shrinks the allowlist, ``--format json`` emits the
+machine-readable ledger CI gates through ``check_artifacts
+--graftlint``.
 """
 
 from tools.graftlint.core import (  # noqa: F401

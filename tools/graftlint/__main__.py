@@ -1,30 +1,58 @@
-"""CLI: ``python -m tools.graftlint ppls_tpu [--baseline FILE]``.
+"""CLI: ``python -m tools.graftlint ppls_tpu [--baseline FILE]
+[--deep] [--format json] [--prune-stale]``.
 
 Exit status 1 iff there are NEW violations (not in the baseline).
 Grandfathered violations are enumerated (they are debt, not noise);
 stale baseline entries (fixed sites still allowlisted) are reported so
-the baseline shrinks over time instead of fossilizing.
+the baseline shrinks over time instead of fossilizing —
+``--prune-stale`` performs that shrink in one command.
+
+``--deep`` adds the semantic tier (GL07-GL10, ``deep.py``): the real
+jitted engine programs are traced on CPU (interpret mode, virtual
+8-mesh for dd) and their jaxprs walked. Staleness is scoped to the
+tiers that ran: a grandfathered deep entry is not reported stale by an
+AST-only run.
+
+``--format json`` emits one machine-readable record per violation
+(schema-gated by ``tools/check_artifacts.py --graftlint``) so CI can
+turn findings into annotations instead of grepping text.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
-from tools.graftlint.core import (load_baseline, run_lint,
-                                  split_new_and_known, write_baseline)
+from tools.graftlint.core import (load_baseline, prune_stale_entries,
+                                  run_lint, split_new_and_known,
+                                  violations_to_json, write_baseline)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="project-specific static analysis (GL01-GL06)")
+        description="project-specific static analysis (GL01-GL06 + "
+                    "GL11; --deep adds the traced-jaxpr tier "
+                    "GL07-GL10)")
     ap.add_argument("target",
                     help="package directory to lint (single files are "
                          "rejected: the rules are cross-module)")
     ap.add_argument("--baseline", default=None,
                     help="committed allowlist JSON; only violations "
                          "absent from it fail the run")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the semantic tier (GL07-GL10): "
+                         "trace the real jitted engine programs and "
+                         "walk the captured jaxprs (ppls_tpu only)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text", dest="fmt",
+                    help="json = one machine-readable record per "
+                         "violation on stdout (exit codes unchanged)")
+    ap.add_argument("--prune-stale", action="store_true",
+                    help="rewrite --baseline dropping entries whose "
+                         "sites are fixed (shrink-only; preserves "
+                         "_comment blocks and surviving reasons)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="regenerate the baseline from the current "
                          "violations (preserves existing reasons)")
@@ -37,17 +65,54 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"graftlint: error: {e}", file=sys.stderr)
         return 2
+    from tools.graftlint.rules import AST_CODES
+    codes_checked = list(AST_CODES)
+    if args.deep:
+        import os
+        if os.path.basename(os.path.normpath(args.target)) \
+                != "ppls_tpu":
+            print("graftlint: error: --deep traces the committed "
+                  "engine programs and only applies to the ppls_tpu "
+                  "package", file=sys.stderr)
+            return 2
+        from tools.graftlint.deep import DEEP_CODES, run_deep
+        violations = sorted(
+            violations + run_deep(),
+            key=lambda v: (v.path, v.line, v.code, v.symbol))
+        codes_checked += list(DEEP_CODES)
     baseline = load_baseline(args.baseline)
 
     if args.write_baseline:
         if not args.baseline:
             ap.error("--write-baseline requires --baseline")
-        write_baseline(args.baseline, violations, reasons=baseline)
+        # codes_checked: an AST-only regeneration must carry the
+        # grandfathered deep-tier entries forward, not delete them
+        write_baseline(args.baseline, violations, reasons=baseline,
+                       codes_checked=codes_checked)
         print(f"graftlint: wrote {len({v.key for v in violations})} "
               f"grandfathered entries to {args.baseline}")
         return 0
 
-    new, known, stale = split_new_and_known(violations, baseline)
+    new, known, stale = split_new_and_known(violations, baseline,
+                                            codes_checked)
+    if args.prune_stale:
+        if not args.baseline:
+            ap.error("--prune-stale requires --baseline")
+        dropped = prune_stale_entries(args.baseline, stale)
+        # the notice goes to stderr under --format json: stdout is
+        # the machine-readable ledger and must stay parseable
+        print(f"graftlint: pruned {dropped} stale baseline entr"
+              f"{'y' if dropped == 1 else 'ies'} from "
+              f"{args.baseline}",
+              file=sys.stderr if args.fmt == "json" else sys.stdout)
+        stale = []
+
+    if args.fmt == "json":
+        print(json.dumps(violations_to_json(
+            args.target, new, known, stale, baseline,
+            deep=args.deep), indent=1))
+        return 1 if new else 0
+
     if known and not args.quiet:
         print(f"graftlint: {len(known)} grandfathered violation(s) "
               f"(allowlisted in {args.baseline}):")
@@ -58,7 +123,7 @@ def main(argv=None) -> int:
     if stale:
         print(f"graftlint: {len(stale)} stale baseline entr"
               f"{'y' if len(stale) == 1 else 'ies'} (site fixed — "
-              f"remove from the allowlist):")
+              f"remove from the allowlist, or run --prune-stale):")
         for k in stale:
             print(f"  {k}")
     if new:
@@ -70,7 +135,8 @@ def main(argv=None) -> int:
               "baseline with a reason)")
         return 1
     print(f"graftlint: OK ({len(violations)} total, "
-          f"{len(known)} grandfathered, 0 new)")
+          f"{len(known)} grandfathered, 0 new"
+          f"{', deep tier clean' if args.deep else ''})")
     return 0
 
 

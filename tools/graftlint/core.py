@@ -12,7 +12,7 @@ import ast
 import dataclasses
 import json
 import os
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple  # noqa: F401
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,17 +176,31 @@ def load_baseline(path: Optional[str]) -> Dict[str, str]:
 
 
 def write_baseline(path: str, violations: Iterable[Violation],
-                   reasons: Optional[Dict[str, str]] = None) -> None:
+                   reasons: Optional[Dict[str, str]] = None,
+                   codes_checked: Optional[Iterable[str]] = None
+                   ) -> None:
+    """Regenerate the baseline from the current violations.
+
+    ``codes_checked`` scopes the regeneration to the rules that ran,
+    mirroring :func:`split_new_and_known`'s staleness scoping: an
+    AST-only ``--write-baseline`` must carry the committed file's
+    grandfathered DEEP entries (GL07-GL10) forward verbatim — their
+    rules never looked this run, so regenerating from the AST-only
+    violation list alone would silently delete reviewed exceptions
+    and fail the next ``--deep`` run. None = regenerate everything
+    (the historical behavior)."""
     reasons = reasons or {}
     # regeneration must not destroy the committed file's documentation
     # (_comment block) or any other top-level keys
     doc: Dict[str, object] = {"version": 1}
+    old_entries: List[Dict] = []
     if os.path.exists(path):
         try:
             with open(path, encoding="utf-8") as fh:
                 old = json.load(fh)
             doc.update({k: v for k, v in old.items()
                         if k != "grandfathered"})
+            old_entries = list(old.get("grandfathered", []))
         except (OSError, ValueError):
             pass
     entries = []
@@ -198,6 +212,11 @@ def write_baseline(path: str, violations: Iterable[Violation],
         entries.append({"key": v.key,
                         "reason": reasons.get(v.key, ""),
                         "message": v.message})
+    if codes_checked is not None:
+        checked = set(codes_checked)
+        entries += [e for e in old_entries
+                    if e.get("key", "").split(":", 1)[0] not in checked
+                    and e.get("key") not in seen]
     doc["grandfathered"] = entries
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, indent=1)
@@ -205,12 +224,75 @@ def write_baseline(path: str, violations: Iterable[Violation],
 
 
 def split_new_and_known(violations: List[Violation],
-                        baseline: Dict[str, str]
+                        baseline: Dict[str, str],
+                        codes_checked: Optional[Iterable[str]] = None
                         ) -> Tuple[List[Violation], List[Violation],
                                    List[str]]:
-    """-> (new, grandfathered, stale_baseline_keys)."""
+    """-> (new, grandfathered, stale_baseline_keys).
+
+    ``codes_checked`` scopes STALENESS to the rules that actually ran:
+    with the deep tier off, a grandfathered GL07-GL10 entry is not
+    "stale" (its rule never looked), so an AST-only run must neither
+    fail on it nor invite its removal. None = every baseline key is in
+    scope (the historical behavior)."""
     keys = {v.key for v in violations}
     new = [v for v in violations if v.key not in baseline]
     known = [v for v in violations if v.key in baseline]
-    stale = sorted(k for k in baseline if k not in keys)
+    if codes_checked is None:
+        in_scope = baseline.keys()
+    else:
+        checked = set(codes_checked)
+        in_scope = [k for k in baseline
+                    if k.split(":", 1)[0] in checked]
+    stale = sorted(k for k in in_scope if k not in keys)
     return new, known, stale
+
+
+def prune_stale_entries(path: str, stale: Iterable[str]) -> int:
+    """--prune-stale: rewrite the committed baseline DROPPING the given
+    stale keys — the shrink-only contract as one command instead of a
+    hand edit. Preserves every other top-level key (the ``_comment``
+    policy block included) and the surviving entries verbatim (their
+    reasons and any per-entry ``_comment`` fields). Returns the number
+    of entries dropped; never adds anything."""
+    stale = set(stale)
+    if not stale:
+        return 0
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    before = doc.get("grandfathered", [])
+    doc["grandfathered"] = [e for e in before
+                            if e.get("key") not in stale]
+    dropped = len(before) - len(doc["grandfathered"])
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    return dropped
+
+
+def violations_to_json(target: str, new: List[Violation],
+                       known: List[Violation], stale: List[str],
+                       baseline: Dict[str, str], deep: bool) -> Dict:
+    """The ``--format json`` document: one record per violation,
+    machine-readable for CI annotations (schema:
+    ``ppls_tpu.utils.artifact_schema.validate_graftlint_json``, gated
+    by ``tools/check_artifacts.py --graftlint``)."""
+    def rec(v: Violation, grandfathered: bool) -> Dict:
+        d = {"key": v.key, "code": v.code, "path": v.path,
+             "line": v.line, "symbol": v.symbol, "message": v.message,
+             "grandfathered": grandfathered}
+        if grandfathered:
+            d["reason"] = baseline.get(v.key, "")
+        return d
+
+    return {
+        "schema": "graftlint-v1",
+        "target": target,
+        "deep": bool(deep),
+        "violations": ([rec(v, False) for v in new]
+                       + [rec(v, True) for v in known]),
+        "stale": list(stale),
+        "counts": {"total": len(new) + len(known), "new": len(new),
+                   "grandfathered": len(known), "stale": len(stale)},
+        "ok": not new,
+    }

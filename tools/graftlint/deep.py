@@ -1,0 +1,461 @@
+"""graftlint --deep: jaxpr-level semantic analysis of the real engines.
+
+The AST tier (GL01-GL06, GL11) polices what the SOURCE spells; the
+invariants that actually break this codebase live in the *traced
+programs* — a collective hidden behind a ``shard_map`` body builder or
+a ``lax.cond`` branch is invisible to GL04, an f32 leak shows up as a
+``convert_element_type`` edge no regex can see, and an accidental
+static only exists after tracing. This tier traces the real jitted
+engine programs — ``walker._run_cycles``, ``run_stream_cycle``,
+``build_dd_walker_run`` in both dd modes, plus the bag and
+XLA-boundary wavefront engines — on the CPU interpret path (virtual
+8-mesh for dd; tracing never executes anything) and walks the
+captured jaxprs:
+
+* **GL07 — collective census.** Every ``psum``/``all_gather``/...
+  primitive tracing captured must reconcile with the declared crounds
+  accounting model (``GL07_CROUNDS_MODEL``): the semantic twin of
+  GL04. Excess = an uncounted collective (the device-counted
+  collective-round claims are silently false); deficit = a stale
+  model entry (update it — the model shrinks like the baseline).
+  Single-chip programs must census EMPTY unconditionally.
+* **GL08 — dtype-flow audit.** Every f32→f64
+  ``convert_element_type`` edge feeding the f64 credit path must
+  originate inside the DECLARED dtype surface
+  (``GL08_DTYPE_SURFACE``: the ds-limb modules, the scout surface of
+  ``GL02_SCOUT_SURFACE``, and the walker's reviewed limb-state
+  functions — the same sites GL02's allowlist documents). An
+  undeclared origin is a single-precision value silently promoted
+  into the Neumaier accumulators.
+* **GL09 — host-interop census.** ``pure_callback`` / ``io_callback``
+  / ``debug_callback`` / ``device_put`` primitives in any traced
+  engine program are violations, period: GL03's BFS sees only source
+  reachability — this sees what tracing actually captured inside the
+  program.
+* **GL10 — compile-once-by-construction.** Each program is traced
+  TWICE with different non-static operand *values* (same
+  shapes/dtypes) and the jaxpr hashes must be equal. A value
+  accidentally consumed as a static (or baked through a closure)
+  shows up as a differing literal — caught here, before it shows up
+  as ``ppls_recompiles_total`` in production. The dynamic twin of the
+  ``compile_once_guard`` fixture, with zero execution.
+
+Trace REUSE: :func:`collect_traces` traces each program once per seed
+and GL07/GL08/GL09 share seed 0's jaxpr while GL10 compares both — one
+trace pass serves all four rules, which is what keeps the ci.sh
+deep-lint step inside its wall budget. Violations share the AST tier's
+line-free ``CODE:path:symbol`` keys and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import sys
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.graftlint.core import Violation
+
+# seeds for the two value-varied traces (GL10); census rules read the
+# first trace only
+TRACE_SEEDS = (0, 1)
+
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "all_gather", "ppermute", "pmax", "pmin", "pmean",
+    "psum_scatter", "reduce_scatter", "all_to_all", "axis_index",
+})
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "device_put",
+})
+
+# ---------------------------------------------------------------------------
+# declared models (reviewed, like GL02_SCOUT_SURFACE — not baselines)
+# ---------------------------------------------------------------------------
+
+# GL07: the collective census each dd program is ALLOWED to trace to,
+# with the reconciliation story against the device-counted ``crounds``
+# model. Counts are exact for the committed probe configurations on
+# this container's jax; a new collective (count above the model) fails
+# the deep lint, a removed one reports the model entry stale so this
+# table shrinks with the code. Targets absent from this table must
+# census EMPTY (the single-chip engines pay no collectives at all).
+GL07_CROUNDS_MODEL: Dict[str, Dict[str, object]] = {
+    "sharded_walker.dd_refill": {
+        "collectives": {"psum": 9, "all_gather": 11, "axis_index": 2},
+        "reason": (
+            "refill-mode reconciliation: the 5 collective-breed-branch "
+            "collectives (loop-guard psum, prev-count psum, and the "
+            "re-shard's size psum + 5 all_gathers) are counted by "
+            "crounds += out.iters per breed round; the phase "
+            "reshard's 6 stratified-deal all_gathers + deal psum are "
+            "counted by crounds += did per taken reshard; the "
+            "remaining psums are REPLICATED PREDICATES (cycle-loop "
+            "guard, breed-dispatch occupancy, local-breed/balance/"
+            "final overflow) — scalar lockstep decisions, not data "
+            "rounds, deliberately outside the crounds claim. "
+            "axis_index is deal-index math, no communication."),
+    },
+    "sharded_walker.dd_legacy": {
+        "collectives": {"psum": 5, "all_gather": 5, "axis_index": 1},
+        "reason": (
+            "legacy-mode reconciliation: the collective breed chain's "
+            "per-round re-shard (size psum + 5 all_gathers, loop-"
+            "guard + prev-count psums) is counted by crounds += "
+            "out.iters; the cycle-loop guard and final overflow "
+            "psums are replicated predicates. No phase reshard in "
+            "this mode — its crounds arm is refill-only."),
+    },
+}
+
+# GL08: the declared f32→f64 origin surface. Everything here is a
+# reviewed, deliberate promotion of exact f32 LIMBS into f64 (the ds
+# double-single representation reassembling, the pow2 exact scale, the
+# scout surface's confirm hand-off) — the same sites GL02's allowlist
+# and scout-surface declaration document at the AST level. An f32→f64
+# convert originating anywhere else is a single-precision value
+# flowing into the f64 credit path. Symbols "*" covers the module.
+GL08_DTYPE_SURFACE: Dict[str, Dict[str, object]] = {
+    "ops/ds.py": {
+        "symbols": ("*",),
+        "reason": "the fenced XLA ds module: (hi, lo) f32 limb pairs "
+                  "reassemble to f64 exactly — the representation, "
+                  "not a downcast recovery."},
+    "ops/ds_kernel.py": {
+        "symbols": ("*",),
+        "reason": "in-kernel ds arithmetic: limb-pair promotion to "
+                  "f64 at credit time is the error-free transform "
+                  "the kernel is built on."},
+    "ops/pow2.py": {
+        "symbols": ("*",),
+        "reason": "exact power-of-two scale: the f32 exponent-field "
+                  "trick promotes an EXACT small value."},
+    "ops/scout_kernel.py": {
+        "symbols": ("*",),
+        "reason": "the declared GL02 scout surface: scout f32 values "
+                  "never credit directly (the confirm pass re-takes "
+                  "in full ds), so any promotion here is test-chain "
+                  "bookkeeping, reviewed with the surface itself."},
+    "parallel/walker.py": {
+        "symbols": ("to_ds", "to_ds3", "do_swap", "_run_walk",
+                    "_run_walk_kernel_refill", "_bank_and_refill",
+                    "_expand_pending"),
+        "reason": "the walker's lane-state limb columns: ds (two-f32-"
+                  "limb) state folding back into f64 bag/credit "
+                  "columns — each of these functions carries a "
+                  "GL02 allowlist entry (or sits inside one's "
+                  "subtree) documenting the deliberate f32."},
+}
+
+
+@dataclasses.dataclass
+class DeepTrace:
+    """One engine program's captured traces (shared across GL07-GL10)."""
+
+    name: str                 # probe name, e.g. "sharded_walker.dd_refill"
+    path: str                 # repo-relative module path (violation anchor)
+    jaxprs: Tuple             # one ClosedJaxpr per TRACE_SEEDS entry
+    error: Optional[str] = None   # trace failure (reported by GL10)
+
+    @property
+    def short(self) -> str:
+        return self.name.split(".", 1)[1] if "." in self.name \
+            else self.name
+
+
+def _ensure_jax_env(n_devices: int = 8):
+    """Import jax with the deep tier's environment: CPU platform, x64,
+    and a virtual multi-device host for the dd mesh — set BEFORE the
+    first jax import when this process owns it (the CLI path), left
+    alone when the embedding process (pytest's conftest) already
+    configured an equivalent environment."""
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{n_devices}").strip()
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    return jax
+
+
+def default_probes():
+    """The committed trace-target registry: every engine module owns a
+    ``deep_trace_probes()`` next to its sizing logic (the probes build
+    the REAL jitted programs over tiny operands). Returns
+    ``[(name, fn, build_operands, module_path), ...]``."""
+    _ensure_jax_env()
+    from ppls_tpu.parallel import (bag_engine, device_engine,
+                                   sharded_walker, walker)
+    from ppls_tpu.runtime import stream
+    paths = {
+        bag_engine: "ppls_tpu/parallel/bag_engine.py",
+        device_engine: "ppls_tpu/parallel/device_engine.py",
+        walker: "ppls_tpu/parallel/walker.py",
+        stream: "ppls_tpu/runtime/stream.py",
+        sharded_walker: "ppls_tpu/parallel/sharded_walker.py",
+    }
+    out = []
+    for mod, path in paths.items():
+        for name, fn, ops in mod.deep_trace_probes():
+            out.append((name, fn, ops, path))
+    return out
+
+
+def collect_traces(probes=None) -> List[DeepTrace]:
+    """ONE trace pass per (program, seed), shared by all deep rules.
+
+    A probe that fails to trace is not a crash: it comes back as a
+    DeepTrace with ``error`` set, which GL10 reports as a violation
+    (an engine program that cannot be traced with value-varied
+    operands has almost certainly grown an unhashable/static-operand
+    mismatch — exactly the drift this tier exists to catch)."""
+    jax = _ensure_jax_env()
+    if probes is None:
+        probes = default_probes()
+    out = []
+    for name, fn, ops, path in probes:
+        try:
+            jaxprs = []
+            for seed in TRACE_SEEDS:
+                # the trace path caches on (function identity, avals):
+                # without a cache clear the second seed would be handed
+                # the FIRST trace back and a closure-baked value (the
+                # exact GL10 failure mode) would be invisible
+                jax.clear_caches()
+                jaxprs.append(jax.make_jaxpr(fn)(*ops(seed)))
+            jaxprs = tuple(jaxprs)
+            out.append(DeepTrace(name=name, path=path, jaxprs=jaxprs))
+        except Exception as e:     # noqa: BLE001 — reported, not raised
+            out.append(DeepTrace(name=name, path=path, jaxprs=(),
+                                 error=f"{type(e).__name__}: {e}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(v) -> Iterator:
+    import jax.core as jc
+    vals = v if isinstance(v, (list, tuple)) else [v]
+    for x in vals:
+        if isinstance(x, jc.ClosedJaxpr):
+            yield x.jaxpr
+        elif isinstance(x, jc.Jaxpr):
+            yield x
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Every eqn of ``jaxpr`` and (recursively) of every sub-jaxpr in
+    its eqn params — pjit bodies, while cond/body, cond branches,
+    shard_map bodies, pallas kernels: the whole captured program."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def _eqn_origin(eqn) -> Tuple[str, str, int]:
+    """(repo-relative-ish file, function, line) of the user frame that
+    emitted ``eqn``; ("?", "?", 0) when source info is unavailable."""
+    try:
+        from jax._src import source_info_util as siu
+        fr = siu.user_frame(eqn.source_info)
+        if fr is None:
+            return "?", "?", 0
+        fname = fr.file_name.replace(os.sep, "/")
+        i = fname.rfind("/ppls_tpu/")
+        rel = fname[i + 1:] if i >= 0 else os.path.basename(fname)
+        return rel, fr.function_name, int(fr.start_line
+                                          if hasattr(fr, "start_line")
+                                          else getattr(fr, "line_num",
+                                                       0))
+    except Exception:   # noqa: BLE001 — origin is best-effort display
+        return "?", "?", 0
+
+
+def _census(jaxpr, prims) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        p = eqn.primitive.name
+        if p in prims:
+            out[p] = out.get(p, 0) + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL07 — collective census vs the crounds model
+# ---------------------------------------------------------------------------
+
+def rule_gl07(traces: List[DeepTrace],
+              model: Optional[Dict] = None) -> Iterator[Violation]:
+    model = GL07_CROUNDS_MODEL if model is None else model
+    for tr in traces:
+        if tr.error:
+            continue
+        expect = dict(model.get(tr.name, {}).get("collectives", {}))
+        got = _census(tr.jaxprs[0].jaxpr, COLLECTIVE_PRIMS)
+        for prim in sorted(set(expect) | set(got)):
+            g, e = got.get(prim, 0), expect.get(prim, 0)
+            if g > e:
+                yield Violation(
+                    code="GL07", path=tr.path, line=1,
+                    symbol=f"{tr.short}:{prim}",
+                    message=(
+                        f"traced program {tr.name} contains {g} "
+                        f"{prim!r} primitive(s), the crounds model "
+                        f"declares {e}: an UNCOUNTED collective "
+                        f"reached the compiled program (GL04 cannot "
+                        f"see through shard_map/cond bodies — this "
+                        f"census can). Count it at a crounds "
+                        f"boundary and update GL07_CROUNDS_MODEL "
+                        f"with the reconciliation, or remove it."))
+            elif g < e:
+                yield Violation(
+                    code="GL07", path=tr.path, line=1,
+                    symbol=f"{tr.short}:{prim}:stale-model",
+                    message=(
+                        f"crounds model declares {e} {prim!r} "
+                        f"primitive(s) for {tr.name} but the traced "
+                        f"program contains {g}: the model entry is "
+                        f"STALE — shrink it to match the program "
+                        f"(the census table only shrinks, like the "
+                        f"baseline)."))
+
+
+# ---------------------------------------------------------------------------
+# GL08 — f32→f64 dtype-flow audit
+# ---------------------------------------------------------------------------
+
+def _surface_covers(surface: Dict, origin_file: str,
+                    origin_fn: str) -> bool:
+    for suffix, entry in surface.items():
+        if origin_file.endswith(suffix):
+            syms = entry["symbols"]
+            if "*" in syms or origin_fn in syms:
+                return True
+    return False
+
+
+def rule_gl08(traces: List[DeepTrace],
+              surface: Optional[Dict] = None) -> Iterator[Violation]:
+    surface = GL08_DTYPE_SURFACE if surface is None else surface
+    seen = set()
+    for tr in traces:
+        if tr.error:
+            continue
+        for eqn in iter_eqns(tr.jaxprs[0].jaxpr):
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            try:
+                src = str(eqn.invars[0].aval.dtype)
+            except Exception:   # noqa: BLE001 — literal invars
+                continue
+            dst = str(eqn.params.get("new_dtype"))
+            if src != "float32" or dst != "float64":
+                continue
+            ofile, ofn, oline = _eqn_origin(eqn)
+            if _surface_covers(surface, ofile, ofn):
+                continue
+            key = (ofile, ofn)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Violation(
+                code="GL08", path=ofile if ofile != "?" else tr.path,
+                line=oline or 1,
+                symbol=f"{ofn}:f32-to-f64",
+                message=(
+                    f"f32→f64 convert_element_type originating in "
+                    f"{ofn} ({ofile}) reached the traced program "
+                    f"{tr.name}: a single-precision value is being "
+                    f"promoted into the f64 credit path outside the "
+                    f"declared dtype surface (ds limbs / scout "
+                    f"surface). Route it through the ds "
+                    f"representation, or declare the origin in "
+                    f"GL08_DTYPE_SURFACE with a reviewed reason."))
+
+
+# ---------------------------------------------------------------------------
+# GL09 — host-interop census
+# ---------------------------------------------------------------------------
+
+def rule_gl09(traces: List[DeepTrace]) -> Iterator[Violation]:
+    for tr in traces:
+        if tr.error:
+            continue
+        got = _census(tr.jaxprs[0].jaxpr, CALLBACK_PRIMS)
+        for prim, n in sorted(got.items()):
+            yield Violation(
+                code="GL09", path=tr.path, line=1,
+                symbol=f"{tr.short}:{prim}",
+                message=(
+                    f"traced program {tr.name} contains {n} {prim!r} "
+                    f"primitive(s): host interop inside an engine "
+                    f"program stalls every cycle on a device→host "
+                    f"round-trip (and a debug callback left behind "
+                    f"fires per execution forever). GL03's source "
+                    f"BFS cannot see wrapped callbacks — tracing "
+                    f"can. Remove it, or move the interop to the "
+                    f"host boundary."))
+
+
+# ---------------------------------------------------------------------------
+# GL10 — compile-once-by-construction (jaxpr-hash stability)
+# ---------------------------------------------------------------------------
+
+def _jaxpr_hash(closed) -> str:
+    return hashlib.sha256(str(closed).encode()).hexdigest()[:16]
+
+
+def rule_gl10(traces: List[DeepTrace]) -> Iterator[Violation]:
+    for tr in traces:
+        if tr.error:
+            yield Violation(
+                code="GL10", path=tr.path, line=1,
+                symbol=f"{tr.short}:trace-error",
+                message=(
+                    f"engine program {tr.name} failed to trace with "
+                    f"value-varied operands: {tr.error} — an "
+                    f"unhashable static / operand mismatch has "
+                    f"drifted into the entry point."))
+            continue
+        hashes = [_jaxpr_hash(j) for j in tr.jaxprs]
+        if len(set(hashes)) > 1:
+            yield Violation(
+                code="GL10", path=tr.path, line=1,
+                symbol=f"{tr.short}:jaxpr-hash",
+                message=(
+                    f"engine program {tr.name} traces to DIFFERENT "
+                    f"jaxprs for different non-static operand values "
+                    f"({' vs '.join(hashes)}): an operand value is "
+                    f"being baked into the program (accidental "
+                    f"static / closure capture) — in production this "
+                    f"is one recompile per distinct value "
+                    f"(ppls_recompiles_total). Make the value a "
+                    f"traced operand."))
+
+
+DEEP_RULES = (rule_gl07, rule_gl08, rule_gl09, rule_gl10)
+DEEP_CODES = ("GL07", "GL08", "GL09", "GL10")
+
+
+def run_deep(probes=None, traces: Optional[List[DeepTrace]] = None
+             ) -> List[Violation]:
+    """Run the semantic tier: one shared trace pass, all four rules.
+
+    Pass ``traces`` to reuse an existing :func:`collect_traces` result
+    (the test suite caches one per session; ci.sh gets the reuse for
+    free inside a single CLI invocation)."""
+    if traces is None:
+        traces = collect_traces(probes)
+    out: List[Violation] = []
+    for rule in DEEP_RULES:
+        out.extend(rule(traces))
+    out.sort(key=lambda v: (v.path, v.line, v.code, v.symbol))
+    return out

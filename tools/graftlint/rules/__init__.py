@@ -1,0 +1,60 @@
+"""The graftlint rule set, one module per concern (round 17: the
+pre-split ``rules.py`` monolith became this package when the deep tier
+landed — shared AST machinery lives in ``_ast.py``, each GL rule keeps
+its docstring rationale next to its code, and the public import
+surface below is unchanged).
+
+AST tier (this package, always on):
+
+* GL01 snapshot-identity completeness     (``snapshot.py``)
+* GL02 f64 dtype discipline               (``dtype.py``)
+* GL03 host syncs in the traced hot path  (``hotpath.py``)
+* GL04 uncounted collectives, source view (``collectives.py``)
+* GL05 static-arg drift                   (``statics.py``)
+* GL06 telemetry publishes at boundaries  (``hotpath.py``)
+* GL11 lock discipline for shared state   (``locks.py``)
+
+Semantic tier (``tools/graftlint/deep.py``, ``--deep``): GL07-GL10
+trace the real jitted engine programs and walk the captured jaxprs —
+see that module for the census/model machinery.
+"""
+
+from tools.graftlint.rules._ast import (  # noqa: F401
+    _arg_is_trace_safe,
+    _build_call_index,
+    _called_names,
+    _const_strings,
+    _docstring_consts,
+    _dotted,
+    _jit_reachable,
+    _jit_roots,
+    _jit_statics,
+    _param_names,
+    _resolve_callee,
+    _static_name_pool,
+    _string_surface,
+    iter_functions,
+)
+from tools.graftlint.rules.collectives import rule_gl04  # noqa: F401
+from tools.graftlint.rules.dtype import (  # noqa: F401
+    GL02_SCOUT_SURFACE,
+    rule_gl02,
+)
+from tools.graftlint.rules.hotpath import (  # noqa: F401
+    rule_gl03,
+    rule_gl06,
+)
+from tools.graftlint.rules.locks import (  # noqa: F401
+    GL11_LOCK_MAP,
+    rule_gl11,
+)
+from tools.graftlint.rules.snapshot import rule_gl01  # noqa: F401
+from tools.graftlint.rules.statics import rule_gl05  # noqa: F401
+
+ALL_RULES = (rule_gl01, rule_gl02, rule_gl03, rule_gl04, rule_gl05,
+             rule_gl06, rule_gl11)
+
+# codes the AST tier checks (the CLI uses this to scope baseline
+# staleness: a deep-tier baseline entry is not "stale" on a run that
+# never executed the deep rules)
+AST_CODES = ("GL01", "GL02", "GL03", "GL04", "GL05", "GL06", "GL11")

@@ -1,0 +1,301 @@
+"""Shared AST machinery for the graftlint rule set.
+
+Every rule module in this package keys off the same small vocabulary:
+function iteration (methods get ``Class.method`` qualnames), dotted-name
+rendering, jit-root discovery (decorated entries AND the
+``jax.jit(shard_map_compat(body))`` builder pattern), the intra-package
+call index, and the jitted-reachability BFS shared by GL03/GL06.
+
+Round 17 fix: the reachability BFS resolves call targets wrapped in
+``functools.partial(...)`` — ``cb = functools.partial(helper, k)``
+inside a jit-reachable function makes ``helper`` part of the traced
+program, but the pre-round-17 walk only followed direct calls, so a
+host sync (or telemetry emit) inside a partial-wrapped helper was
+silently invisible to GL03/GL06.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint.core import LintModule
+
+
+def iter_functions(tree: ast.Module
+                   ) -> Iterator[Tuple[str, ast.FunctionDef]]:
+    """Top-level functions and class methods as (qualname, node).
+    Nested closures stay inside their parent's subtree (a function's
+    "scope" for every rule below is its whole subtree)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); '' if not dotted."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _jit_statics(fn: ast.FunctionDef) -> Optional[Tuple[str, ...]]:
+    """If ``fn`` is decorated as a jitted entry, return its declared
+    static_argnames (possibly empty); None when not jitted.
+
+    Recognized forms: ``@jax.jit``, ``@jit``, and
+    ``@[functools.]partial(jax.jit, static_argnames=(...))``.
+    """
+    for dec in fn.decorator_list:
+        d = _dotted(dec)
+        if d in ("jax.jit", "jit"):
+            return ()
+        if isinstance(dec, ast.Call):
+            head = _dotted(dec.func)
+            if head not in ("functools.partial", "partial"):
+                continue
+            if not dec.args or _dotted(dec.args[0]) not in ("jax.jit",
+                                                            "jit"):
+                continue
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    return tuple(_const_strings(kw.value))
+            return ()
+    return None
+
+
+def _const_strings(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_const_strings(e))
+        return out
+    return []
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs]
+            + ([a.vararg.arg] if a.vararg else [])
+            + ([a.kwarg.arg] if a.kwarg else []))
+
+
+def _docstring_consts(node: ast.AST) -> Set[int]:
+    """ids of the Constant nodes that are docstrings anywhere under
+    ``node`` — prose must not count as code-level accounting: a
+    docstring *mentioning* a counter or a field name is not the same
+    as persisting/incrementing it."""
+    out: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Module)):
+            body = n.body
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _string_surface(node: ast.AST) -> Set[str]:
+    """Every way a field name can be 'mentioned' by snapshot code:
+    string constants (dict keys, tuple-of-names tables, np.savez keys)
+    and keyword-argument names (``dict(tasks=0)``, ``overflow=ovf``).
+    Docstrings are excluded — prose is not persistence."""
+    docs = _docstring_consts(node)
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and id(n) not in docs:
+            out.add(n.value)
+        elif isinstance(n, ast.keyword) and n.arg:
+            out.add(n.arg)
+    return out
+
+
+def _called_names(node: ast.AST) -> Set[str]:
+    """Simple callee names (both ``f(...)`` and ``mod.f(...)``)."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Name):
+                out.add(n.func.id)
+            elif isinstance(n.func, ast.Attribute):
+                out.add(n.func.attr)
+    return out
+
+
+def _jit_roots(mod: LintModule
+               ) -> List[Tuple[str, ast.FunctionDef, Tuple[str, ...]]]:
+    """Jitted entries of a module: decorated defs, plus local function
+    names passed (possibly through wrappers like ``shard_map_compat``)
+    into a ``jax.jit(...)`` call — the builder pattern the sharded
+    engines use."""
+    roots = []
+    for qn, fn in iter_functions(mod.tree):
+        statics = _jit_statics(fn)
+        if statics is not None:
+            roots.append((qn, fn, statics))
+    local_defs: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(mod.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs.setdefault(n.name, n)
+    seen = {qn for qn, _, _ in roots}
+
+    def names_in(node):
+        for x in ast.walk(node):
+            if isinstance(x, ast.Name):
+                yield x.id
+
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and _dotted(n.func) in ("jax.jit",
+                                                           "jit"):
+            for arg in n.args[:1]:
+                for name in names_in(arg):
+                    fn = local_defs.get(name)
+                    if fn is not None and name not in seen:
+                        seen.add(name)
+                        statics = tuple(
+                            s for kw in n.keywords
+                            if kw.arg in ("static_argnames",
+                                          "static_argnums")
+                            for s in _const_strings(kw.value))
+                        roots.append((name, fn, statics))
+    return roots
+
+
+def _build_call_index(modules: List[LintModule]
+                      ) -> Dict[str, Dict[str, ast.FunctionDef]]:
+    """modkey -> {top-level function/method name -> node}."""
+    return {m.modkey: dict(iter_functions(m.tree)) for m in modules}
+
+
+def _resolve_name_or_attr(mod: LintModule, node: ast.AST,
+                          index: Dict[str, Dict[str, ast.FunctionDef]]
+                          ) -> Optional[Tuple[str, str]]:
+    """(modkey, qualname) when a bare Name or ``module.attr`` node
+    resolves to an intra-package function via this module's import
+    bindings; None otherwise."""
+    if isinstance(node, ast.Name):
+        if node.id in index.get(mod.modkey, {}):
+            return mod.modkey, node.id
+        imp = mod.name_imports.get(node.id)
+        if imp is not None:
+            base, orig = imp
+            if orig in index.get(base, {}):
+                return base, orig
+        return None
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name):
+        target_mod = mod.module_aliases.get(node.value.id)
+        if target_mod is not None and node.attr in index.get(target_mod,
+                                                             {}):
+            return target_mod, node.attr
+    return None
+
+
+def _resolve_callee(mod: LintModule, call: ast.Call,
+                    index: Dict[str, Dict[str, ast.FunctionDef]]
+                    ) -> Optional[Tuple[str, str]]:
+    """(modkey, qualname) of an intra-package callee, else None.
+
+    ``functools.partial(f, ...)`` resolves to ``f`` (round-17 fix):
+    partial-wrapping a function hands the WRAPPED function to whatever
+    consumes the callable (a lax.scan body, a kernel builder, a
+    deferred call), so for reachability purposes building the partial
+    IS calling the target."""
+    f = call.func
+    resolved = _resolve_name_or_attr(mod, f, index)
+    if resolved is not None:
+        return resolved
+    if _dotted(f) in ("functools.partial", "partial") and call.args:
+        return _resolve_name_or_attr(mod, call.args[0], index)
+    return None
+
+
+def _static_name_pool(modules: List[LintModule]) -> Set[str]:
+    """Union of every declared static argname in the package: a name
+    in this pool passed to ``int()`` inside a traced body is a
+    trace-time config coercion, not a host sync."""
+    pool: Set[str] = set()
+    for mod in modules:
+        for _, fn, statics in _jit_roots(mod):
+            pool.update(statics)
+    return pool
+
+
+def _arg_is_trace_safe(node: ast.AST, static_pool: Set[str]) -> bool:
+    """int()/float() args that are NOT host syncs: constants, shape
+    reads (static under tracing), and static-config names."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                       "dtype"):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            return True
+    names = [n.id for n in ast.walk(node) if isinstance(n, ast.Name)]
+    if names and all(nm in static_pool for nm in names):
+        return True
+    return not names    # pure-constant expression
+
+
+def _jit_reachable(modules: List[LintModule]):
+    """BFS the intra-package call graph from every jitted root.
+
+    Returns ``(visited, lookup)``: the reachable ``(modkey, qualname)``
+    set and a resolver to each function's AST node. Shared by GL03
+    (host syncs) and GL06 (telemetry publishes) — both defend the same
+    boundary: code reachable from a jitted root runs under tracing.
+    ``functools.partial(f, ...)`` edges are followed like direct calls
+    (see :func:`_resolve_callee`).
+    """
+    index = _build_call_index(modules)
+    mod_by_key = {m.modkey: m for m in modules}
+    # nested defs too: builder-pattern roots (jax.jit(wrap(body)) where
+    # body is a closure) are not top-level functions
+    all_defs: Dict[str, Dict[str, ast.FunctionDef]] = {}
+    for m in modules:
+        d: Dict[str, ast.FunctionDef] = {}
+        for n in ast.walk(m.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                d.setdefault(n.name, n)
+        all_defs[m.modkey] = d
+
+    def _lookup(modkey: str, qn: str) -> Optional[ast.FunctionDef]:
+        return index[modkey].get(qn) or all_defs[modkey].get(qn)
+    # BFS the reachable set
+    queue: List[Tuple[str, str]] = []
+    for mod in modules:
+        for qn, fn, _ in _jit_roots(mod):
+            queue.append((mod.modkey, qn))
+    visited: Set[Tuple[str, str]] = set()
+    while queue:
+        key = queue.pop()
+        if key in visited:
+            continue
+        visited.add(key)
+        modkey, qn = key
+        mod = mod_by_key[modkey]
+        fn = _lookup(modkey, qn)
+        if fn is None:
+            continue
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                callee = _resolve_callee(mod, n, index)
+                if callee is not None and callee not in visited:
+                    queue.append(callee)
+    return visited, _lookup

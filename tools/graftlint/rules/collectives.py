@@ -1,0 +1,83 @@
+"""GL04 — uncounted collectives in the dd engine (AST tier).
+
+The SEMANTIC twin of this rule is GL07 (``tools/graftlint/deep.py``):
+GL04 sees only what the source spells — a collective hidden behind a
+``shard_map`` body builder, a ``lax.cond`` branch, or a helper in
+another module is invisible here, which is exactly why the deep tier
+traces the real jitted dd/stream programs and censuses the collective
+primitives that tracing actually captured.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List
+
+from tools.graftlint.core import LintModule, Violation
+from tools.graftlint.rules._ast import (_docstring_consts, _dotted,
+                                        iter_functions)
+
+_COLLECTIVES = {"psum", "all_gather", "ppermute", "pmax", "pmin",
+                "pmean", "psum_scatter", "all_to_all"}
+_GL04_SCOPE = re.compile(r"(sharded_walker|mesh)\.py$")
+
+
+def rule_gl04(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL04: every collective in the dd engine must be paired with
+    ``crounds`` accounting.
+
+    The dd walker's headline claim (2.4-3.0 collective rounds/cycle vs
+    legacy's 7-10.5) is backed by the device-counted ``crounds``
+    counter; a collective added without touching ``crounds`` silently
+    falsifies that accounting.  Mechanically: any top-level function in
+    ``sharded_walker.py``/``mesh.py`` whose subtree performs a
+    ``lax.psum/all_gather/ppermute/...`` must also reference
+    ``crounds`` somewhere in the same subtree (increment, carry field,
+    or an explicit pass-through).  Primitives whose collectives are
+    counted by their caller belong in the allowlist with that reason.
+    """
+    for mod in modules:
+        if not _GL04_SCOPE.search(mod.path):
+            continue
+        for qn, fn in iter_functions(mod.tree):
+            hits: List[ast.Call] = []
+            counted = False
+            docs = _docstring_consts(fn)
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    head = _dotted(n.func)
+                    parts = head.split(".")
+                    if (parts[-1] in _COLLECTIVES
+                            and (len(parts) == 1
+                                 or parts[-2] in ("lax", "jax"))):
+                        hits.append(n)
+                if isinstance(n, ast.Name) and "crounds" in n.id:
+                    counted = True
+                elif isinstance(n, ast.Attribute) \
+                        and "crounds" in n.attr:
+                    counted = True
+                elif isinstance(n, ast.keyword) and n.arg \
+                        and "crounds" in n.arg:
+                    counted = True
+                elif isinstance(n, ast.Constant) \
+                        and isinstance(n.value, str) \
+                        and "crounds" in n.value \
+                        and id(n) not in docs:
+                    # a docstring saying "crounds is handled by the
+                    # caller" is prose — the allowlist (with a
+                    # reviewable reason) is the only sanctioned
+                    # caller-counts-it escape hatch
+                    counted = True
+            if hits and not counted:
+                yield Violation(
+                    code="GL04", path=mod.path, line=hits[0].lineno,
+                    symbol=qn,
+                    message=(
+                        f"{qn} performs {len(hits)} collective(s) "
+                        f"(lax.psum/all_gather/...) but never touches "
+                        f"the crounds counter: the device-counted "
+                        f"collective-round claims no longer cover "
+                        f"this path. Increment crounds at the "
+                        f"boundary, or allowlist with the reason the "
+                        f"caller counts it."))

@@ -1,0 +1,149 @@
+"""GL02 — f64 dtype discipline (plus the declared scout-dtype surface)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from tools.graftlint.core import LintModule, Violation
+from tools.graftlint.rules._ast import _dotted, iter_functions
+
+# Creation calls whose dtype defaults are config-dependent (f32 without
+# jax_enable_x64).  jnp.array/asarray are only flagged for literal
+# payloads: wrapping an existing traced array inherits its dtype.
+_GL02_CREATORS = {"zeros", "ones", "empty", "full", "arange",
+                  "linspace"}
+_GL02_DTYPE_POSITION = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                        "array": 1, "asarray": 1}
+# The ds (double-double) representation IS a pair of f32 limbs: its
+# kernels are f32 by construction, not by accident.
+_GL02_F32_EXEMPT = re.compile(r"ops/(ds_kernel|pow2|ds)\.py$")
+
+# Round-12 DECLARED SCOUT-DTYPE SURFACE: the mixed-precision scouting
+# pass is DELIBERATELY f32 — but only where declared. Each entry names
+# a module (path suffix), the symbols (function qualnames, or "*" for
+# the whole module) allowed to reference f32, and the REVIEWED reason.
+# This is a declaration, not a baseline: f32 outside the listed
+# (module, symbol) pairs still fails GL02, and additions here are a
+# code-reviewed API change, never a silent baseline growth
+# (tests/test_graftlint.py pins both directions).
+GL02_SCOUT_SURFACE = {
+    "ops/scout_kernel.py": {
+        "*": "the declared f32 scout surface itself: a single-precision "
+             "ds-API twin evaluated ONLY by the walker's scout pass — "
+             "f32 is the module's entire purpose, and every scout "
+             "decision it feeds is either decisively-split (guard band) "
+             "or re-taken in full ds by the confirm pass.",
+    },
+}
+
+
+def _scout_surface_entry(path: str, qn: str):
+    """The declared scout-surface reason covering (module, symbol), or
+    None when the pair is not declared."""
+    for suffix, symbols in GL02_SCOUT_SURFACE.items():
+        if path.endswith(suffix):
+            if "*" in symbols:
+                return symbols["*"]
+            if qn in symbols:
+                return symbols[qn]
+            # bare function name of a ClassName.method qualname
+            if qn.split(".")[-1] in symbols:
+                return symbols[qn.split(".")[-1]]
+    return None
+
+
+def _is_literal_payload(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex, bool))
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literal_payload(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal_payload(node.operand)
+    return False
+
+
+def rule_gl02(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL02: f64 dtype discipline in ``parallel/`` and ``ops/``.
+
+    Flags (a) dtype-less ``jnp.zeros/ones/empty/full/arange/linspace``
+    and literal-payload ``jnp.array/asarray`` — their dtype is whatever
+    ``jax_enable_x64`` happens to be, i.e. f32 in any embedding that
+    forgot the flag, silently downcasting an accumulator path; and
+    (b) ``float32`` references outside the ds-limb modules (ds kernels
+    are f32 *by representation*; everywhere else f32 in a numeric path
+    is a downcast hazard).  Literal arithmetic (``0.5 * x``) is NOT
+    flagged: under weak typing literals adopt the array operand's
+    dtype, so the hazard is creation, not arithmetic.
+
+    Round 12: the DECLARED scout-dtype surface (``GL02_SCOUT_SURFACE``
+    — module + symbol list, per-entry reviewed reason) carves out the
+    mixed-precision scouting pass from the float32 check only; the
+    dtype-less-creation check still applies inside it, and f32 outside
+    the declared pairs still fails."""
+    for mod in modules:
+        if "/parallel/" not in "/" + mod.path \
+                and "/ops/" not in "/" + mod.path:
+            continue
+        f32_hits: Dict[str, Tuple[int, int]] = {}
+        for qn, fn in iter_functions(mod.tree):
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    head = _dotted(n.func)
+                    parts = head.split(".")
+                    if len(parts) == 2 and parts[0] in ("jnp", "jax_np"):
+                        name = parts[1]
+                        has_dtype = any(kw.arg == "dtype"
+                                        for kw in n.keywords)
+                        pos = _GL02_DTYPE_POSITION.get(name)
+                        if pos is not None and len(n.args) > pos:
+                            has_dtype = True
+                        if name in _GL02_CREATORS and not has_dtype \
+                                and name not in ("array", "asarray"):
+                            yield Violation(
+                                code="GL02", path=mod.path,
+                                line=n.lineno,
+                                symbol=f"{qn}:dtype-less-{name}",
+                                message=(
+                                    f"jnp.{name}(...) without an "
+                                    f"explicit dtype in a numeric "
+                                    f"path: the result is f32 unless "
+                                    f"jax_enable_x64 is set — pass "
+                                    f"dtype=jnp.float64 (or the "
+                                    f"intended integer dtype)."))
+                        elif name in ("array", "asarray") \
+                                and not has_dtype and n.args \
+                                and _is_literal_payload(n.args[0]):
+                            yield Violation(
+                                code="GL02", path=mod.path,
+                                line=n.lineno,
+                                symbol=f"{qn}:dtype-less-{name}",
+                                message=(
+                                    f"jnp.{name}(<literal>) without "
+                                    f"dtype: literal payloads default "
+                                    f"to the x64-flag dtype — make "
+                                    f"the f64 (or integer) intent "
+                                    f"explicit."))
+                if not _GL02_F32_EXEMPT.search(mod.path) \
+                        and _scout_surface_entry(mod.path, qn) is None:
+                    is_f32 = (
+                        (isinstance(n, ast.Attribute)
+                         and n.attr == "float32")
+                        or (isinstance(n, ast.Constant)
+                            and n.value == "float32"))
+                    if is_f32 and qn not in f32_hits:
+                        f32_hits[qn] = (n.lineno, 1)
+                    elif is_f32:
+                        line, cnt = f32_hits[qn]
+                        f32_hits[qn] = (line, cnt + 1)
+        for qn, (line, cnt) in f32_hits.items():
+            yield Violation(
+                code="GL02", path=mod.path, line=line,
+                symbol=f"{qn}:float32",
+                message=(
+                    f"{cnt} float32 reference(s) in {qn}: f32 in a "
+                    f"numeric path silently downcasts the f64 "
+                    f"accumulator chain. If the f32 is deliberate "
+                    f"(ds limbs, lane-state packing), allowlist this "
+                    f"function with that reason."))

@@ -1,0 +1,159 @@
+"""GL03 + GL06 — the traced-hot-path boundary rules.
+
+Both rules defend the same line: code reachable from a jitted root runs
+under tracing, so host syncs (GL03) and telemetry publishes (GL06) in
+there either break tracing, fire at trace time, or force a device
+round-trip per cycle. They share the :func:`_jit_reachable` BFS.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.graftlint.core import LintModule, Violation
+from tools.graftlint.rules._ast import (_arg_is_trace_safe, _dotted,
+                                        _jit_reachable,
+                                        _static_name_pool)
+
+_HOST_SYNC_ATTRS = {"device_get", "device_put", "block_until_ready",
+                    "item", "tolist"}
+_NP_ALIASES = {"np", "numpy", "onp"}
+
+
+def rule_gl03(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL03: host synchronization inside the traced hot path.
+
+    Walks the intra-package call graph from every jitted root (the
+    ``@jax.jit`` entries of walker.py/stream.py and the
+    ``jax.jit(shard_map_compat(...))`` builders of the sharded
+    engines) and flags, in any reachable function body:
+    ``jax.device_get/device_put``, ``.block_until_ready()``,
+    ``.item()/.tolist()``, ``np.*`` calls on non-constant arguments,
+    and ``int()/float()/bool()`` coercions of traced values.  Under
+    ``jit`` these either fail at trace time in the best case or —
+    with AOT-style retracing — force a device round-trip per cycle in
+    the hot loop, which is exactly the failure mode the device-counted
+    ``crounds``/phase claims exist to rule out."""
+    mod_by_key = {m.modkey: m for m in modules}
+    static_pool = _static_name_pool(modules)
+    visited, _lookup = _jit_reachable(modules)
+    for modkey, qn in sorted(visited):
+        mod = mod_by_key[modkey]
+        fn = _lookup(modkey, qn)
+        if fn is None:
+            continue
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            head = _dotted(n.func)
+            parts = head.split(".")
+            sync = None
+            if head in ("jax.device_get", "jax.device_put"):
+                sync = head
+            elif isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("block_until_ready", "item",
+                                        "tolist"):
+                sync = f".{n.func.attr}()"
+            elif len(parts) == 2 and parts[0] in _NP_ALIASES:
+                # np.float32(eps) on a static config name is trace-time
+                # constant construction, not a sync
+                if any(not _arg_is_trace_safe(a, static_pool)
+                       for a in n.args):
+                    sync = head
+            elif isinstance(n.func, ast.Name) \
+                    and n.func.id in ("int", "float", "bool") \
+                    and n.args \
+                    and not _arg_is_trace_safe(n.args[0], static_pool):
+                sync = f"{n.func.id}()"
+            if sync is None:
+                continue
+            yield Violation(
+                code="GL03", path=mod.path, line=n.lineno,
+                symbol=f"{qn}:{sync}",
+                message=(
+                    f"{sync} inside {qn}, which is reachable from a "
+                    f"jitted root: a host sync in the traced hot path "
+                    f"either breaks tracing or forces a device "
+                    f"round-trip per cycle. Hoist it to the host "
+                    f"driver, or allowlist with the reason it only "
+                    f"runs at trace time."))
+
+
+# The obs-layer publish/emit surface (obs.telemetry / obs.registry /
+# obs.spans method names). `.set` is deliberately ABSENT: jax's
+# `x.at[i].set(v)` shares the attribute name, and gauges are only
+# reachable through the obs-imported handles the name check below
+# already covers.
+_GL06_API = {"inc", "set_max", "observe", "event", "span",
+             "publish_run", "publish_phase", "publish_compile_cache",
+             "publish_compile", "publish_chip_balance", "record_phase",
+             "stream_counter", "stream_gauge", "emit_event"}
+
+
+def _imports_obs(mod: LintModule) -> bool:
+    """Whether the module binds anything from the obs subpackage."""
+    if any(v == "obs" or v.startswith("obs/")
+           for v in mod.module_aliases.values()):
+        return True
+    return any(base == "obs" or base.startswith("obs/")
+               for base, _ in mod.name_imports.values())
+
+
+def rule_gl06(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL06: telemetry reads/writes (registry publishes, span/event
+    emits) may only occur in boundary-hook functions — never inside a
+    function reachable from a jitted root.
+
+    The telemetry layer's contract is "one device fetch per boundary,
+    publishes are host dict arithmetic on values the boundary already
+    pulled" (obs/__init__.py). A publish that drifts into the traced
+    cycle body breaks it two ways at once: the Python side effect
+    runs at TRACE time (the registry records one phantom sample per
+    compile, not per execution — silently wrong counts), and any
+    value it needs forces the GL03 host-sync shape. Mechanically: in
+    any function reachable from a jitted root (the GL03 BFS), flag
+    (a) calls to names imported from ``obs`` modules, and (b) — in
+    modules that import obs — attribute calls spelling an obs API
+    method (``.inc``/``.observe``/``.event``/``.span``/
+    ``publish_*``/...)."""
+    mod_by_key = {m.modkey: m for m in modules}
+    visited, _lookup = _jit_reachable(modules)
+    for modkey, qn in sorted(visited):
+        mod = mod_by_key[modkey]
+        fn = _lookup(modkey, qn)
+        if fn is None:
+            continue
+        obs_mod = _imports_obs(mod)
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            hit = None
+            f = n.func
+            if isinstance(f, ast.Name):
+                imp = mod.name_imports.get(f.id)
+                if imp is not None and (imp[0] == "obs"
+                                        or imp[0].startswith("obs/")):
+                    hit = f.id
+            elif isinstance(f, ast.Attribute):
+                if obs_mod and f.attr in _GL06_API:
+                    hit = f.attr
+                # obs_module.anything(...) through a module alias
+                elif isinstance(f.value, ast.Name):
+                    tgt = mod.module_aliases.get(f.value.id)
+                    if tgt is not None and (tgt == "obs"
+                                            or tgt.startswith("obs/")):
+                        hit = f"{f.value.id}.{f.attr}"
+            if hit is None:
+                continue
+            yield Violation(
+                code="GL06", path=mod.path, line=n.lineno,
+                symbol=f"{qn}:{hit}",
+                message=(
+                    f"telemetry publish/emit {hit!r} inside {qn}, "
+                    f"which is reachable from a jitted root: the "
+                    f"side effect fires at trace time (one phantom "
+                    f"sample per compile) and its inputs force a "
+                    f"host sync. Move the publish to the host "
+                    f"boundary hook that already holds the fetched "
+                    f"values."))

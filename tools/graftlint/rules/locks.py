@@ -1,0 +1,138 @@
+"""GL11 — lock-discipline lint for the serving runtime's shared state.
+
+Round 16 fixed two real ingest races BY HAND REVIEW (CHANGES PR 10):
+an ingest acknowledgment could land in a dead engine during the
+supervisor's backoff window (the handle was cleared outside the engine
+lock), and concurrent stdout JSONL lines could interleave mid-record.
+This rule is the regression armor: a DECLARED lock map
+(``GL11_LOCK_MAP``) names, per module, the attributes that are shared
+across threads and the lock that owns them; any read or write of a
+guarded attribute outside a ``with <lock>:`` block flags.
+
+The discipline is lexical (AST tier), which is exactly what makes it
+enforceable: the repo's convention is that every cross-thread touch
+sits visibly inside a ``with self._lock`` block of the owning class
+(``runtime/ingest.py``'s ``EngineHandle``), and the engines themselves
+stay single-threaded. ``__init__`` (and any other declared
+``unlocked_ok`` function) is exempt — an object under construction is
+not yet shared.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from tools.graftlint.core import LintModule, Violation
+from tools.graftlint.rules._ast import iter_functions
+
+# The declared lock map. Like GL02_SCOUT_SURFACE this is a REVIEWED
+# declaration, not a baseline: every entry carries the reason its
+# guarded set is what it is, additions are a code-reviewed API change,
+# and tests pin that reasons exist. ``guarded`` may be empty — that is
+# itself a contract statement ("this module holds no cross-thread
+# mutable state"), kept here so the next thread added to the module
+# has to meet this rule head-on instead of discovering it post-race.
+GL11_LOCK_MAP = {
+    "runtime/ingest.py": {
+        "locks": ("_lock",),
+        "guarded": ("_eng",),
+        "unlocked_ok": ("__init__",),
+        "reason": (
+            "EngineHandle._eng is the live-engine publication cell "
+            "shared between the serve phase loop and the ingest "
+            "handler threads: the PR-10 ack-after-engine-death race "
+            "was exactly a touch of this slot outside the engine "
+            "lock (an ack landing in a dead engine vanishes at "
+            "resume). Every read/write goes through a with "
+            "self._lock block; __init__ is exempt because the "
+            "handle is not yet shared during construction."),
+    },
+    "runtime/stream.py": {
+        "locks": ("_lock",),
+        "guarded": (),
+        "unlocked_ok": (),
+        "reason": (
+            "StreamEngine is single-threaded BY DESIGN: every "
+            "cross-thread access (ingest submit, shed ledger reads, "
+            "graceful-shutdown snapshot) is serialized by the serve "
+            "loop's EngineHandle lock (runtime/ingest.py), so the "
+            "engine itself owns no lock and no guarded attrs. The "
+            "empty guarded set records that contract — a thread "
+            "spawned INSIDE stream.py must declare its shared attrs "
+            "here (and take a lock) or fail review."),
+    },
+}
+
+
+def _with_mentions_lock(item: ast.withitem, locks) -> bool:
+    """True when a with-item's context expression spells one of the
+    declared lock names (``self._lock``, ``handle._lock``, a bare
+    ``_lock`` local, or a ``handle.lock()`` accessor returning it)."""
+    for n in ast.walk(item.context_expr):
+        if isinstance(n, ast.Attribute) and n.attr in locks:
+            return True
+        if isinstance(n, ast.Name) and n.id in locks:
+            return True
+    return False
+
+
+def rule_gl11(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL11: reads/writes of declared cross-thread attributes outside
+    the owning ``with <lock>`` block.
+
+    For every module with a ``GL11_LOCK_MAP`` entry: each access to a
+    guarded attribute (``anything._eng`` — attribute spelling is the
+    identity, mirroring how the PR-10 race was a ``holder`` slot
+    reachable from two threads under any alias) must sit lexically
+    inside a ``with`` whose context expression mentions one of the
+    declared lock names. Functions in the entry's ``unlocked_ok``
+    tuple (``__init__`` by convention) are exempt."""
+    for mod in modules:
+        entry = None
+        for suffix, e in GL11_LOCK_MAP.items():
+            if mod.path.endswith(suffix):
+                entry = e
+                break
+        if entry is None or not entry["guarded"]:
+            continue
+        locks = tuple(entry["locks"])
+        guarded = set(entry["guarded"])
+        exempt = set(entry.get("unlocked_ok", ()))
+
+        for qn, fn in iter_functions(mod.tree):
+            if qn in exempt or qn.split(".")[-1] in exempt:
+                continue
+            seen: Set[Tuple[str, str]] = set()
+
+            def scan(node: ast.AST, held: bool):
+                for child in ast.iter_child_nodes(node):
+                    child_held = held
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        if any(_with_mentions_lock(it, locks)
+                               for it in child.items):
+                            child_held = True
+                    if isinstance(child, ast.Attribute) \
+                            and child.attr in guarded and not held:
+                        key = (qn, child.attr)
+                        if key not in seen:
+                            seen.add(key)
+                            yield Violation(
+                                code="GL11", path=mod.path,
+                                line=child.lineno,
+                                symbol=f"{qn}:{child.attr}",
+                                message=(
+                                    f"{qn} touches the cross-thread "
+                                    f"attribute {child.attr!r} outside "
+                                    f"the owning with-{'/'.join(locks)}"
+                                    f" block: this is the PR-10 "
+                                    f"ack-after-engine-death race "
+                                    f"shape — another thread can "
+                                    f"observe or clear the handle "
+                                    f"mid-sequence. Wrap the access "
+                                    f"in the declared lock, or add "
+                                    f"the function to unlocked_ok "
+                                    f"with a reviewed reason."))
+                    yield from scan(child, child_held)
+
+            yield from scan(fn, False)

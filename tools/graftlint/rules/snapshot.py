@@ -1,0 +1,120 @@
+"""GL01 — snapshot-identity completeness."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Set, Tuple
+
+from tools.graftlint.core import LintModule, Violation
+from tools.graftlint.rules._ast import (_called_names, _string_surface,
+                                        iter_functions)
+
+_CHECKPOINT_APIS = {
+    "save_family_checkpoint", "load_family_checkpoint",
+    "save_checkpoint", "load_checkpoint",
+    "_family_identity", "_family_ckpt_identity", "_stream_identity",
+    "_dd_ckpt_identity",
+}
+_SNAPSHOT_NAME_RE = re.compile(r"identity|checkpoint|snapshot|resume",
+                               re.IGNORECASE)
+
+# Spelling bridges between carry fields and their on-disk names.  Kept
+# deliberately tiny: a rename that breaks one of these should be FELT.
+_GL01_ALIASES: Dict[str, Set[str]] = {
+    "bag": {"bag_cols"},
+    "bag_l": {"l"}, "bag_r": {"r"}, "bag_th": {"th"},
+    "bag_meta": {"meta"},
+    "maxd": {"max_depth"},
+}
+
+
+def _carry_classes(mod: LintModule
+                   ) -> List[Tuple[ast.ClassDef, List[Tuple[str, int]]]]:
+    """NamedTuple/dataclass definitions named ``*Carry`` with their
+    (field, line) lists."""
+    from tools.graftlint.rules._ast import _dotted
+    out = []
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Carry")):
+            continue
+        is_nt = any(_dotted(b).split(".")[-1] == "NamedTuple"
+                    for b in node.bases)
+        is_dc = any(_dotted(d).split(".")[-1] == "dataclass"
+                    or (isinstance(d, ast.Call)
+                        and _dotted(d.func).split(".")[-1] == "dataclass")
+                    for d in node.decorator_list)
+        if not (is_nt or is_dc):
+            continue
+        fields = [(s.target.id, s.lineno) for s in node.body
+                  if isinstance(s, ast.AnnAssign)
+                  and isinstance(s.target, ast.Name)]
+        out.append((node, fields))
+    return out
+
+
+def rule_gl01(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL01: every field of every walker/stream/dd carry container must
+    be represented on the checkpoint identity surface.
+
+    The PR-2 near-miss this encodes: ``refill_slots`` changed the
+    meaning of the persisted state but was not part of the snapshot
+    identity, so a refill snapshot could silently resume a legacy run.
+    Mechanically: for each ``*Carry`` NamedTuple/dataclass that is
+    referenced by the module's snapshot code (directly, or by a
+    function the snapshot code calls — the run entry whose result gets
+    persisted), every field name must appear among the string
+    constants / keyword names of the snapshot functions themselves (or
+    of ``runtime/checkpoint.py``), modulo the tiny documented alias
+    map.  A field the snapshot surface never mentions is state the
+    resume path cannot restore."""
+    global_surface: Set[str] = set()
+    for mod in modules:
+        if mod.path.endswith("runtime/checkpoint.py"):
+            global_surface |= _string_surface(mod.tree)
+    for mod in modules:
+        carries = _carry_classes(mod)
+        if not carries:
+            continue
+        funcs = dict(iter_functions(mod.tree))
+        contributing = {
+            qn: fn for qn, fn in funcs.items()
+            if _SNAPSHOT_NAME_RE.search(qn)
+            or (_called_names(fn) & _CHECKPOINT_APIS)
+        }
+        if not contributing:
+            continue
+        surface = set(global_surface)
+        referencing: List[ast.AST] = []
+        one_hop: Set[str] = set()
+        for fn in contributing.values():
+            surface |= _string_surface(fn)
+            referencing.append(fn)
+            one_hop |= _called_names(fn)
+        for qn, fn in funcs.items():
+            if qn in one_hop and qn not in contributing:
+                referencing.append(fn)
+        in_scope_names: Set[str] = set()
+        for node in referencing:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name):
+                    in_scope_names.add(n.id)
+        for cls, fields in carries:
+            if cls.name not in in_scope_names:
+                continue        # kernel-internal carry, never persisted
+            for field, line in fields:
+                names = {field} | _GL01_ALIASES.get(field, set())
+                if names & surface:
+                    continue
+                yield Violation(
+                    code="GL01", path=mod.path, line=line,
+                    symbol=f"{cls.name}.{field}",
+                    message=(
+                        f"carry field {cls.name}.{field} is absent from "
+                        f"the snapshot identity surface: no snapshot/"
+                        f"identity function in this module mentions "
+                        f"{sorted(names)} — a resumed run cannot "
+                        f"restore it. Persist it (bag_cols/totals/"
+                        f"identity), or allowlist with the reason it "
+                        f"is derived state."))

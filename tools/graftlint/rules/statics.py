@@ -1,0 +1,158 @@
+"""GL05 — static-arg drift on jitted entries."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint.core import LintModule, Violation
+from tools.graftlint.rules._ast import (_dotted, _jit_roots,
+                                        _param_names, iter_functions)
+
+_HASHABLE_ANNOTATIONS = {"int", "float", "bool", "str", "Callable",
+                         "Rule"}
+
+
+def _is_config_param(arg: ast.arg, default: Optional[ast.AST]) -> bool:
+    ann = arg.annotation
+    if ann is not None:
+        if _dotted(ann).split(".")[-1] in _HASHABLE_ANNOTATIONS:
+            return True
+        # Callable[..., X] — subscripted form
+        if isinstance(ann, ast.Subscript) \
+                and _dotted(ann.value).split(".")[-1] == "Callable":
+            return True
+    if default is not None and isinstance(default, ast.Constant) \
+            and isinstance(default.value, (int, float, bool, str)) \
+            and default.value is not None:
+        return True
+    return False
+
+
+def rule_gl05(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL05: static-arg drift on jitted entries.
+
+    Three drifts, all of which have bitten jitted-config code before:
+    (a) a name in ``static_argnames`` that is no longer a parameter —
+    silently ignored by jax, so the "static" silently became traced
+    after a rename; (b) a hashable config parameter (Callable / int /
+    float / bool / str / Rule annotation, or scalar default) that is
+    NOT declared static — Callables fail at trace time, scalars trace
+    into the program and change numerics-by-config into
+    numerics-by-input; (c) a call site feeding a declared static from
+    an enclosing loop variable — one recompile per iteration, the
+    recompile-storm shape."""
+    # (modkey, bare name) -> statics, so same-named jitted functions in
+    # different modules don't shadow each other, and call sites resolve
+    # through the import bindings instead of by bare-name guesswork
+    jit_sigs: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+    for mod in modules:
+        for qn, fn, statics in _jit_roots(mod):
+            jit_sigs[(mod.modkey, qn.split(".")[-1])] = statics
+            params = set(_param_names(fn))
+            for s in statics:
+                if s not in params:
+                    yield Violation(
+                        code="GL05", path=mod.path, line=fn.lineno,
+                        symbol=f"{qn}:{s}:not-a-param",
+                        message=(
+                            f"static_argnames entry {s!r} of {qn} is "
+                            f"not a parameter: jax ignores unknown "
+                            f"names, so after a rename the value is "
+                            f"silently traced. Fix the declaration."))
+            # hashable config params anywhere in the signature:
+            # keyword-only (the dominant convention here) AND annotated
+            # / scalar-defaulted positional-or-keyword params — a
+            # jitted `def f(x, eps: float = 1e-7)` leaks config into
+            # the traced signature just the same
+            pos = fn.args.posonlyargs + fn.args.args
+            pos_defaults = [None] * (len(pos) - len(fn.args.defaults)) \
+                + list(fn.args.defaults)
+            candidates = list(zip(pos, pos_defaults)) \
+                + list(zip(fn.args.kwonlyargs, fn.args.kw_defaults))
+            for arg, default in candidates:
+                if arg.arg in statics:
+                    continue
+                if _is_config_param(arg, default):
+                    yield Violation(
+                        code="GL05", path=mod.path, line=arg.lineno,
+                        symbol=f"{qn}:{arg.arg}:undeclared-static",
+                        message=(
+                            f"keyword-only config param {arg.arg!r} "
+                            f"of jitted {qn} is hashable "
+                            f"(annotation/default) but not in "
+                            f"static_argnames: a Callable here fails "
+                            f"at trace time, a scalar gets traced "
+                            f"and varies the compiled program's "
+                            f"numerics per call. Declare it static "
+                            f"or drop the config flavor."))
+
+    def _callee_statics(mod: LintModule, call: ast.Call
+                        ) -> Tuple[Optional[str],
+                                   Optional[Tuple[str, ...]]]:
+        """(display name, statics) when the call site resolves to a
+        known jitted function via this module's bindings; (None, None)
+        otherwise — an unresolvable ``obj.method(...)`` must not match
+        a jitted function that happens to share the bare name."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if (mod.modkey, f.id) in jit_sigs:
+                return f.id, jit_sigs[(mod.modkey, f.id)]
+            imp = mod.name_imports.get(f.id)
+            if imp is not None and imp in jit_sigs:
+                return f.id, jit_sigs[imp]
+        elif isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name):
+            target_mod = mod.module_aliases.get(f.value.id)
+            if target_mod is not None \
+                    and (target_mod, f.attr) in jit_sigs:
+                return f.attr, jit_sigs[(target_mod, f.attr)]
+        return None, None
+
+    # (c) loop-varying statics at call sites, package-wide
+    for mod in modules:
+
+        def scan(node: ast.AST, loop_targets: Set[str], qn: str):
+            for child in ast.iter_child_nodes(node):
+                targets = loop_targets
+                if isinstance(child, ast.For):
+                    targets = loop_targets | {
+                        n.id for n in ast.walk(child.target)
+                        if isinstance(n, ast.Name)}
+                elif isinstance(child, (ast.ListComp, ast.SetComp,
+                                        ast.GeneratorExp, ast.DictComp)):
+                    # a call per comprehension element is the same
+                    # recompile storm as a for-statement body
+                    targets = loop_targets | {
+                        n.id for g in child.generators
+                        for n in ast.walk(g.target)
+                        if isinstance(n, ast.Name)}
+                if isinstance(child, ast.Call):
+                    name, statics = _callee_statics(mod, child)
+                    if statics:
+                        for kw in child.keywords:
+                            if kw.arg not in statics:
+                                continue
+                            used = {n.id for n in ast.walk(kw.value)
+                                    if isinstance(n, ast.Name)}
+                            bad = used & loop_targets
+                            if bad:
+                                yield Violation(
+                                    code="GL05", path=mod.path,
+                                    line=child.lineno,
+                                    symbol=(f"{qn}:{name}."
+                                            f"{kw.arg}:loop-varying"),
+                                    message=(
+                                        f"call to jitted {name} "
+                                        f"feeds static arg "
+                                        f"{kw.arg!r} from loop "
+                                        f"variable(s) "
+                                        f"{sorted(bad)}: one "
+                                        f"recompile per iteration "
+                                        f"(recompile storm). Hoist "
+                                        f"the value or make the "
+                                        f"arg traced."))
+                yield from scan(child, targets, qn)
+
+        for qn, fn in iter_functions(mod.tree):
+            yield from scan(fn, set(), qn)
