@@ -113,9 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default="host",
                    help="host: unbounded frontier, host loop; device: one "
                         "jitted while_loop; sharded: multi-chip shard_map")
-    p.add_argument("--backend", choices=["jax", "mpi"], default="jax",
+    p.add_argument("--backend", choices=["jax", "mpi", "spillover"],
+                   default="jax",
                    help="jax: TPU-native path; mpi: the C farmer/worker "
-                        "binary (requires an MPI toolchain)")
+                        "binary (requires an MPI toolchain); spillover: "
+                        "pure-f64 bag rounds pinned to the host CPU "
+                        "(off-mesh, round 18)")
     p.add_argument("--capacity", type=int, default=1 << 16)
     p.add_argument("--max-rounds", type=int, default=4096)
     p.add_argument("--n-devices", type=int, default=None)
@@ -286,6 +289,38 @@ def build_parser() -> argparse.ArgumentParser:
                      dest="reduced_integrands",
                      help="prefer the family's range-reduced ds twin")
     srv.add_argument("--n-devices", type=int, default=None)
+    srv.add_argument("--processes", type=int, default=None,
+                     help="round 18: run the service as a MULTI-"
+                          "PROCESS cluster — N worker processes "
+                          "(each with its own host-local engine over "
+                          "its own devices) behind one coordinator "
+                          "that deals requests, collects retirements "
+                          "and, under --supervise, discovers the "
+                          "surviving topology on host loss and "
+                          "re-deals onto it")
+    srv.add_argument("--spillover", action="store_true",
+                     help="round 18 graceful degradation: queue-"
+                          "overflow victims without a deadline run "
+                          "as pure-f64 bag rounds on the host CPU "
+                          "(slower-but-correct, off-mesh) instead of "
+                          "being shed; requires --queue-limit to "
+                          "have any effect. NOTE: deadline-bearing "
+                          "requests are never spill-eligible (slower "
+                          "capacity cannot bound latency), so a "
+                          "--deadline-phases DEFAULT applied to every "
+                          "request disables spillover entirely — "
+                          "everything sheds queue_full")
+    srv.add_argument("--spillover-limit", type=int, default=4,
+                     dest="spillover_limit",
+                     help="max spillover completions per phase "
+                          "boundary (default 4)")
+    srv.add_argument("--f64-rounds", type=int, default=0,
+                     dest="f64_rounds",
+                     help="K > 0 runs the engine in PURE-F64 "
+                          "streaming mode (K LIFO bag rounds per "
+                          "phase, no Pallas kernel) — the provably "
+                          "batch-identical mode the determinism "
+                          "contracts are stated on")
     srv.add_argument("--requests", default=None, metavar="FILE",
                      help="JSONL request stream: one "
                           '{"theta": T, "bounds": [A, B], '
@@ -705,6 +740,34 @@ def _main_serve(args) -> int:
     reqs = [reqs[i] for i in order]
     arrivals = [arrivals[i] for i in order]
 
+    if getattr(args, "processes", None) is not None:
+        # round 18: the multi-process cluster serve path (coordinator
+        # + N worker processes). The ingest tier composes with the
+        # single-process engine only, for now.
+        if args.processes < 1:
+            # a sweep script parameterized over process counts must
+            # get a refusal for P<1, not a silently different engine
+            raise SystemExit(
+                f"--processes must be >= 1 (got {args.processes}); "
+                f"drop the flag to run the single-process engine")
+        if args.ingest_port is not None:
+            raise SystemExit(
+                "--ingest-port is not supported with --processes "
+                "(the cluster coordinator owns the request deal); "
+                "drive the batch/synthetic schedule instead")
+        if args.tenant_quotas is not None:
+            raise SystemExit(
+                "--tenant-quotas is not supported with --processes "
+                "(the cluster coordinator does not implement "
+                "per-tenant token buckets); drop the flag or run "
+                "single-process")
+        if args.metrics_port is not None:
+            raise SystemExit(
+                "--metrics-port is not supported with --processes "
+                "(the coordinator does not serve the registry yet); "
+                "read the summary line / --events timeline instead")
+        return _main_serve_cluster(args, reqs, arrivals)
+
     kw = dict(rule=Rule(args.rule), slots=args.slots, chunk=args.chunk,
               capacity=args.capacity, refill_slots=args.refill_slots,
               scout_dtype=args.scout_dtype,
@@ -712,10 +775,14 @@ def _main_serve(args) -> int:
               reduced_integrands=args.reduced_integrands,
               theta_block=int(getattr(args, "theta_block", 1)),
               engine=args.engine,
+              f64_rounds=int(getattr(args, "f64_rounds", 0)),
               checkpoint_every=args.checkpoint_every,
               queue_limit=args.queue_limit,
               tenant_quotas=args.tenant_quotas,
-              default_deadline_phases=args.deadline_phases)
+              default_deadline_phases=args.deadline_phases,
+              spillover=bool(getattr(args, "spillover", False)),
+              spillover_limit=int(getattr(args, "spillover_limit",
+                                          4)))
     if args.lanes:
         kw["lanes"] = args.lanes
 
@@ -773,18 +840,8 @@ def _main_serve(args) -> int:
     io_lock = threading.Lock()
 
     def _print_shed(rec):
-        # the explicit JSONL rejection record every shed request gets
-        # (the overload contract): same stream as the retirements, so
-        # a consumer can account for every acknowledged rid
         with io_lock:
-            print(json.dumps({
-                "rid": rec.rid, "shed": True, "reason": rec.reason,
-                "tenant": rec.tenant, "priority": rec.priority,
-                "phase": rec.phase,
-                "theta": (list(rec.theta)
-                          if isinstance(rec.theta, (tuple, list))
-                          else rec.theta),
-                "bounds": list(rec.bounds)}), flush=True)
+            print(json.dumps(_serve_shed_record(rec)), flush=True)
 
     def make_engine():
         from ppls_tpu.obs import Telemetry
@@ -943,30 +1000,8 @@ def _main_serve(args) -> int:
                     raise
             with io_lock:
                 for c in retired:
-                    print(json.dumps({
-                        "rid": c.rid,
-                        "theta": (list(c.theta)
-                                  if isinstance(c.theta, (tuple, list))
-                                  else c.theta),
-                        **({"areas": c.areas}
-                           if c.areas is not None and not c.failed
-                           else {}),
-                        "bounds": list(c.bounds),
-                        # a failed request (NaN quarantine, deadline
-                        # expiry) reports area null (the non-finite
-                        # payload is not strict JSON) + the failed
-                        # marker + its failure reason
-                        "area": (None if c.failed else c.area),
-                        **({"failed": True} if c.failed else {}),
-                        **({"failure": c.failure}
-                           if c.failure else {}),
-                        "tenant": c.tenant, "priority": c.priority,
-                        "admit_phase": c.admit_phase,
-                        "retire_phase": c.retire_phase,
-                        "phases_in_flight": c.phases_in_flight,
-                        "latency_phases": c.latency_phases,
-                        "latency_s": round(c.latency_s, 4)}),
-                        flush=True)
+                    print(json.dumps(_serve_completed_record(c)),
+                          flush=True)
             if idle_wait:
                 time.sleep(0.02)
         if stop.requested:
@@ -1045,6 +1080,11 @@ def _main_serve(args) -> int:
             for s in res.shed:
                 reasons[s.reason] = reasons.get(s.reason, 0) + 1
             summary["shed_reasons"] = reasons
+        # ENGINE-shape block (spillover_tasks included), emitted
+        # unconditionally — the same shape and cadence as the cluster
+        # summary, so consumers written against one path read the
+        # other
+        summary["spillover"] = eng.spillover_summary()
         if holder.get("stopped"):
             summary["terminated"] = holder["stopped"]
         failed = sum(1 for c in res.completed if c.failed)
@@ -1080,6 +1120,290 @@ def _main_serve(args) -> int:
             holder["tel"].close()
         if metrics_srv is not None:
             metrics_srv.close()
+
+
+def _serve_completed_record(c) -> dict:
+    """One completed request as its stdout-JSONL ledger record — the
+    consumer-facing shape `check_artifacts --serve` validates, shared
+    by the single-process and cluster serve paths so the two ledgers
+    cannot drift. A failed request (NaN quarantine, deadline expiry)
+    reports area null (the non-finite payload is not strict JSON)
+    plus the failed marker and its failure reason."""
+    return {
+        "rid": c.rid,
+        "theta": (list(c.theta)
+                  if isinstance(c.theta, (tuple, list)) else c.theta),
+        **({"areas": c.areas}
+           if c.areas is not None and not c.failed else {}),
+        "bounds": list(c.bounds),
+        "area": (None if c.failed else c.area),
+        **({"failed": True} if c.failed else {}),
+        **({"failure": c.failure} if c.failure else {}),
+        **({"spillover": True}
+           if getattr(c, "spillover", False) else {}),
+        "tenant": c.tenant, "priority": c.priority,
+        "admit_phase": c.admit_phase,
+        "retire_phase": c.retire_phase,
+        "phases_in_flight": c.phases_in_flight,
+        "latency_phases": c.latency_phases,
+        "latency_s": round(c.latency_s, 4)}
+
+
+def _serve_shed_record(s) -> dict:
+    """One shed request as its explicit JSONL rejection record (the
+    overload contract) — same stream as the retirements, so a
+    consumer can account for every acknowledged rid."""
+    return {
+        "rid": s.rid, "shed": True, "reason": s.reason,
+        "tenant": s.tenant, "priority": s.priority,
+        "phase": s.phase,
+        "theta": (list(s.theta)
+                  if isinstance(s.theta, (tuple, list)) else s.theta),
+        "bounds": list(s.bounds)}
+
+
+def _main_serve_cluster(args, reqs, arrivals) -> int:
+    """Round 18: the multi-process serve path. One coordinator (this
+    process) deals the request schedule over N worker processes,
+    prints the same JSONL ledger + summary as the single-process
+    path, and — under supervision — survives a real worker death:
+    host-loss discovery + re-deal onto the survivors, per-request
+    areas preserved (the schedule-independence contract)."""
+    import os
+    import time
+
+    from ppls_tpu.obs import Telemetry
+    from ppls_tpu.runtime.checkpoint import CheckpointCorruptError
+    from ppls_tpu.runtime.cluster import ClusterStreamEngine
+    from ppls_tpu.runtime.faults import FaultInjector, FaultPlan
+    from ppls_tpu.runtime.guard import GracefulShutdown, Supervisor
+
+    plan = (FaultPlan.from_spec(args.fault_plan)
+            if args.fault_plan else FaultPlan.from_env())
+    supervise = bool(args.supervise or plan is not None
+                     or os.environ.get("PPLS_CHAOS") == "1")
+    quarantine = bool(args.quarantine or supervise)
+    resuming = bool(args.checkpoint
+                    and os.path.exists(args.checkpoint))
+    tel = Telemetry(
+        events_path=args.events,
+        meta={"mode": "serve-cluster", "engine": args.engine,
+              "family": args.family, "eps": args.eps,
+              "rule": args.rule, "slots": args.slots,
+              "processes": int(args.processes), "seed": args.seed,
+              "requests": len(reqs), "resumed": resuming},
+        append=resuming)
+    injector = (FaultInjector(plan, telemetry=tel)
+                if plan is not None else None)
+
+    worker_kw = dict(
+        rule=args.rule, slots=args.slots, chunk=args.chunk,
+        capacity=args.capacity, refill_slots=args.refill_slots,
+        scout_dtype=args.scout_dtype,
+        double_buffer=args.double_buffer,
+        reduced_integrands=args.reduced_integrands,
+        theta_block=int(getattr(args, "theta_block", 1)),
+        engine=args.engine, n_devices=args.n_devices,
+        f64_rounds=int(getattr(args, "f64_rounds", 0)),
+        quarantine=quarantine)
+    if args.lanes:
+        worker_kw["lanes"] = args.lanes
+    # NOTE: checkpoint_path stays OUT of ckw — resume() takes it
+    # positionally and forwards it to the constructor itself
+    ckw = dict(n_processes=int(args.processes),
+               worker_kw=worker_kw,
+               checkpoint_every=args.checkpoint_every,
+               telemetry=tel, fault_injector=injector,
+               queue_limit=args.queue_limit,
+               spillover=bool(args.spillover),
+               spillover_limit=int(args.spillover_limit))
+
+    def build_engine():
+        if args.checkpoint and os.path.exists(args.checkpoint):
+            try:
+                # cluster_resize: a restart may legitimately target
+                # fewer (or more) processes than the snapshot's
+                # manifest — the deliberate spelling, same shape as
+                # the single path's always-on mesh_resize
+                return ClusterStreamEngine.resume(
+                    args.checkpoint, args.family, args.eps,
+                    cluster_resize=True, **ckw)
+            except CheckpointCorruptError as e:
+                print(f"serve: {e}; starting fresh", file=sys.stderr,
+                      flush=True)
+                tel.event("checkpoint_corrupt", path=args.checkpoint,
+                          detail=str(e)[:200])
+                # the per-process sibling snapshots must go with the
+                # coordinator file: a fresh coordinator re-issues
+                # grids from 0, and a stale worker snapshot's gmap
+                # would collide its old grids with the new run's
+                # (ghost retirements credited to the wrong request)
+                import glob as _glob
+                for p in ([args.checkpoint]
+                          + _glob.glob(f"{args.checkpoint}.p*")):
+                    if os.path.exists(p):
+                        os.unlink(p)
+        return ClusterStreamEngine(
+            args.family, args.eps,
+            checkpoint_path=args.checkpoint, **ckw)
+
+    # the live engine sits in a box: the supervisor's retry arms must
+    # be able to swap in a FRESH engine (see serve_loop below) and the
+    # summary/teardown below must follow the swap
+    eng_box = {"eng": build_engine()}
+    printed = {"done": 0, "shed": 0}
+
+    def flush_ledger():
+        # the print cursor trails the ledger instead of the step()
+        # return value: retirements collected before a host-loss
+        # abort (or restored by a resume) still get their line —
+        # consumers dedupe by rid across restarts
+        eng = eng_box["eng"]
+        while printed["done"] < len(eng.completed):
+            c = eng.completed[printed["done"]]
+            printed["done"] += 1
+            print(json.dumps(_serve_completed_record(c)), flush=True)
+        while printed["shed"] < len(eng.shed):
+            s = eng.shed[printed["shed"]]
+            printed["shed"] += 1
+            print(json.dumps(_serve_shed_record(s)), flush=True)
+
+    flush_ledger()          # a resumed ledger re-prints (rid dedupe)
+    t0 = time.perf_counter()
+    loop_state = {"started": False, "recovered": False}
+    # SIGTERM/SIGINT contract parity with the single-process path
+    # (round 16 / the sigterm fault kind): the handler only sets a
+    # flag, the loop winds down at the next phase boundary — final
+    # snapshot kept, balanced span close, summary with "terminated",
+    # exit 0
+    stop = GracefulShutdown()
+
+    def serve_loop():
+        # SELF-RESUMING on retry (like the single-process serve loop):
+        # a transient/hang re-entry must NOT re-drive the previous
+        # live engine — a watchdog timeout abandons its attempt thread
+        # mid-RPC, so that engine's sockets may still be owned by the
+        # stale thread and its command/reply pairing desynced. Force-
+        # kill the stale cluster and rebuild from the checkpoint (the
+        # restored client_state/batch_cursor keeps zero-lost-acks).
+        # The host_loss arm recovers the engine IN PLACE
+        # (recover_host_loss) and sets `recovered` so we keep it.
+        if loop_state["started"] \
+                and not loop_state.pop("recovered", False):
+            eng_box["eng"].close(graceful=False)
+            eng_box["eng"] = build_engine()
+            # the rebuilt ledger re-prints from 0 (rid dedupe), same
+            # as a process-level restart — cursors into the OLD
+            # engine's ledger don't index the restored one
+            printed["done"] = printed["shed"] = 0
+            flush_ledger()
+        loop_state["started"] = True
+        eng = eng_box["eng"]
+        k = int(eng.client_state.setdefault("batch_cursor",
+                                            eng.next_rid))
+        span = tel.span("run", mode="serve-cluster",
+                        processes=eng.n_processes,
+                        requests=len(reqs))
+        while (k < len(reqs) or not eng.idle) and not stop.requested:
+            while k < len(reqs) and arrivals[k] <= eng.phase:
+                r = reqs[k]
+                kw2 = dict(r[2]) if len(r) > 2 else {}
+                if args.deadline_phases is not None:
+                    # the single-process default-deadline semantics:
+                    # applied at submit (spill eligibility keys on it)
+                    kw2.setdefault("deadline_phases",
+                                   args.deadline_phases)
+                eng.submit(r[0], r[1], **kw2)
+                k += 1
+                eng.client_state["batch_cursor"] = k
+            eng.step()
+            flush_ledger()
+        if stop.requested:
+            # graceful shutdown: the final coordinated snapshot IS
+            # the zero-downtime restart state (coordinator + worker
+            # siblings), kept on disk for the restart to resume
+            if args.checkpoint:
+                eng.snapshot()
+            tel.event("graceful_shutdown",
+                      signal=stop.signal_name or "signal",
+                      phase=eng.phase, pending=eng.pending,
+                      completed=len(eng.completed))
+        span.close(phases=eng.phase, completed=len(eng.completed),
+                   **({"terminated": stop.signal_name or "signal"}
+                      if stop.requested else {}))
+        return eng
+
+    supervisor = None
+    try:
+        stop.__enter__()
+        if supervise:
+            def resize_fn(exc):
+                eng_box["eng"].recover_host_loss(exc)
+                loop_state["recovered"] = True
+                return serve_loop
+
+            supervisor = Supervisor(
+                serve_loop, resize_fn=resize_fn,
+                deadline=args.watchdog, telemetry=tel,
+                backoff_base=0.25, backoff_cap=30.0)
+            supervisor.run()
+        else:
+            serve_loop()
+        wall = time.perf_counter() - t0
+        flush_ledger()
+        eng = eng_box["eng"]
+        res = eng.result(wall_s=wall)
+        if args.checkpoint and not stop.requested:
+            # a graceful shutdown KEEPS its snapshot — that file IS
+            # the zero-downtime restart state; only a drained run
+            # clears it
+            eng.clear_snapshot()
+        summary = {
+            "summary": True, "engine": args.engine,
+            "family": args.family, "eps": args.eps,
+            "rule": args.rule, "slots": args.slots,
+            "processes": int(args.processes),
+            "manifest": eng.manifest.identity(),
+            "completed": len(res.completed), "phases": res.phases,
+            "wall_s": round(wall, 3),
+            "requests_per_sec": round(res.requests_per_sec, 3),
+            "latency": res.latency_percentiles(),
+            "latency_by_class": res.class_latency_percentiles(),
+            "tenants": res.tenant_summary(),
+            "shed": len(res.shed),
+            # the engine's summary carries the device-counted
+            # spillover task total on top of the record counts
+            "spillover": eng.spillover_summary(),
+            "redeal_walls_s": [round(w, 4)
+                               for w in eng.redeal_walls],
+            "totals": res.totals,
+        }
+        if res.shed:
+            reasons = {}
+            for s in res.shed:
+                reasons[s.reason] = reasons.get(s.reason, 0) + 1
+            summary["shed_reasons"] = reasons
+        if stop.requested:
+            summary["terminated"] = stop.signal_name or "signal"
+        failed = sum(1 for c in res.completed if c.failed)
+        if quarantine or failed:
+            summary["failed"] = failed
+        if supervisor is not None:
+            summary["supervised"] = True
+            summary["attempts"] = supervisor.attempts
+            summary["recoveries"] = [
+                {"kind": k, "action": a}
+                for k, a in supervisor.recoveries]
+        if injector is not None:
+            summary["faults_injected"] = [
+                ev.describe() for ev in injector.plan.events
+                if ev.fired]
+        print(json.dumps(summary))
+        return 0
+    finally:
+        stop.__exit__()
+        eng_box["eng"].close()
+        tel.close()
 
 
 def _main_2d(args) -> int:
@@ -1193,6 +1517,12 @@ def _dispatch(args) -> int:
     if cfg.backend == Backend.MPI:
         from ppls_tpu.backends import run_mpi
         res = run_mpi(cfg, n_workers=args.n_workers)
+    elif cfg.backend == Backend.SPILLOVER:
+        # round 18: the off-mesh arm — pure-f64 bag rounds pinned to
+        # the host CPU device (the same executor the stream engines
+        # shed overload to)
+        from ppls_tpu.backends import run_spillover_single
+        res = run_spillover_single(cfg)
     elif args.engine == "host":
         from ppls_tpu.runtime.host_frontier import integrate
 

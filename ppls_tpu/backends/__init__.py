@@ -1,9 +1,13 @@
-"""Backend dispatch: ``backend={jax, mpi}`` (SURVEY.md §7 step 6).
+"""Backend dispatch: ``backend={jax, mpi, spillover}`` (SURVEY.md §7
+step 6; round 18 adds the off-mesh arm).
 
 The JAX backend is this package. The MPI backend runs our C farmer/worker
 program (an original implementation of the reference's design,
 ``aquadPartA.c:125-208``) for behavioral parity — gated on an MPI
-toolchain being present.
+toolchain being present. The SPILLOVER backend (round 18) runs
+pure-f64 bag rounds pinned to the host CPU — the slower-but-correct
+capacity a degraded or overloaded cluster sheds load to before it
+sheds requests (``backends/spillover.py``).
 """
 
 from ppls_tpu.backends.mpi_backend import (
@@ -13,5 +17,12 @@ from ppls_tpu.backends.mpi_backend import (
     run_mpi,
     run_seq,
 )
+from ppls_tpu.backends.spillover import (
+    SpilloverExecutor,
+    run_spillover_single,
+    spillover_available,
+)
 
-__all__ = ["build_mpi", "build_seq", "mpi_available", "run_mpi", "run_seq"]
+__all__ = ["build_mpi", "build_seq", "mpi_available", "run_mpi",
+           "run_seq", "SpilloverExecutor", "run_spillover_single",
+           "spillover_available"]

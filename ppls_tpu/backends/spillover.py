@@ -1,0 +1,158 @@
+"""CPU spillover backend (round 18): slower-but-correct off-mesh
+capacity behind the ``backend={jax, mpi, spillover}`` dispatch shim.
+
+The reference farmer has exactly one answer to overload: the bag grows
+until memory runs out (``aquadPartA.c:133``). Round 16 gave this
+reproduction an explicit answer — shed with a record — and round 18
+adds the step BEFORE shedding: a degraded or overloaded cluster first
+sheds load to the host CPU, where a request runs as PURE-F64 BAG
+ROUNDS (``parallel.bag_engine``, the engines' reference twin) pinned
+to the host ``cpu`` backend via ``jax.default_device``. On this
+container that is the same silicon through a different code path; on
+a TPU host it is genuinely off-mesh — chips stay saturated while
+drained tails and overload bursts run beside them.
+
+Correctness contract: the spillover path IS the pure-f64 bag engine,
+so its per-request areas meet the engines' documented contract —
+BIT-IDENTICAL to the streaming engine's pure-f64 (``f64_rounds``)
+mode on dyadic workloads, within the ~1e-9 ds-schedule contract
+against the ds walker (tests pin both). Engagement is device-counted
+(the bag engine's own task counters) and attribution-reported:
+``ppls_spillover_requests_total`` / ``ppls_spillover_tasks_total``
+plus the ``spillover=True`` marker on every completed record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ppls_tpu.config import QuadConfig, Rule
+
+
+def _cpu_device():
+    """The host CPU device, or None when this jax build exposes no cpu
+    backend (spillover is then unavailable and callers shed instead)."""
+    import jax
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:
+        return None
+
+
+def spillover_available() -> bool:
+    return _cpu_device() is not None
+
+
+class SpilloverExecutor:
+    """Runs one request at a time through the pure-f64 bag engine on
+    the host CPU. Host-side boundary machinery: the engines call
+    :meth:`run` only at phase boundaries (the same discipline as every
+    other boundary hook), and every run's device-counted task total
+    accumulates into the registry."""
+
+    def __init__(self, family: str, eps: float,
+                 rule: Rule = Rule.TRAPEZOID,
+                 chunk: int = 1 << 10, capacity: int = 1 << 16,
+                 telemetry=None):
+        from ppls_tpu.models.integrands import get_family
+        self.family = family
+        self.f_theta = get_family(family)
+        self.eps = float(eps)
+        self.rule = Rule(rule)
+        # cap the host-CPU bag chunk regardless of the engine's chunk
+        # sizing (one policy for every caller): spillover runs beside
+        # the mesh engine, never with its device-sized programs
+        self.chunk = min(int(chunk), 1 << 12)
+        self.capacity = int(capacity)
+        self.device = _cpu_device()
+        if self.device is None:
+            raise RuntimeError(
+                "spillover requested but this jax build exposes no "
+                "cpu backend")
+        self.requests_total = 0
+        self.tasks_total = 0
+        self.wall_total = 0.0
+        self._c_req = self._c_tasks = None
+        if telemetry is not None:
+            self._c_req = telemetry.registry.counter(
+                "ppls_spillover_requests_total",
+                "requests completed on the CPU spillover backend")
+            self._c_tasks = telemetry.registry.counter(
+                "ppls_spillover_tasks_total",
+                "device-counted bag tasks executed by the CPU "
+                "spillover backend")
+
+    def run(self, theta, bounds: Tuple[float, float]
+            ) -> Tuple[list, int, float]:
+        """Integrate one request (scalar theta or a theta batch) to
+        completion off-mesh. Returns (per-theta areas, device-counted
+        tasks, wall seconds)."""
+        import jax
+
+        from ppls_tpu.parallel.bag_engine import integrate_family
+        thetas = (np.asarray(theta, dtype=np.float64).reshape(-1)
+                  if isinstance(theta, (tuple, list, np.ndarray))
+                  else np.array([float(theta)]))
+        t0 = time.perf_counter()
+        with jax.default_device(self.device):
+            res = integrate_family(
+                self.f_theta, thetas, bounds, self.eps,
+                rule=self.rule, chunk=self.chunk,
+                capacity=self.capacity)
+        wall = time.perf_counter() - t0
+        tasks = int(res.metrics.tasks)
+        self.requests_total += 1
+        self.tasks_total += tasks
+        self.wall_total += wall
+        if self._c_req is not None:
+            self._c_req.inc()
+            self._c_tasks.inc(tasks)
+        return [float(v) for v in np.asarray(res.areas)], tasks, wall
+
+
+@dataclasses.dataclass
+class SpilloverRunResult:
+    """Result shim for the single-integral CLI dispatch arm (the same
+    attribute surface ``__main__._dispatch`` prints for every other
+    backend)."""
+
+    area: float
+    exact: Optional[float]
+    metrics: object
+
+    @property
+    def global_error(self) -> Optional[float]:
+        if self.exact is None:
+            return None
+        return abs(self.area - self.exact)
+
+
+def run_spillover_single(config: QuadConfig) -> SpilloverRunResult:
+    """``--backend spillover``: run one ``QuadConfig`` problem as
+    pure-f64 bag rounds pinned to the host CPU — the off-mesh arm of
+    the dispatch shim, useful as a correctness cross-check and as the
+    smallest spelling of "this problem does not need the mesh"."""
+    import jax
+
+    from ppls_tpu.models.integrands import get_integrand
+    from ppls_tpu.parallel.bag_engine import integrate_family
+    entry = get_integrand(config.integrand)
+    dev = _cpu_device()
+    if dev is None:
+        raise RuntimeError(
+            "spillover backend requested but this jax build exposes "
+            "no cpu backend")
+    with jax.default_device(dev):
+        res = integrate_family(
+            lambda x, th: entry.fn(x), np.array([0.0]),
+            (config.a, config.b), config.eps, rule=Rule(config.rule),
+            chunk=min(config.capacity, 1 << 12),
+            capacity=config.capacity)
+    return SpilloverRunResult(
+        area=float(np.asarray(res.areas)[0]),
+        exact=entry.exact(config.a, config.b),
+        metrics=res.metrics)

@@ -33,10 +33,14 @@ class Backend(str, enum.Enum):
     JAX is the TPU-native path. MPI shells out to the compiled C
     farmer/worker binary (our own implementation, built only when an MPI
     toolchain exists) for parity runs against the reference design.
+    SPILLOVER (round 18) runs pure-f64 bag rounds pinned to the host
+    CPU — off-mesh, slower-but-correct; the same executor the stream
+    engines shed overload to before shedding requests.
     """
 
     JAX = "jax"
     MPI = "mpi"
+    SPILLOVER = "spillover"
 
 
 @dataclasses.dataclass(frozen=True)

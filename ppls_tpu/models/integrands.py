@@ -181,6 +181,20 @@ def _cosh4_scaled(x, th):
 register_family("cosh4_scaled", _cosh4_scaled)
 
 
+def _quad_scaled(x, th):
+    # round 18: a DYADIC-EXACT built-in family (theta * x^2). On
+    # dyadic bounds every trapezoid credit and sum is exactly
+    # representable, so per-request areas are schedule-independent to
+    # the bit — the family the multi-process determinism contracts
+    # (host-loss redeal, spillover parity, cross-topology resume) are
+    # asserted on. Registered in the PACKAGE (not a test module)
+    # because cluster WORKER SUBPROCESSES must resolve it too.
+    return th * x * x
+
+
+register_family("quad_scaled", _quad_scaled)
+
+
 # High-precision exact values for families, so the bench can report the
 # north-star metric pair (evals/sec/chip AND achieved abs error @ eps,
 # BASELINE.json). Host-side mpmath, never device math.
@@ -335,6 +349,19 @@ register_family_exact("cosh4_scaled", _cosh4_scaled_exact,
                       vec=_cosh4_scaled_exact_vec)
 
 
+def _quad_scaled_exact(a, b, th):
+    return float(th) * (float(b) ** 3 - float(a) ** 3) / 3.0
+
+
+def _quad_scaled_exact_vec(a, b, th):
+    th = np.asarray(th, dtype=np.float64)
+    return th * (np.float64(b) ** 3 - np.float64(a) ** 3) / 3.0
+
+
+register_family_exact("quad_scaled", _quad_scaled_exact,
+                      vec=_quad_scaled_exact_vec)
+
+
 # --- double-single counterparts for the Pallas walker kernel --------------
 # (fence-free ds arithmetic; see ops/ds_kernel.py and parallel/walker.py)
 
@@ -438,6 +465,12 @@ def _sin_scaled_ds(x, th, dsm=None):
     return dsm.ds_sin(dsm.ds_mul(th, x))
 
 
+def _quad_scaled_ds(x, th, dsm=None):
+    if dsm is None:
+        from ppls_tpu.ops import ds_kernel as dsm
+    return dsm.ds_mul(th, dsm.ds_mul(x, x))
+
+
 def _gauss_center_ds(x, c, dsm=None):
     # exp(-0.5 ((x-c)/1e-3)^2) = exp(-500000 (x-c)^2); the scale is an
     # integer < 2^24, exact in f32.
@@ -506,6 +539,9 @@ register_family_ds("sin_scaled", _sin_scaled_ds,
 register_family_ds("gauss_center", _gauss_center_ds)
 register_family_ds("cosh4_scaled", _cosh4_scaled_ds,
                    domain_check=_cosh4_scaled_domain)
+# quad_scaled is pure ds arithmetic (mul only — no transcendental, no
+# range limit): every (bounds, theta) is in-domain, no check needed
+register_family_ds("quad_scaled", _quad_scaled_ds)
 
 
 # --- round-12 range-reduced ds twins --------------------------------------

@@ -49,10 +49,26 @@ class ChipFlightRecorder:
     def __init__(self, telemetry: Telemetry, n_dev: int,
                  engine: str = "walker-dd-stream",
                  straggler_share: Optional[float] = None,
-                 straggler_phases: int = 3):
+                 straggler_phases: int = 3,
+                 span_name: str = "chip",
+                 labels=None):
         self.tel = telemetry
         self.n_dev = int(n_dev)
         self.engine = engine
+        # round 18: the cluster coordinator reuses this recorder at
+        # PROCESS granularity — one "process" child span per worker
+        # under each cluster phase span, same attribution machinery.
+        # ``labels`` maps positional index -> reported unit id: after
+        # a host loss the surviving worker keeps its REAL process_id
+        # in the timeline instead of being renumbered to the id the
+        # timeline just recorded as killed.
+        self.span_name = str(span_name)
+        self.labels = (list(labels) if labels is not None
+                       else list(range(self.n_dev)))
+        if len(self.labels) != self.n_dev:
+            raise ValueError(
+                f"labels must have one entry per unit: "
+                f"{len(self.labels)} != {self.n_dev}")
         # default threshold: 2x the fair share, capped below 1 so a
         # 2-chip mesh can still trip it
         self.straggler_share = (float(straggler_share)
@@ -98,12 +114,14 @@ class ChipFlightRecorder:
             if waste is not None:
                 for k, v in zip(WASTE_BUCKETS, waste[chip]):
                     attrs[k] = int(v)
-            # one child span per chip under the open phase span: open
-            # and close back-to-back — the chip's "duration" is not
-            # host-measurable (chips run inside one device program),
-            # the span exists to carry the attribution attrs in a
-            # shape timeline viewers nest correctly
-            tel.span("chip", chip=chip).close(
+            # one child span per chip/process under the open phase
+            # span: open and close back-to-back — the unit's
+            # "duration" is not host-measurable (chips run inside one
+            # device program; worker phases overlap), the span exists
+            # to carry the attribution attrs in a shape timeline
+            # viewers nest correctly
+            tel.span(self.span_name,
+                     **{self.span_name: self.labels[chip]}).close(
                 **{k: v for k, v in attrs.items() if k != "chip"})
         if crounds:
             tel.event("collective_boundary", phase=int(phase),
@@ -132,7 +150,8 @@ class ChipFlightRecorder:
                 self._streak[chip] = 0
             if self._streak[chip] >= self.straggler_phases:
                 self._c_straggler.inc()
-                tel.event("straggler", chip=chip, phase=int(phase),
+                tel.event("straggler", chip=self.labels[chip],
+                          phase=int(phase),
                           share=round(share, 4),
                           phases=self._streak[chip],
                           threshold=round(self.straggler_share, 4))

@@ -245,7 +245,8 @@ def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
 
 
 def load_family_checkpoint(path: str, identity: dict, *,
-                           mesh_resize: bool = False):
+                           mesh_resize: bool = False,
+                           cluster_resize: bool = False):
     """Returns (bag_cols, count, acc, totals); raises ValueError when
     the snapshot belongs to a different problem identity and
     :class:`CheckpointCorruptError` when the payload fails its
@@ -258,6 +259,15 @@ def load_family_checkpoint(path: str, identity: dict, *,
     per-chip sizing — must still match exactly; the caller owns
     re-dealing the per-chip state onto the new mesh
     (``mesh.host_strided_redeal``).
+
+    ``cluster_resize=True`` (round 18) is the PROCESS-level twin: the
+    stored identity may additionally differ in the ``cluster``
+    manifest key (a coordinator snapshot taken on an n-process
+    cluster resuming onto m != n processes). Cross-topology resume is
+    therefore always DELIBERATE — the manifest rides the identity, so
+    a different topology refuses by default and the caller that opts
+    in owns the request-granularity redeal
+    (``cluster.ClusterStreamEngine.resume``).
     """
     try:
         with np.load(path) as z:
@@ -277,7 +287,12 @@ def load_family_checkpoint(path: str, identity: dict, *,
         diff = {k: (stored.get(k), identity.get(k))
                 for k in set(stored) | set(identity)
                 if stored.get(k) != identity.get(k)}
-        if not (mesh_resize and set(diff) == {"n_dev"}):
+        allowed = set()
+        if mesh_resize:
+            allowed.add("n_dev")
+        if cluster_resize:
+            allowed.add("cluster")
+        if not (allowed and set(diff) <= allowed):
             raise ValueError(
                 f"checkpoint {path!r} belongs to a different run; "
                 f"refusing to blend (stored vs requested): {diff}")

@@ -1,0 +1,1600 @@
+"""Multi-process distributed runtime (round 18): real worker
+processes behind one coordinator, surviving-host discovery, and a
+cluster manifest on the checkpoint identity.
+
+The reference program's whole design is N-1 workers surviving behind
+one farmer over MPI ranks (``aquadPartA.c:92-105``); until round 18
+this reproduction ran every "chip" inside one process — the round-14
+recovery story (seeded faults, elastic mesh-resize resume, supervisor)
+never faced a real process dying. This module promotes the streaming
+service to MULTI-PROCESS execution:
+
+* **Bootstrap** — :func:`init_distributed` is the ``jax.distributed.
+  initialize`` code path a TPU pod takes (coordinator address, process
+  count, process id; afterwards ``jax.devices()`` spans processes
+  while ``jax.local_devices()`` is this host's slice). On THIS
+  container it is exercised by the opt-in ``PPLS_JAX_DISTRIBUTED=1``
+  worker flag and a dedicated bootstrap test: the jax coordination
+  service runs fine on CPU, but cross-process COMPUTATIONS do not
+  (jaxlib 0.4.36: "Multiprocess computations aren't implemented on
+  the CPU backend", verified empirically) — and a dead peer must not
+  cascade through the coordination-service heartbeat while the
+  supervisor is mid-recovery. So the local cluster keeps the flag off
+  by default and the compiled programs HOST-LOCAL by construction:
+  each worker runs its own engine over its own local devices (the
+  host-local root banks, with the phase-boundary occupancy psum of
+  the dd engine unchanged — graftlint GL07 pins that census), and the
+  cross-process exchange happens at phase boundaries through the
+  coordinator socket protocol — the farmer/worker shape of the
+  reference, at request granularity.
+* **Coordinator-held manifest** — :class:`ClusterManifest` records
+  process -> devices as the workers report it at hello, joins the
+  coordinator checkpoint identity as the ``cluster`` key, and makes
+  cross-topology resume DELIBERATE: resuming an n-process snapshot on
+  m != n processes refuses unless ``cluster_resize=True`` (the
+  round-14 ``mesh_resize`` rule's process-level twin).
+* **Surviving-host discovery** — on process loss (a step RPC hits a
+  dead socket) the coordinator raises :class:`guard.HostLossError`;
+  the supervisor's ``host_loss`` arm calls
+  :meth:`ClusterStreamEngine.recover_host_loss`, which DISCOVERS the
+  surviving topology by pinging every worker (instead of being handed
+  a hand-built smaller mesh), updates the manifest, and re-deals the
+  lost host's outstanding requests onto the survivors through the
+  existing ``mesh.host_strided_redeal`` deal rule. Requests are the
+  unit of cross-host state (bag rows never migrate across process
+  boundaries; within a host, chip loss keeps the round-14 row-level
+  redeal), so a replayed request's area is the schedule-independent
+  per-request contract: BIT-IDENTICAL on dyadic workloads, ~1e-9 with
+  the ds walker engaged.
+* **Consistency / zero lost acks** — the coordinator LEDGER holds
+  every submitted request payload, its assignment, and its outcome;
+  snapshots are a coordinated cut (workers snapshot at the boundary,
+  then the coordinator). On resume the coordinator ADOPTS worker-
+  reported completions newer than its own snapshot and re-submits
+  anything a worker lost (fresh or corrupt snapshot), so every
+  acknowledged rid ends in exactly one of completed/shed/spillover.
+  A CORRUPT snapshot on one host is recoverable by construction: the
+  worker reports it, starts fresh, and replays its share from the
+  ledger — it never poisons the cluster.
+* **CPU spillover** — with ``spillover=True`` the coordinator sheds
+  load to the slower-but-correct host-CPU backend
+  (``backends.spillover``) before shedding requests: queue overflow
+  victims without a deadline run as pure-f64 bag rounds off-mesh,
+  device-counted (``ppls_spillover_tasks_total``) and attribution-
+  reported (``spillover=True`` on the completed record).
+
+Worker protocol: newline-delimited JSON over a localhost TCP socket
+(``hello`` at connect; then ``state`` / ``submit`` / ``step`` /
+``snapshot`` / ``ping`` / ``exit`` commands). Workers are spawned as
+``python -m ppls_tpu.runtime.cluster --connect HOST:PORT ...``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ppls_tpu.config import Rule
+from ppls_tpu.runtime.guard import HostLossError
+
+# worker engine kwargs the coordinator forwards verbatim (everything
+# else in the spec is cluster plumbing)
+_WORKER_ENGINE_KEYS = (
+    "rule", "slots", "chunk", "capacity", "lanes", "roots_per_lane",
+    "refill_slots", "seg_iters", "max_segments", "min_active_frac",
+    "f64_rounds", "scout_dtype", "double_buffer", "reduced_integrands",
+    "theta_block", "engine", "n_devices", "quarantine",
+)
+
+ENV_JAX_DISTRIBUTED = "PPLS_JAX_DISTRIBUTED"
+
+
+def init_distributed(coordinator_address: str, num_processes: int,
+                     process_id: int) -> dict:
+    """The ``jax.distributed.initialize`` bootstrap — the code path a
+    TPU pod takes verbatim. Returns the local/global device picture
+    this process sees afterwards (the manifest row it reports).
+
+    On the CPU container the coordination service works (global device
+    enumeration spans processes) but cross-process computations are
+    not implemented by the backend — the local cluster therefore keeps
+    its compiled programs host-local and uses this only when opted in
+    (``PPLS_JAX_DISTRIBUTED=1``), which is also what the bootstrap
+    test exercises.
+    """
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes), process_id=int(process_id))
+    return {
+        "process_id": int(jax.process_index()),
+        "local_devices": int(jax.local_device_count()),
+        "global_devices": int(jax.device_count()),
+        "platform": str(jax.default_backend()),
+    }
+
+
+@dataclasses.dataclass
+class ClusterManifest:
+    """Coordinator-held process -> devices map, reported by each
+    worker at hello. ``identity()`` is the checkpoint-identity face:
+    resuming under a different manifest refuses unless the caller
+    passes ``cluster_resize=True`` (cross-topology resume is
+    deliberate, never accidental)."""
+
+    processes: List[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def n_processes(self) -> int:
+        return len(self.processes)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return sorted(int(p["process_id"]) for p in self.processes)
+
+    def identity(self) -> dict:
+        """The compact identity form: process count + per-process
+        device counts in process-id order. Host names/pids are
+        deliberately excluded — a restart on new pids of the SAME
+        topology is the same cluster."""
+        rows = sorted(self.processes,
+                      key=lambda p: int(p["process_id"]))
+        return {"processes": len(rows),
+                "devices": [int(p.get("devices", 1)) for p in rows]}
+
+    def drop(self, process_id: int) -> None:
+        self.processes = [p for p in self.processes
+                          if int(p["process_id"]) != int(process_id)]
+
+    def describe(self) -> dict:
+        return {"processes": [dict(p) for p in self.processes]}
+
+
+# ---------------------------------------------------------------------------
+# socket plumbing (newline-delimited JSON, both directions)
+# ---------------------------------------------------------------------------
+
+class _SockIO:
+    def __init__(self, conn: socket.socket):
+        self.conn = conn
+        self._rfile = conn.makefile("rb")
+
+    def send(self, obj: dict) -> None:
+        self.conn.sendall(json.dumps(obj).encode("utf-8") + b"\n")
+
+    def recv(self, timeout: Optional[float] = None) -> dict:
+        self.conn.settimeout(timeout)
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("peer closed the connection")
+        return json.loads(line.decode("utf-8"))
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        except OSError:
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+def _worker_build_engine(spec: dict, telemetry):
+    """Build (or resume) the worker-local StreamEngine. A corrupt
+    snapshot is RECOVERABLE here: report it, discard the file, start
+    fresh — the coordinator replays this worker's share from its
+    ledger (the cluster is never poisoned by one host's disk)."""
+    from ppls_tpu.runtime.checkpoint import CheckpointCorruptError
+    from ppls_tpu.runtime.stream import StreamEngine
+    kw = {k: spec[k] for k in _WORKER_ENGINE_KEYS if k in spec}
+    if "rule" in kw:
+        kw["rule"] = Rule(kw["rule"])
+    ckpt = spec.get("checkpoint_path")
+    corrupt = None
+    if ckpt and os.path.exists(ckpt):
+        try:
+            eng = StreamEngine.resume(
+                ckpt, spec["family"], float(spec["eps"]),
+                telemetry=telemetry, checkpoint_every=1 << 30, **kw)
+            return eng, True, None
+        except CheckpointCorruptError as e:
+            corrupt = str(e)[:300]
+            os.unlink(ckpt)
+    eng = StreamEngine(spec["family"], float(spec["eps"]),
+                       checkpoint_path=ckpt, checkpoint_every=1 << 30,
+                       telemetry=telemetry, **kw)
+    return eng, False, corrupt
+
+
+def _worker_state(eng) -> dict:
+    """The worker's resume-relevant state: outstanding global rids
+    (pending + resident), completed records, and shed records (a
+    worker-side deadline shed is a terminal outcome the coordinator
+    must adopt, or its ledger entry stays 'dealt' forever) — the
+    coordinator reconciles these against its own (possibly older)
+    ledger."""
+    gmap = {int(k): int(v)
+            for k, v in eng.client_state.get("gmap", {}).items()}
+    outstanding = sorted(
+        gmap[r.rid] for r in eng._pending if r.rid in gmap)
+    outstanding += sorted(
+        gmap[r.rid] for r in eng._slot_req.values() if r.rid in gmap)
+    done = []
+    for c in eng.completed:
+        if c.rid not in gmap:
+            continue
+        done.append(_retired_record(c, gmap[c.rid]))
+    shed = [_shed_record(s, gmap[s.rid]) for s in eng.shed
+            if s.rid in gmap]
+    return {"outstanding": sorted(outstanding), "completed": done,
+            "shed": shed}
+
+
+def _shed_record(s, grid: int) -> dict:
+    return {"grid": int(grid), "reason": s.reason,
+            "tenant": s.tenant, "priority": int(s.priority)}
+
+
+def _retired_record(c, grid: int) -> dict:
+    return {
+        "grid": int(grid),
+        "area": (None if c.failed else float(c.area)),
+        "areas": ([float(v) for v in c.areas]
+                  if (c.areas is not None and not c.failed) else None),
+        "failed": bool(c.failed), "failure": c.failure,
+        "tenant": c.tenant, "priority": int(c.priority),
+    }
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    """Entry point of one cluster worker process."""
+    import argparse
+    p = argparse.ArgumentParser(prog="ppls_tpu.runtime.cluster")
+    p.add_argument("--connect", required=True, metavar="HOST:PORT")
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--spec", required=True,
+                   help="engine spec: inline JSON or @file.json")
+    p.add_argument("--jax-coordinator", default=None,
+                   help="jax.distributed coordinator address; arms "
+                        "init_distributed (the TPU-pod bootstrap)")
+    p.add_argument("--num-processes", type=int, default=None)
+    args = p.parse_args(argv)
+
+    spec = args.spec
+    if spec.startswith("@"):
+        with open(spec[1:], encoding="utf-8") as fh:
+            spec = fh.read()
+    spec = json.loads(spec)
+
+    dist_info = None
+    if args.jax_coordinator and args.num_processes:
+        if os.environ.get("_PPLS_DIST_BOOTED") == "1":
+            # the -c boot shim already ran jax.distributed.initialize
+            # (it MUST precede the package import — ppls_tpu's import
+            # surface executes jax computations); just report
+            import jax
+            dist_info = {
+                "process_id": int(jax.process_index()),
+                "local_devices": int(jax.local_device_count()),
+                "global_devices": int(jax.device_count()),
+                "platform": str(jax.default_backend()),
+            }
+        else:
+            dist_info = init_distributed(
+                args.jax_coordinator, args.num_processes,
+                args.process_id)
+
+    import jax
+
+    from ppls_tpu.obs import Telemetry
+    from ppls_tpu.utils.compile_cache import enable_compile_cache
+    # workers are short-lived fresh processes: the persistent cache is
+    # what keeps the per-spawn compile cost to a warm replay (the
+    # pure-f64 engine programs are XLA-only, which the cache replays
+    # across processes — see utils/compile_cache.py's measurements)
+    enable_compile_cache()
+    tel = Telemetry()
+    eng, resumed, corrupt = _worker_build_engine(spec, tel)
+    eng.client_state.setdefault("gmap", {})
+
+    host, port = args.connect.rsplit(":", 1)
+    conn = socket.create_connection((host, int(port)), timeout=60)
+    io = _SockIO(conn)
+    hello = {
+        "hello": True, "process_id": int(args.process_id),
+        "pid": os.getpid(),
+        "devices": int(jax.local_device_count()),
+        "platform": str(jax.default_backend()),
+        "resumed": bool(resumed),
+    }
+    if corrupt:
+        hello["corrupt"] = corrupt
+    if dist_info:
+        hello["jax_distributed"] = dist_info
+    hello.update(_worker_state(eng))
+    io.send(hello)
+
+    while True:
+        try:
+            cmd = io.recv(timeout=None)
+        except (ConnectionError, OSError):
+            return 0                    # coordinator went away
+        try:
+            reply = _worker_dispatch(eng, cmd)
+        except Exception as e:  # noqa: BLE001 — shipped to coordinator
+            reply = {"error": f"{e}"[:500],
+                     "etype": type(e).__name__}
+        io.send(reply)
+        if cmd.get("cmd") == "exit":
+            io.close()
+            return 0
+
+
+def _worker_dispatch(eng, cmd: dict) -> dict:
+    kind = cmd.get("cmd")
+    if kind == "ping":
+        return {"ok": True, "phase": int(eng.phase)}
+    if kind == "state":
+        return dict(_worker_state(eng), ok=True)
+    if kind == "exit":
+        return {"ok": True}
+    if kind == "snapshot":
+        eng.snapshot()
+        return {"ok": True}
+    if kind == "submit":
+        gmap = eng.client_state["gmap"]
+        for r in cmd["reqs"]:
+            rid = eng.submit(
+                (tuple(r["theta"]) if isinstance(r["theta"], list)
+                 else float(r["theta"])),
+                tuple(r["bounds"]), tenant=r.get("tenant", "default"),
+                priority=int(r.get("priority", 1)),
+                deadline_phases=r.get("deadline_phases"))
+            gmap[str(rid)] = int(r["grid"])
+        return {"ok": True, "accepted": len(cmd["reqs"])}
+    if kind == "step":
+        gmap = {int(k): int(v)
+                for k, v in eng.client_state["gmap"].items()}
+        n0 = eng.phase_rows_len()
+        s0 = len(eng.shed)
+        retired = eng.step()
+        # an idle phase appends no row — report zeros, not the stale
+        # previous phase's deltas
+        row = (eng.last_phase_row()
+               if eng.phase_rows_len() > n0 else None)
+        return {
+            "ok": True, "phase": int(eng.phase),
+            "retired": [_retired_record(c, gmap[c.rid])
+                        for c in retired if c.rid in gmap],
+            "shed": [_shed_record(s, gmap[s.rid])
+                     for s in eng.shed[s0:] if s.rid in gmap],
+            "pending": int(eng.pending),
+            "resident": int(eng.resident),
+            "live": int(row["live_tasks"]) if row else 0,
+            "tasks": int(row["tasks"]) if row else 0,
+            "wtasks": int(row["wtasks"]) if row else 0,
+            "wsteps": int(row["wsteps"]) if row else 0,
+            "idle": bool(eng.idle),
+        }
+    raise ValueError(f"unknown worker command {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# coordinator side
+# ---------------------------------------------------------------------------
+
+# The worker boot shim: ``jax.distributed.initialize`` must run BEFORE
+# the first jax computation, and importing ``ppls_tpu`` (which a
+# ``python -m ppls_tpu.runtime.cluster`` spelling does first) already
+# executes some — so distributed workers boot through ``-c``, where
+# the initialize happens against a bare ``import jax`` and the package
+# import follows. Non-distributed workers take the same shim (one
+# spawn path) with the initialize block skipped.
+_WORKER_BOOT = """\
+import os, sys
+args = sys.argv[1:]
+if "--jax-coordinator" in args:
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=args[args.index("--jax-coordinator") + 1],
+        num_processes=int(args[args.index("--num-processes") + 1]),
+        process_id=int(args[args.index("--process-id") + 1]))
+    os.environ["_PPLS_DIST_BOOTED"] = "1"
+from ppls_tpu.runtime.cluster import worker_main
+sys.exit(worker_main(args))
+"""
+
+
+class WorkerLost(ConnectionError):
+    """A worker RPC hit a dead process/socket; carries which one."""
+
+    def __init__(self, process_id: int, detail: str):
+        self.process_id = int(process_id)
+        super().__init__(
+            f"worker process {process_id} lost ({detail})")
+
+
+class WorkerHandle:
+    """One spawned worker: its Popen, socket, and manifest row."""
+
+    def __init__(self, process_id: int, proc: subprocess.Popen,
+                 io: _SockIO, hello: dict, rpc_timeout: float):
+        self.process_id = int(process_id)
+        self.proc = proc
+        self.io = io
+        self.hello = hello
+        self.rpc_timeout = float(rpc_timeout)
+
+    def send_cmd(self, obj: dict) -> None:
+        """Fire one command without reading the reply — the fan-out
+        half of a parallel RPC round (every worker computes its phase
+        concurrently; :meth:`recv_reply` collects in worker order)."""
+        try:
+            self.io.send(obj)
+        except (OSError, ConnectionError, ValueError) as e:
+            # a failed RPC poisons the request/reply pairing (a late
+            # reply would answer the NEXT command) — close the socket
+            # so discovery reaps this worker instead of resyncing
+            # against a desynchronized stream
+            self.io.close()
+            raise WorkerLost(self.process_id,
+                             f"{type(e).__name__}: {e}") from e
+
+    def recv_reply(self, timeout: Optional[float] = None) -> dict:
+        try:
+            reply = self.io.recv(timeout or self.rpc_timeout)
+        except (OSError, ConnectionError, ValueError) as e:
+            self.io.close()
+            raise WorkerLost(self.process_id,
+                             f"{type(e).__name__}: {e}") from e
+        if "error" in reply:
+            if reply.get("etype") == "FloatingPointError":
+                raise FloatingPointError(reply["error"])
+            raise RuntimeError(
+                f"worker {self.process_id}: {reply['error']}")
+        return reply
+
+    def call(self, obj: dict,
+             timeout: Optional[float] = None) -> dict:
+        self.send_cmd(obj)
+        return self.recv_reply(timeout)
+
+    def ping(self, timeout: float = 5.0) -> bool:
+        if self.proc.poll() is not None:
+            return False
+        try:
+            return bool(self.call({"cmd": "ping"},
+                                  timeout=timeout).get("ok"))
+        except WorkerLost:
+            return False
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+
+    def close(self, graceful: bool = True) -> None:
+        if graceful and self.proc.poll() is None:
+            try:
+                self.call({"cmd": "exit"}, timeout=10)
+            except WorkerLost:
+                pass
+        self.io.close()
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+def _spawn_workers(n_processes: int, spec: dict, base_ckpt,
+                   spawn_timeout: float, rpc_timeout: float,
+                   jax_distributed: bool,
+                   process_ids: Optional[List[int]] = None
+                   ) -> List[WorkerHandle]:
+    """Spawn + handshake ``n_processes`` workers. Every worker gets
+    the shared engine spec plus its own checkpoint path (sibling files
+    of the coordinator snapshot: ``<path>.p<process_id>``)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(n_processes)
+    addr = f"127.0.0.1:{srv.getsockname()[1]}"
+    ids = (list(process_ids) if process_ids is not None
+           else list(range(n_processes)))
+    jax_coord = None
+    if jax_distributed:
+        # workers form their own jax.distributed cluster: process 0's
+        # service port, allocated here so every worker gets the same
+        # address before any of them starts
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        jax_coord = f"127.0.0.1:{probe.getsockname()[1]}"
+        probe.close()
+    procs = {}
+    try:
+        for pid_ in ids:
+            wspec = dict(spec)
+            if base_ckpt:
+                wspec["checkpoint_path"] = f"{base_ckpt}.p{pid_}"
+            cmd = [sys.executable, "-c", _WORKER_BOOT,
+                   "--connect", addr, "--process-id", str(pid_),
+                   "--spec", json.dumps(wspec)]
+            if jax_coord is not None:
+                cmd += ["--jax-coordinator", jax_coord,
+                        "--num-processes", str(n_processes)]
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            # workers must resolve ppls_tpu regardless of the
+            # coordinator's cwd (the -c shim has no script dir on
+            # sys.path): prepend the repo root this package loaded
+            # from
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            env["PYTHONPATH"] = os.pathsep.join(
+                [pkg_root] + ([env["PYTHONPATH"]]
+                              if env.get("PYTHONPATH") else []))
+            procs[pid_] = subprocess.Popen(
+                cmd, stdout=subprocess.DEVNULL, env=env)
+        handles = {}
+        # short accept timeout so a worker that DIES during boot (a
+        # bad spec, an unresumable per-process snapshot) fails the
+        # bootstrap immediately instead of hanging out the full
+        # spawn budget
+        srv.settimeout(2.0)
+        deadline = time.monotonic() + spawn_timeout
+        while len(handles) < len(ids):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"cluster bootstrap: only {len(handles)} of "
+                    f"{len(ids)} workers connected within "
+                    f"{spawn_timeout:.0f}s")
+            dead = [k for k, pr in procs.items()
+                    if k not in handles and pr.poll() is not None]
+            if dead:
+                raise RuntimeError(
+                    f"cluster bootstrap: worker process(es) {dead} "
+                    f"exited before handshaking (exit codes "
+                    f"{[procs[k].returncode for k in dead]}); "
+                    f"check the worker spec / per-process snapshots")
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            io = _SockIO(conn)
+            hello = io.recv(timeout=spawn_timeout)
+            k = int(hello["process_id"])
+            handles[k] = WorkerHandle(k, procs[k], io, hello,
+                                      rpc_timeout)
+        return [handles[k] for k in sorted(handles)]
+    except BaseException:
+        for pr in procs.values():
+            if pr.poll() is None:
+                pr.kill()
+        raise
+    finally:
+        srv.close()
+
+
+@dataclasses.dataclass
+class _LedgerEntry:
+    """One submitted request in the coordinator ledger: the payload
+    (enough to re-submit anywhere), its assignment, and its state."""
+
+    grid: int
+    theta: object
+    bounds: Tuple[float, float]
+    tenant: str
+    priority: int
+    deadline_phases: Optional[int]
+    submit_phase: int
+    submit_t: float
+    assigned: Optional[int] = None        # process_id, None = undealt
+    state: str = "pending"      # pending | dealt | spill | done | shed
+
+    def payload(self) -> dict:
+        return {"grid": self.grid,
+                "theta": (list(self.theta)
+                          if isinstance(self.theta, (tuple, list))
+                          else self.theta),
+                "bounds": list(self.bounds), "tenant": self.tenant,
+                "priority": self.priority,
+                "deadline_phases": self.deadline_phases}
+
+    @classmethod
+    def from_payload(cls, d: dict, submit_phase: int = 0) -> \
+            "_LedgerEntry":
+        th = d["theta"]
+        return cls(grid=int(d["grid"]),
+                   theta=(tuple(th) if isinstance(th, list)
+                          else float(th)),
+                   bounds=tuple(d["bounds"]),
+                   tenant=d.get("tenant", "default"),
+                   priority=int(d.get("priority", 1)),
+                   deadline_phases=d.get("deadline_phases"),
+                   submit_phase=int(d.get("submit_phase",
+                                          submit_phase)),
+                   submit_t=time.perf_counter())
+
+
+class ClusterStreamEngine:
+    """Coordinator-side streaming engine over N worker processes.
+
+    The driving surface mirrors :class:`runtime.stream.StreamEngine`
+    (``submit`` / ``step`` / ``drain`` / ``run`` / ``result`` /
+    ``snapshot`` / ``resume``) so the serve CLI and the supervisor
+    drive either interchangeably. Requests deal round-robin over the
+    live process set in rid order (the deterministic deal), each
+    worker runs its own host-local engine, and the coordinator phase
+    is the cross-process boundary: deal -> step-all -> collect
+    retirements -> spillover -> checkpoint. The host-side sum of the
+    workers' live-row counts is the cross-process face of the dd
+    engine's occupancy psum (which itself stays process-local and
+    unchanged).
+    """
+
+    def __init__(self, family: str, eps: float, *,
+                 n_processes: int = 2,
+                 worker_kw: Optional[dict] = None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 8,
+                 telemetry=None, fault_injector=None,
+                 queue_limit: Optional[int] = None,
+                 spillover: bool = False,
+                 spillover_limit: int = 4,
+                 jax_distributed: bool = False,
+                 spawn_timeout: float = 180.0,
+                 rpc_timeout: float = 600.0,
+                 _defer_spawn: bool = False):
+        from ppls_tpu.models.integrands import get_family_ds
+        from ppls_tpu.obs import Telemetry
+        if n_processes < 1:
+            raise ValueError(
+                f"n_processes must be >= 1, got {n_processes}")
+        self.family = family
+        self.eps = float(eps)
+        self.worker_kw = dict(worker_kw or {})
+        self.rule = Rule(self.worker_kw.get("rule", Rule.TRAPEZOID))
+        self._f_ds = get_family_ds(family)
+        self.n_processes = int(n_processes)
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = max(int(checkpoint_every), 1)
+        self.telemetry = telemetry if telemetry is not None \
+            else Telemetry()
+        self.fault_injector = fault_injector
+        self.queue_limit = (None if queue_limit is None
+                            else int(queue_limit))
+        self.quarantine = bool(self.worker_kw.get("quarantine"))
+        self.spillover_limit = int(spillover_limit)
+        # the spill queue is BOUNDED (round-18 review): beyond ~8
+        # phases of spillover backlog the victim sheds with an
+        # explicit record — otherwise sustained deadline-less overload
+        # would re-grow the unbounded backlog queue_limit exists to
+        # prevent, just one hop downstream
+        self._spill_cap = 8 * max(self.spillover_limit, 1)
+        self._spill = None
+        if spillover:
+            from ppls_tpu.backends.spillover import SpilloverExecutor
+            self._spill = SpilloverExecutor(
+                family, self.eps, rule=self.rule,
+                chunk=int(self.worker_kw.get("chunk", 1 << 10)),
+                capacity=int(self.worker_kw.get("capacity", 1 << 16)),
+                telemetry=self.telemetry)
+        self.jax_distributed = bool(jax_distributed)
+        self._spawn_timeout = float(spawn_timeout)
+        self._rpc_timeout = float(rpc_timeout)
+
+        self.phase = 0
+        self._next_rid = 0
+        self._ledger: Dict[int, _LedgerEntry] = {}
+        self._pending: List[int] = []            # undealt grids
+        self._spill_queue: List[int] = []
+        self.completed: List = []
+        self.shed: List = []
+        self.client_state: dict = {}
+        self._tasks_total = 0
+        self._wtasks_total = 0
+        self._wsteps_total = 0
+        self.redeal_walls: List[float] = []
+        self._rr = 0
+        self._phases_after_recovery = 0
+        self._closed = False
+
+        if fault_injector is not None:
+            fault_injector.host_kill_fn = self.kill_process
+
+        self._workers: List[WorkerHandle] = []
+        if not _defer_spawn:
+            self._spawn(list(range(self.n_processes)))
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def _worker_spec(self) -> dict:
+        spec = {k: v for k, v in self.worker_kw.items()
+                if k in _WORKER_ENGINE_KEYS and v is not None}
+        if "rule" in spec:
+            spec["rule"] = str(Rule(spec["rule"]).value)
+        spec["family"] = self.family
+        spec["eps"] = self.eps
+        return spec
+
+    def _spawn(self, process_ids: List[int]) -> None:
+        self._workers = _spawn_workers(
+            len(process_ids), self._worker_spec(),
+            self.checkpoint_path, self._spawn_timeout,
+            self._rpc_timeout, self.jax_distributed,
+            process_ids=process_ids)
+        self.manifest = ClusterManifest([
+            {"process_id": w.process_id,
+             "devices": int(w.hello.get("devices", 1)),
+             "pid": int(w.hello.get("pid", 0)),
+             "platform": w.hello.get("platform", "cpu")}
+            for w in self._workers])
+        from ppls_tpu.obs.flight import ChipFlightRecorder
+        self._flight = ChipFlightRecorder(
+            self.telemetry, len(self._workers),
+            engine="cluster-stream", span_name="process",
+            labels=[w.process_id for w in self._workers])
+        self.telemetry.event(
+            "cluster_bootstrap",
+            processes=self.manifest.n_processes,
+            devices=self.manifest.identity()["devices"],
+            jax_distributed=self.jax_distributed)
+
+    def _live(self) -> List[WorkerHandle]:
+        return list(self._workers)
+
+    def _worker(self, process_id: int) -> Optional[WorkerHandle]:
+        for w in self._workers:
+            if w.process_id == int(process_id):
+                return w
+        return None
+
+    def kill_process(self, process_id: Optional[int] = None) -> None:
+        """SIGKILL one worker (the fault injector's host_loss hook —
+        the real-process spelling of losing a host). The loss
+        SURFACES at the next RPC, like a real dead host would."""
+        live = self._live()
+        if not live:
+            return
+        if process_id is None or process_id < 0 \
+                or self._worker(process_id) is None:
+            w = live[-1]
+        else:
+            w = self._worker(process_id)
+        self.telemetry.event("host_killed",
+                             process=w.process_id, phase=self.phase)
+        if w.proc.poll() is None:
+            os.kill(w.proc.pid, signal.SIGKILL)
+            w.proc.wait(timeout=30)
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, theta, bounds, tenant: str = "default",
+               priority: int = 1,
+               deadline_phases: Optional[int] = None) -> int:
+        from ppls_tpu.models.integrands import check_ds_domain
+        bounds = (float(bounds[0]), float(bounds[1]))
+        # the single-engine pre-rid validation surface, mirrored: a
+        # malformed request must be rejected HERE with a per-request
+        # ValueError, not crash a worker at deal time (where it would
+        # come back as a fatal whole-service RuntimeError)
+        theta_block = int(self.worker_kw.get("theta_block", 1) or 1)
+        if isinstance(theta, (tuple, list, np.ndarray)):
+            thetas = tuple(float(t)
+                           for t in np.asarray(theta).reshape(-1))
+            if not thetas:
+                raise ValueError("empty theta batch")
+            if len(thetas) > theta_block:
+                raise ValueError(
+                    f"theta batch of {len(thetas)} exceeds the "
+                    f"workers' theta_block={theta_block}")
+            theta_store = thetas if len(thetas) > 1 else thetas[0]
+        else:
+            thetas = (float(theta),)
+            theta_store = float(theta)
+        check_ds_domain(self._f_ds,
+                        np.tile(np.array([bounds]), (len(thetas), 1)),
+                        np.array(thetas))
+        tenant = str(tenant)
+        if not tenant or len(tenant) > 128:
+            raise ValueError(
+                f"tenant must be a non-empty string of <= 128 chars, "
+                f"got {tenant!r}")
+        if deadline_phases is not None:
+            deadline_phases = int(deadline_phases)
+            if deadline_phases < 1:
+                raise ValueError(
+                    f"deadline_phases must be >= 1, got "
+                    f"{deadline_phases}")
+        grid = self._next_rid
+        self._next_rid += 1
+        ent = _LedgerEntry(
+            grid=grid, theta=theta_store, bounds=bounds,
+            tenant=str(tenant), priority=int(priority),
+            deadline_phases=deadline_phases,
+            submit_phase=self.phase, submit_t=time.perf_counter())
+        self._ledger[grid] = ent
+        if self.queue_limit is not None \
+                and len(self._pending) >= self.queue_limit:
+            victim_grid = min(
+                self._pending,
+                key=lambda g: (self._ledger[g].priority, g))
+            victim = self._ledger[victim_grid]
+            if victim.priority < ent.priority:
+                self._pending.remove(victim_grid)
+                self._pending.append(grid)
+                self._shed_or_spill(victim)
+            else:
+                self._shed_or_spill(ent)
+            return grid
+        self._pending.append(grid)
+        return grid
+
+    def _shed_or_spill(self, ent: _LedgerEntry) -> None:
+        """Overload policy (round 18): a queue-overflow victim routes
+        to the CPU spillover backend when one is armed and the request
+        is spill-eligible (no deadline — slower capacity cannot bound
+        latency); otherwise it sheds with the explicit record."""
+        spillable = (self._spill is not None
+                     and ent.deadline_phases is None)
+        if spillable and len(self._spill_queue) < self._spill_cap:
+            ent.state = "spill"
+            self._spill_queue.append(ent.grid)
+            self.telemetry.event(
+                "spillover_enqueued", rid=ent.grid,
+                tenant=ent.tenant, phase=self.phase)
+            return
+        from ppls_tpu.runtime.stream import ShedRecord
+        ent.state = "shed"
+        reason = ("spill_queue_full" if spillable else "queue_full")
+        rec = ShedRecord(
+            rid=ent.grid, theta=ent.theta, bounds=ent.bounds,
+            tenant=ent.tenant, priority=ent.priority,
+            reason=reason, phase=self.phase,
+            submit_phase=ent.submit_phase)
+        self.shed.append(rec)
+        self.telemetry.event(
+            "request_shed", rid=ent.grid, tenant=ent.tenant,
+            priority=ent.priority, reason=reason,
+            phase=self.phase, submit_phase=ent.submit_phase)
+
+    def _adopt_worker_shed(self, ent: "_LedgerEntry", rec: dict,
+                           process_id: int) -> None:
+        """A worker-side shed (deadline unmeetable on its queue) is a
+        TERMINAL outcome: adopt it into the coordinator ledger, or
+        the entry would stay 'dealt' forever and the cluster would
+        never go idle."""
+        from ppls_tpu.runtime.stream import ShedRecord
+        ent.state = "shed"
+        self.shed.append(ShedRecord(
+            rid=ent.grid, theta=ent.theta, bounds=ent.bounds,
+            tenant=ent.tenant, priority=ent.priority,
+            reason=rec.get("reason", "worker_shed"),
+            phase=self.phase, submit_phase=ent.submit_phase))
+        self.telemetry.event(
+            "request_shed", rid=ent.grid, tenant=ent.tenant,
+            priority=ent.priority,
+            reason=rec.get("reason", "worker_shed"),
+            process=process_id, phase=self.phase,
+            submit_phase=ent.submit_phase)
+
+    @property
+    def next_rid(self) -> int:
+        return self._next_rid
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    @property
+    def idle(self) -> bool:
+        if self._pending or self._spill_queue:
+            return False
+        return not any(e.state == "dealt"
+                       for e in self._ledger.values())
+
+    # -- the phase loop ----------------------------------------------------
+
+    def _deal(self) -> None:
+        """Round-robin deal of the undealt queue over the live process
+        set, in grid order — the deterministic deal; each worker's
+        own engine then does slot admission at ITS phase boundary."""
+        live = self._live()
+        if not live or not self._pending:
+            return
+        batches: Dict[int, List[int]] = {}
+        for grid in sorted(self._pending):
+            w = live[self._rr % len(live)]
+            self._rr += 1
+            batches.setdefault(w.process_id, []).append(grid)
+        self._pending = []
+        todo = [w for w in live if w.process_id in batches]
+        for i, w in enumerate(todo):
+            reqs = []
+            for g in batches[w.process_id]:
+                ent = self._ledger[g]
+                ent.assigned = w.process_id
+                ent.state = "dealt"
+                reqs.append(ent.payload())
+            try:
+                w.call({"cmd": "submit", "reqs": reqs})
+            except WorkerLost:
+                # batches not yet SENT roll back to pending (the next
+                # deal re-assigns them over whatever survives); this
+                # worker's batch stays dealt-to-the-dead, which
+                # recover_host_loss re-deals from the ledger — nothing
+                # is stranded in a state no recovery arm covers
+                for w2 in todo[i + 1:]:
+                    for g in batches[w2.process_id]:
+                        ent = self._ledger[g]
+                        ent.assigned = None
+                        ent.state = "pending"
+                        self._pending.append(g)
+                raise
+
+    def _complete(self, ent: _LedgerEntry, rec: dict,
+                  spillover: bool = False) -> object:
+        from ppls_tpu.runtime.stream import CompletedRequest
+        now = time.perf_counter()
+        c = CompletedRequest(
+            rid=ent.grid, theta=ent.theta, bounds=ent.bounds,
+            area=(float("nan") if rec.get("failed")
+                  else float(rec["area"])),
+            areas=rec.get("areas"),
+            submit_phase=ent.submit_phase,
+            admit_phase=ent.submit_phase,
+            retire_phase=self.phase,
+            latency_s=now - ent.submit_t,
+            first_seeded_phase=-1, last_credited_phase=-1,
+            failed=bool(rec.get("failed")),
+            tenant=ent.tenant, priority=ent.priority,
+            failure=rec.get("failure"),
+            spillover=spillover)
+        ent.state = "done"
+        self.completed.append(c)
+        self.telemetry.event(
+            "retire", rid=c.rid,
+            process=(-1 if spillover else ent.assigned),
+            area=(None if c.failed else c.area),
+            failed=c.failed,
+            **({"failure": c.failure} if c.failure else {}),
+            spillover=spillover, retire_phase=self.phase,
+            tenant=c.tenant, priority=c.priority)
+        return c
+
+    def _run_spillover(self, retired: list) -> None:
+        n = 0
+        while self._spill_queue and n < self.spillover_limit:
+            grid = self._spill_queue.pop(0)
+            ent = self._ledger[grid]
+            try:
+                areas, tasks, _wall = self._spill.run(
+                    ent.theta, ent.bounds)
+            except FloatingPointError:
+                # the quarantine contract covers the spillover path
+                # too: a poisoned request becomes a FAILED record,
+                # never an engine-wide abort stranding healthy work
+                if not self.quarantine:
+                    raise
+                self.telemetry.event("quarantine", rid=ent.grid,
+                                     phase=self.phase,
+                                     spillover=True)
+                rec = {"area": None, "failed": True,
+                       "failure": "nan", "areas": None}
+            else:
+                rec = {"area": areas[0], "failed": False,
+                       "areas": (list(areas)
+                                 if isinstance(ent.theta,
+                                               (tuple, list))
+                                 else None)}
+            retired.append(self._complete(ent, rec, spillover=True))
+            n += 1
+
+    def step(self) -> list:
+        """One coordinator phase: deal -> step every worker ->
+        collect retirements -> spillover batch -> checkpoint."""
+        tel = self.telemetry
+        if self.fault_injector is not None:
+            self.fault_injector.on_phase_open(
+                self.phase, n_dev=len(self._live()))
+        span = tel.span("phase", phase=self.phase)
+        retired: list = []
+        try:
+            self._deal()
+            live = self._live()
+            tasks, wsteps, rows = [], [], []
+            # parallel fan-out: every worker's step command goes out
+            # BEFORE any reply is read, so the N phase programs run
+            # concurrently (an N-host phase costs ~max, not ~sum).
+            # A loss mid-round is held until the survivors' replies
+            # are consumed — the newline protocol stays in sync and
+            # their retirements are not dropped on the floor.
+            lost: Optional[WorkerLost] = None
+            stepped = []
+            for w in live:
+                try:
+                    w.send_cmd({"cmd": "step"})
+                    stepped.append(w)
+                except WorkerLost as e:
+                    lost = lost or e
+            for w in stepped:
+                try:
+                    rep = w.recv_reply()
+                except WorkerLost as e:
+                    lost = lost or e
+                    continue
+                tasks.append(int(rep.get("tasks", 0)))
+                wsteps.append(int(rep.get("wsteps", 0)))
+                rows.append(int(rep.get("live", 0)))
+                self._wtasks_total += int(rep.get("wtasks", 0))
+                for rec in rep.get("retired", ()):
+                    ent = self._ledger.get(int(rec["grid"]))
+                    if ent is None or ent.state == "done":
+                        continue
+                    retired.append(self._complete(ent, rec))
+                for rec in rep.get("shed", ()):
+                    ent = self._ledger.get(int(rec["grid"]))
+                    if ent is None or ent.state in ("done", "shed"):
+                        continue
+                    self._adopt_worker_shed(ent, rec, w.process_id)
+            if lost is not None:
+                raise lost
+            if live:
+                self._flight.record_phase(
+                    self.phase, wsteps=wsteps, tasks=tasks,
+                    live_rows=rows,
+                    bank_delta=[0] * len(live))
+                self._tasks_total += sum(tasks)
+                self._wsteps_total += sum(wsteps)
+            # the cross-process occupancy sum: the host-side face of
+            # the phase-boundary psum (each worker's device program
+            # keeps its own, unchanged)
+            occupancy = sum(rows)
+            self._run_spillover(retired)
+        except WorkerLost as e:
+            span.close(error="host_loss", process=e.process_id)
+            raise HostLossError(
+                e.process_id, len(self._live()),
+                detail=str(e)) from e
+        self.phase += 1
+        self._phases_after_recovery += 1
+        span.close(retired=len(retired), occupancy=int(occupancy),
+                   processes=len(self._live()))
+        if self.checkpoint_path and \
+                self.phase % self.checkpoint_every == 0:
+            try:
+                self.snapshot()
+            except WorkerLost as e:
+                # a host dying at the checkpoint cut is a host loss,
+                # not a transient: classify it so the supervisor runs
+                # discovery + redeal instead of blind backoff-rerun
+                raise HostLossError(
+                    e.process_id, len(self._live()),
+                    detail=str(e)) from e
+        if self.fault_injector is not None:
+            self.fault_injector.on_phase_close(
+                self.phase - 1, n_dev=len(self._live()))
+        return retired
+
+    def drain(self, max_phases: int = 1 << 12) -> list:
+        done = []
+        phases = 0
+        while not self.idle:
+            done.extend(self.step())
+            phases += 1
+            if phases >= max_phases:
+                raise RuntimeError(
+                    f"cluster did not drain in {max_phases} phases")
+        return done
+
+    def run(self, requests, arrival_phase=None,
+            _crash_after_phases: Optional[int] = None):
+        t0 = time.perf_counter()
+        sched = ([0] * len(requests) if arrival_phase is None
+                 else [int(p) for p in arrival_phase])
+        order = sorted(range(len(requests)), key=lambda i: sched[i])
+        queue = [(sched[i], requests[i]) for i in order]
+        k = 0
+        phases = 0
+        while k < len(queue) or not self.idle:
+            while k < len(queue) and queue[k][0] <= self.phase:
+                r = queue[k][1]
+                kw2 = r[2] if len(r) > 2 else {}
+                self.submit(r[0], r[1], **kw2)
+                k += 1
+            self.step()
+            phases += 1
+            if _crash_after_phases is not None \
+                    and phases >= _crash_after_phases:
+                raise RuntimeError(
+                    f"simulated crash after {phases} phases "
+                    f"(test hook)")
+            if phases > (1 << 12):
+                raise RuntimeError("cluster stream did not converge")
+        return self.result(wall_s=time.perf_counter() - t0)
+
+    def result(self, wall_s: float = 0.0):
+        from ppls_tpu.parallel.walker import STREAM_STAT_FIELDS
+        from ppls_tpu.runtime.stream import StreamResult
+        res = StreamResult(
+            completed=list(self.completed), phases=self.phase,
+            wall_s=wall_s,
+            totals={"tasks": self._tasks_total,
+                    "wtasks": self._wtasks_total,
+                    "wsteps": self._wsteps_total},
+            phase_stats=np.zeros((0, len(STREAM_STAT_FIELDS)),
+                                 np.int64),
+            shed=list(self.shed))
+        return res
+
+    def spillover_summary(self) -> dict:
+        done = [c for c in self.completed
+                if getattr(c, "spillover", False)]
+        total = len(self.completed)
+        tasks = (self._spill.tasks_total
+                 if self._spill is not None else 0)
+        return {
+            "spillover_completed": len(done),
+            "spillover_fraction": (len(done) / total if total
+                                   else 0.0),
+            "spillover_tasks": int(tasks),
+        }
+
+    # -- surviving-host discovery + redeal ---------------------------------
+
+    def discover(self) -> List[int]:
+        """Ping every worker; reap the dead; return the surviving
+        process ids — the DISCOVERED topology, not a hand-built one."""
+        survivors, dead = [], []
+        for w in list(self._workers):
+            if w.ping():
+                survivors.append(w)
+            else:
+                dead.append(w)
+        for w in dead:
+            self.manifest.drop(w.process_id)
+            w.io.close()
+            if w.proc.poll() is None:
+                w.proc.kill()
+            self._workers.remove(w)
+        self.telemetry.event(
+            "host_loss_discovery",
+            survivors=[w.process_id for w in survivors],
+            lost=[w.process_id for w in dead], phase=self.phase)
+        return [w.process_id for w in survivors]
+
+    def _redeal_rows(self, rows: Dict[int, List[int]]) -> int:
+        """The one deal arm both recovery paths share: per-host grid
+        rows (the n-host layout) re-deal over the LIVE process set
+        through ``mesh.host_strided_redeal``, each survivor receiving
+        its share as a submit batch. Returns the rows moved."""
+        from ppls_tpu.parallel.mesh import host_strided_redeal
+        live = sorted(w.process_id for w in self._live())
+        if not rows or not live:
+            return 0
+        hosts = sorted(rows)
+        counts = np.array([len(rows[h]) for h in hosts],
+                          dtype=np.int64)
+        b = max(int(counts.max()), 1)
+        col = np.full((len(hosts), b), -1, dtype=np.int64)
+        for i, h in enumerate(hosts):
+            col[i, :counts[i]] = rows[h]
+        dealt, new_counts = host_strided_redeal(
+            {"grid": col}, counts, len(live), fills={"grid": -1})
+        moved = 0
+        for d, w_pid in enumerate(live):
+            grids = sorted(int(v) for v in
+                           dealt["grid"][d][:new_counts[d]])
+            if not grids:
+                continue
+            reqs = []
+            for g in grids:
+                ent = self._ledger[g]
+                ent.assigned = w_pid
+                reqs.append(ent.payload())
+            self._worker(w_pid).call({"cmd": "submit",
+                                      "reqs": reqs})
+            moved += len(reqs)
+        return moved
+
+    def recover_host_loss(self, exc=None) -> int:
+        """The supervisor's ``host_loss`` recovery: discover the
+        surviving topology, then re-deal every lost host's outstanding
+        requests onto the survivors through the existing
+        ``mesh.host_strided_redeal`` deal rule. Returns the surviving
+        process count. Raises the original error when nothing
+        survives."""
+        t0 = time.perf_counter()
+        survivors = self.discover()
+        if not survivors:
+            raise exc if exc is not None else HostLossError(
+                -1, 0, detail="no survivors")
+        live_set = set(survivors)
+        # outstanding grids whose assigned process no longer exists,
+        # grouped per lost process (the n-host snapshot's per-host
+        # rows host_strided_redeal deals from)
+        lost_rows: Dict[int, List[int]] = {}
+        for g in sorted(self._ledger):
+            ent = self._ledger[g]
+            if ent.state == "dealt" and ent.assigned not in live_set:
+                lost_rows.setdefault(int(ent.assigned), []).append(g)
+        moved = self._redeal_rows(lost_rows)
+        # survivors reconcile too: a loss mid-phase can drop a step
+        # reply on the floor — adopt any completion the coordinator
+        # missed and re-submit anything a survivor never received
+        # (the same ledger-replay arm the corrupt-snapshot path uses)
+        self._reconcile_workers(states={
+            w.process_id: w.call({"cmd": "state"})
+            for w in self._live()})
+        # the flight recorder re-targets the surviving topology (the
+        # per-process streak history cannot survive a re-deal)
+        from ppls_tpu.obs.flight import ChipFlightRecorder
+        self._flight = ChipFlightRecorder(
+            self.telemetry, len(survivors), engine="cluster-stream",
+            span_name="process", labels=sorted(survivors))
+        wall = time.perf_counter() - t0
+        self.redeal_walls.append(wall)
+        self._phases_after_recovery = 0
+        self.telemetry.event(
+            "cluster_redeal", survivors=survivors, rows=moved,
+            wall_s=round(wall, 4), phase=self.phase)
+        return len(survivors)
+
+    # -- snapshot / resume -------------------------------------------------
+
+    def _identity(self, cluster: Optional[dict] = None) -> dict:
+        from ppls_tpu.runtime.checkpoint import engine_name
+        ident = {"engine": engine_name("cluster-stream", self.rule),
+                 "fname": self.family, "eps": self.eps,
+                 "cluster": (cluster if cluster is not None
+                             else self.manifest.identity())}
+        wk = self.worker_kw
+        for k in ("slots", "chunk", "capacity", "lanes",
+                  "refill_slots", "f64_rounds", "theta_block"):
+            if k in wk and wk[k] is not None:
+                ident[k] = int(wk[k])
+        return ident
+
+    def snapshot(self) -> None:
+        """The coordinated cut: workers snapshot at this boundary
+        first, then the coordinator ledger (so a torn cut leaves
+        workers AHEAD, which resume reconciles by adopting their
+        completions — never behind with work silently lost)."""
+        if not self.checkpoint_path:
+            raise ValueError("no checkpoint_path configured")
+        from ppls_tpu.runtime.checkpoint import save_family_checkpoint
+        for w in self._live():
+            w.call({"cmd": "snapshot"})
+        totals = {
+            "phase": self.phase, "next_rid": self._next_rid,
+            "rr": self._rr,
+            "ledger": [dict(e.payload(), submit_phase=e.submit_phase,
+                            assigned=e.assigned, state=e.state)
+                       for e in (self._ledger[g]
+                                 for g in sorted(self._ledger))],
+            "pending": sorted(self._pending),
+            "spill_queue": list(self._spill_queue),
+            "completed": [dataclasses.asdict(c)
+                          for c in self.completed],
+            "shed": [dataclasses.asdict(s) for s in self.shed],
+            "client_state": dict(self.client_state),
+            "tasks_total": int(self._tasks_total),
+            "wtasks_total": int(self._wtasks_total),
+            "wsteps_total": int(self._wsteps_total),
+            "spill_requests_total": int(
+                self._spill.requests_total if self._spill else 0),
+            "spill_tasks_total": int(
+                self._spill.tasks_total if self._spill else 0),
+        }
+        save_family_checkpoint(
+            self.checkpoint_path, identity=self._identity(),
+            bag_cols={}, count=0, acc=np.zeros(1), totals=totals)
+        self.telemetry.event(
+            "checkpoint", phase=self.phase,
+            pending=len(self._pending),
+            completed=len(self.completed))
+        if self.fault_injector is not None:
+            self.fault_injector.on_checkpoint_write(
+                self.checkpoint_path)
+
+    @classmethod
+    def resume(cls, checkpoint_path: str, family: str, eps: float,
+               cluster_resize: bool = False, **kwargs
+               ) -> "ClusterStreamEngine":
+        """Rebuild a cluster from its coordinator snapshot.
+
+        Same topology: workers resume their own per-process snapshots
+        and the coordinator reconciles (adopting completions newer
+        than its cut; re-submitting anything a fresh/corrupt worker
+        lost). Different topology (``n_processes`` != the manifest):
+        refuses unless ``cluster_resize=True`` — then every
+        outstanding request re-deals over the new process set from
+        the ledger (request-granularity redeal, both directions)."""
+        from ppls_tpu.runtime.checkpoint import load_family_checkpoint
+        from ppls_tpu.runtime.stream import (CompletedRequest,
+                                             ShedRecord)
+        eng = cls(family, eps, checkpoint_path=checkpoint_path,
+                  _defer_spawn=True, **kwargs)
+        # Read the STORED manifest first: worker device counts are
+        # unknowable before spawning, so when the process count
+        # matches the identity comparison claims the stored cluster
+        # (and re-verifies against the ACTUAL spawned manifest below);
+        # a different process count leaves the cluster key differing,
+        # which load_family_checkpoint refuses unless the caller
+        # passed cluster_resize=True — the deliberate-resize gate.
+        stored_cluster: dict = {}
+        try:
+            with np.load(checkpoint_path) as z:
+                meta = json.loads(bytes(z["meta"]).decode())
+            stored_cluster = dict(
+                meta.get("identity", {}).get("cluster") or {})
+        except Exception:   # noqa: BLE001 — the verified load below
+            pass            # produces the proper corrupt/IO error
+        same_count = (int(stored_cluster.get("processes", -1))
+                      == eng.n_processes)
+        claim = (stored_cluster if same_count
+                 else {"processes": eng.n_processes, "devices": []})
+        bag_cols, _count, _acc, totals = load_family_checkpoint(
+            checkpoint_path, eng._identity(cluster=claim),
+            cluster_resize=cluster_resize)
+        resized = not same_count
+
+        eng.phase = int(totals["phase"])
+        eng._next_rid = int(totals["next_rid"])
+        eng._rr = int(totals.get("rr", 0))
+        eng._tasks_total = int(totals.get("tasks_total", 0))
+        eng._wtasks_total = int(totals.get("wtasks_total", 0))
+        eng._wsteps_total = int(totals.get("wsteps_total", 0))
+        if eng._spill is not None:
+            # the device-counted spillover engagement survives the
+            # restart with everything else (spillover_summary reads
+            # the executor's live counters)
+            eng._spill.requests_total = int(
+                totals.get("spill_requests_total", 0))
+            eng._spill.tasks_total = int(
+                totals.get("spill_tasks_total", 0))
+        eng.client_state = dict(totals.get("client_state", {}))
+        for d in totals["ledger"]:
+            ent = _LedgerEntry.from_payload(d)
+            ent.assigned = d.get("assigned")
+            ent.state = d.get("state", "pending")
+            eng._ledger[ent.grid] = ent
+        eng._pending = [int(g) for g in totals.get("pending", [])]
+        eng._spill_queue = [int(g)
+                            for g in totals.get("spill_queue", [])]
+        if eng._spill_queue and eng._spill is None:
+            # without the backend the queue can never drain: idle
+            # stays False forever while every phase is a no-op — the
+            # acknowledged requests must not be silently stranded
+            eng.close()
+            raise ValueError(
+                f"snapshot carries {len(eng._spill_queue)} "
+                f"spillover-queued request(s) but spillover is not "
+                f"armed on this resume; pass spillover=True")
+
+        def _theta_in(v):
+            return tuple(v) if isinstance(v, list) else v
+
+        eng.completed = [CompletedRequest(
+            **{k: (tuple(v) if k == "bounds"
+                   else _theta_in(v) if k == "theta" else v)
+               for k, v in d.items()})
+            for d in totals.get("completed", [])]
+        eng.shed = [ShedRecord(
+            **{k: (tuple(v) if k == "bounds"
+                   else _theta_in(v) if k == "theta" else v)
+               for k, v in d.items()})
+            for d in totals.get("shed", [])]
+        done = {c.rid for c in eng.completed}
+        for rid in done:
+            if rid in eng._ledger:
+                eng._ledger[rid].state = "done"
+
+        if resized:
+            # cross-topology: stale per-process snapshots must not be
+            # resumed by the new workers — their assignment map no
+            # longer exists
+            for i in range(max(int(stored_cluster["processes"]),
+                               eng.n_processes) + 1):
+                p = f"{checkpoint_path}.p{i}"
+                if os.path.exists(p):
+                    os.unlink(p)
+        eng._spawn(list(range(eng.n_processes)))
+        if not resized \
+                and eng.manifest.identity() != stored_cluster:
+            # same process count but the per-process device picture
+            # changed (a different host class): still a topology
+            # change — deliberate only
+            if not cluster_resize:
+                eng.close()
+                raise ValueError(
+                    f"checkpoint {checkpoint_path!r} belongs to a "
+                    f"different cluster topology (stored "
+                    f"{stored_cluster}, actual "
+                    f"{eng.manifest.identity()}); pass "
+                    f"cluster_resize=True to re-deal onto it")
+        eng.telemetry.event(
+            "cluster_resume", phase=eng.phase,
+            processes=eng.n_processes, resized=bool(resized))
+
+        if resized:
+            eng._redeal_all_outstanding()
+        else:
+            eng._reconcile_workers()
+        return eng
+
+    def _redeal_all_outstanding(self) -> None:
+        """Cross-topology resume: every dealt-but-uncompleted request
+        re-deals over the new process set via ``host_strided_redeal``
+        (its old per-process assignment rows are the deal input), and
+        undealt pending stays pending."""
+        t0 = time.perf_counter()
+        rows: Dict[int, List[int]] = {}
+        for g in sorted(self._ledger):
+            ent = self._ledger[g]
+            if ent.state == "dealt":
+                rows.setdefault(int(ent.assigned or 0), []).append(g)
+        moved = self._redeal_rows(rows)
+        self.redeal_walls.append(time.perf_counter() - t0)
+        self.telemetry.event(
+            "cluster_redeal",
+            survivors=[w.process_id for w in self._live()],
+            rows=moved,
+            wall_s=round(self.redeal_walls[-1], 4), phase=self.phase)
+
+    def _reconcile_workers(
+            self, states: Optional[Dict[int, dict]] = None) -> None:
+        """Adopt worker-reported completions the coordinator does not
+        hold, and re-submit anything a worker lost. Two callers: the
+        same-topology resume (state = each worker's hello, covering
+        the fresh-start-after-corrupt-snapshot path) and host-loss
+        recovery (state = a live ``state`` RPC per survivor, covering
+        step replies dropped by the loss)."""
+        for w in self._live():
+            st = (states[w.process_id] if states is not None
+                  else w.hello)
+            if st.get("corrupt"):
+                self.telemetry.event(
+                    "worker_snapshot_corrupt",
+                    process=w.process_id,
+                    detail=str(st["corrupt"])[:200])
+            for rec in st.get("completed", ()):
+                ent = self._ledger.get(int(rec["grid"]))
+                if ent is not None and ent.state != "done":
+                    self._complete(ent, rec)
+            for rec in st.get("shed", ()):
+                ent = self._ledger.get(int(rec["grid"]))
+                if ent is not None \
+                        and ent.state not in ("done", "shed"):
+                    self._adopt_worker_shed(ent, rec, w.process_id)
+            held = set(int(g) for g in st.get("outstanding", ()))
+            held |= {int(r["grid"])
+                     for r in st.get("completed", ())}
+            held |= {int(r["grid"]) for r in st.get("shed", ())}
+            missing = []
+            for g in sorted(self._ledger):
+                ent = self._ledger[g]
+                if ent.state == "dealt" \
+                        and ent.assigned == w.process_id \
+                        and g not in held:
+                    missing.append(ent.payload())
+            if missing:
+                w.call({"cmd": "submit", "reqs": missing})
+                self.telemetry.event(
+                    "worker_replay", process=w.process_id,
+                    rows=len(missing))
+
+    def clear_snapshot(self) -> None:
+        """Remove the coordinator snapshot and every per-process
+        sibling (a drained run leaves no restart state behind)."""
+        if not self.checkpoint_path:
+            return
+        import glob
+        for p in ([self.checkpoint_path]
+                  + glob.glob(f"{self.checkpoint_path}.p*")):
+            if os.path.exists(p):
+                os.unlink(p)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, graceful: bool = True) -> None:
+        """``graceful=False`` skips the exit RPC and SIGKILLs straight
+        away — the spelling for tearing down a cluster whose command/
+        reply pairing may be desynced (e.g. a watchdog abandoned a
+        thread mid-RPC): writing on such a socket could block or
+        confuse a live worker, killing it cannot."""
+        if self._closed:
+            return
+        self._closed = True
+        for w in self._workers:
+            if not graceful:
+                w.kill()
+            w.close(graceful=graceful)
+        self._workers = []
+
+    def __enter__(self) -> "ClusterStreamEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def deep_trace_probes():
+    """Semantic-lint probes (round 18): the DISTRIBUTED dd program —
+    the phase program a cluster worker runs when its spec says
+    ``engine="walker-dd"`` (``build_dd_walker_run`` with the admit
+    window armed, on the worker's LOCAL 2-chip mesh). Its GL07 census
+    PINS that the cluster keeps compiled collectives host-local by
+    construction: the model below must match exactly, so a collective
+    that silently starts crossing the worker boundary (or a new
+    uncounted one inside it) fails the deep lint."""
+    import jax.numpy as jnp
+
+    from ppls_tpu.parallel.mesh import make_mesh
+    from ppls_tpu.parallel.sharded_walker import (_dd_sizing,
+                                                  build_dd_walker_run)
+    lanes, capacity, chunk, rpl = 256, 1 << 9, 1 << 7, 2
+    n_dev = 2
+    mesh = make_mesh(n_dev)
+    target_local, breed_chunk, store, reshard_window = _dd_sizing(
+        lanes, capacity, chunk, rpl)
+    aw = 4
+    slots = 2
+    run = build_dd_walker_run(
+        mesh, "sin_scaled", 1e-3, int(breed_chunk), capacity, slots,
+        lanes, 64, 1 << 10, 0.1, 0.95, 0.65, int(target_local), True,
+        1, 0.5, 1.0, Rule.TRAPEZOID, True, 8.0, rpl,
+        int(reshard_window), admit_window=aw)
+
+    def ops(seed: int):
+        z64 = jnp.zeros(n_dev, jnp.int64)
+        state = (
+            jnp.full((n_dev * store,), 0.5, jnp.float64),
+            jnp.full((n_dev * store,), 0.5 + 0.25 * seed,
+                     jnp.float64),
+            jnp.full((n_dev * store,), 1.0, jnp.float64),
+            jnp.zeros((n_dev * store,), jnp.int32),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros((n_dev, slots), jnp.float64))
+        from ppls_tpu.parallel.walker import N_WASTE
+        counters = tuple(z64 for _ in range(11)) + (
+            jnp.zeros((n_dev, N_WASTE), jnp.int64),
+            jnp.zeros((n_dev, 2), jnp.int64),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros(n_dev, dtype=bool))
+        adm = (
+            jnp.full(n_dev * aw, 0.25, jnp.float64),
+            jnp.full(n_dev * aw, 0.75 + 0.125 * seed, jnp.float64),
+            jnp.full(n_dev * aw, 1.0, jnp.float64),
+            jnp.zeros(n_dev * aw, jnp.int32),
+            jnp.zeros(n_dev, jnp.int32),
+            jnp.zeros((n_dev, slots), dtype=bool))
+        return state + counters + adm
+
+    return [("cluster.worker_dd_stream", run, ops)]
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

@@ -38,6 +38,13 @@ Fault taxonomy (``FAULT_KINDS``):
   serve loop's GracefulShutdown must final-checkpoint, close the span
   timeline balanced, and exit 0, and the ``serve --checkpoint``
   restart must resume with zero lost acknowledged requests;
+* ``host_loss``     — SIGKILL a chosen WORKER PROCESS at a phase
+  boundary (round 18): the cluster coordinator installs
+  ``host_kill_fn`` so the event kills a real process (the loss then
+  surfaces at the next RPC, like a real dead host); without the hook
+  (single-process engines) it raises :class:`guard.HostLossError`
+  directly. Opt-in like ``sigterm`` — deliberately excluded from the
+  seeded-schedule pool so existing seeds keep their schedules;
 * ``ckpt_truncate`` — truncate the snapshot file just written (a
   crash mid-upload / out-of-disk shape);
 * ``ckpt_corrupt``  — flip one byte in the middle of the snapshot
@@ -68,19 +75,23 @@ from typing import List, Optional
 
 import numpy as np
 
-from ppls_tpu.runtime.guard import ChipLossError, InjectedCrash
+from ppls_tpu.runtime.guard import (ChipLossError, HostLossError,
+                                    InjectedCrash)
 
 FAULT_KINDS = ("chip_loss", "crash", "hang", "straggler", "nan_poison",
-               "ckpt_truncate", "ckpt_corrupt", "sigterm")
+               "ckpt_truncate", "ckpt_corrupt", "sigterm",
+               "host_loss")
 
 # kinds keyed on the PHASE index (fire at a phase boundary); the
 # others key on the request rid (nan_poison) or the checkpoint-write
-# index (ckpt_*). NOTE: sigterm is phase-keyed too but deliberately
-# NOT in PHASE_KINDS — seeded schedule generation draws from
-# PHASE_KINDS, and appending there would silently change every
-# existing seed's schedule (the same-seed-same-schedule contract).
+# index (ckpt_*). NOTE: sigterm and host_loss (round 18) are
+# phase-keyed too but deliberately NOT in PHASE_KINDS — seeded
+# schedule generation draws from PHASE_KINDS, and appending there
+# would silently change every existing seed's schedule (the
+# same-seed-same-schedule contract, regression-pinned in
+# tests/test_faults.py).
 PHASE_KINDS = ("chip_loss", "crash", "hang", "straggler")
-_EDGE_KINDS = PHASE_KINDS + ("sigterm",)
+_EDGE_KINDS = PHASE_KINDS + ("sigterm", "host_loss")
 
 # an injected hang must outlive any plausible watchdog deadline: the
 # wedged thread is daemonized and must sleep until process exit, never
@@ -209,6 +220,10 @@ class FaultInjector:
         self.telemetry = telemetry
         self.ckpt_writes = 0
         self._lock = threading.Lock()
+        # round 18: the cluster coordinator installs its real-process
+        # killer here so host_loss events SIGKILL a worker; None (the
+        # single-process engines) raises HostLossError directly
+        self.host_kill_fn = None
 
     # -- internals ---------------------------------------------------------
 
@@ -265,6 +280,17 @@ class FaultInjector:
                 chip = ev.chip if ev.chip is not None else n_dev - 1
                 raise ChipLossError(chip, n_dev,
                                     detail="fault plan injection")
+            elif ev.kind == "host_loss":
+                if self.host_kill_fn is not None:
+                    # kill a REAL worker process: the loss surfaces
+                    # at the coordinator's next RPC to it, exactly
+                    # like an un-injected dead host
+                    self.host_kill_fn(ev.chip)
+                else:
+                    proc = ev.chip if ev.chip is not None \
+                        else n_dev - 1
+                    raise HostLossError(proc, n_dev,
+                                        detail="fault plan injection")
 
     def on_phase_open(self, phase: int, n_dev: int = 1) -> None:
         """Phase-open boundary (before admission): crashes here model

@@ -70,6 +70,32 @@ class ChipLossError(RuntimeError):
             + f"; {self.surviving} chip(s) survive")
 
 
+class HostLossError(ChipLossError):
+    """A whole WORKER PROCESS (a host) left the cluster mid-run
+    (round 18). The chip-level fields are reused at process
+    granularity: ``chip`` is the lost process id, ``n_dev`` the
+    process count it left, ``surviving`` the count after the loss.
+    On the local cluster this is the classified face of a dead worker
+    socket (or a fault-plan SIGKILL); on a TPU pod it is a dead
+    host's coordination-service eviction."""
+
+    def __init__(self, process: int, n_processes: int,
+                 detail: str = ""):
+        self.chip = int(process)
+        self.n_dev = int(n_processes)
+        self.surviving = max(int(n_processes) - 1, 0)
+        RuntimeError.__init__(
+            self,
+            f"host (worker process) {process} lost from the "
+            f"{n_processes}-process cluster"
+            + (f" ({detail})" if detail else "")
+            + f"; {self.surviving} process(es) survive")
+
+    @property
+    def process(self) -> int:
+        return self.chip
+
+
 class RetryBudgetExhausted(RuntimeError):
     """The retry loop's total-deadline budget ran out before the next
     backoff could be paid; carries the last underlying failure."""
@@ -84,6 +110,9 @@ def is_transient(msg: str) -> bool:
 def classify_failure(exc: BaseException) -> str:
     """Failure taxonomy of the round-14 supervisor:
 
+    * ``host_loss``  — a :class:`HostLossError` (round 18): a worker
+      PROCESS died; recover by discovering the surviving topology and
+      re-dealing the lost host's outstanding work onto it;
     * ``chip_loss``  — a :class:`ChipLossError`: recover by resuming the
       latest snapshot onto the surviving (smaller) mesh;
     * ``poison``     — a ``FloatingPointError`` (the engines' NaN
@@ -96,6 +125,8 @@ def classify_failure(exc: BaseException) -> str:
       backoff + resume;
     * ``fatal``      — everything else (bugs, sizing errors): propagate.
     """
+    if isinstance(exc, HostLossError):
+        return "host_loss"
     if isinstance(exc, ChipLossError):
         return "chip_loss"
     if isinstance(exc, FloatingPointError):
@@ -415,6 +446,58 @@ class Supervisor:
                                  "supervised run")
         return self.run_fn()
 
+    def _resize_with_backoff(self, exc, kind: str, t_start: float):
+        """Round 18 (the resize-abort fix): the chip/host-loss resize
+        recovery gets the SAME deterministic backoff-with-budget the
+        transient arm has. A resize racing a slow worker teardown (its
+        socket still half-open, its snapshot still renaming into
+        place) used to abort the whole supervised run on the first
+        failed ``resize_fn`` call; now each failed resize attempt is
+        classified, backs off deterministically, and retries until the
+        attempt/deadline budget is spent. Fatal/poison resize failures
+        (a store-fit refusal, a corrupt-identity mismatch) still
+        propagate immediately — only infrastructure-shaped failures
+        are worth waiting out."""
+        resize_attempt = 0
+        while True:
+            try:
+                return self.resize_fn(exc)
+            except BaseException as re:  # noqa: BLE001 — classified
+                rkind = classify_failure(re)
+                rmsg = f"{type(re).__name__}: {re}"
+                self.attempts += 1
+                self._event("supervisor_failure",
+                            kind=f"resize_{rkind}",
+                            attempt=self.attempts,
+                            error=rmsg[:200])
+                self._count("ppls_supervisor_failures_total",
+                            "kind", f"resize_{rkind}")
+                if rkind in ("fatal", "poison") \
+                        or self.attempts >= self.max_attempts:
+                    raise
+                resize_attempt += 1
+                delay = backoff_seconds(resize_attempt,
+                                        self.backoff_base,
+                                        self.backoff_cap)
+                if self.total_deadline is not None and \
+                        time.monotonic() - t_start + delay \
+                        > self.total_deadline:
+                    raise RetryBudgetExhausted(
+                        f"supervised resize: total deadline "
+                        f"{self.total_deadline:.0f}s would be "
+                        f"exceeded by the next {delay:.0f}s backoff; "
+                        f"last failure: {rmsg[:200]}") from re
+                self._log(f"[supervisor] resize attempt "
+                          f"{resize_attempt} failed ({rmsg[:120]}) "
+                          f"... retrying in {delay:.1f}s")
+                self.recoveries.append((kind, "resize_backoff"))
+                self._event("supervisor_recovery",
+                            action="resize_backoff",
+                            backoff_s=delay, attempt=self.attempts)
+                self._count("ppls_supervisor_recoveries_total",
+                            "action", "resize_backoff")
+                self._sleep(delay)
+
     def run(self):
         t_start = time.monotonic()
         backoff_attempt = 0       # resets after a successful resize
@@ -429,15 +512,17 @@ class Supervisor:
                             attempt=self.attempts, error=msg[:200])
                 self._count("ppls_supervisor_failures_total", "kind",
                             kind)
-                if kind == "chip_loss" and self.resize_fn is not None:
+                if kind in ("chip_loss", "host_loss") \
+                        and self.resize_fn is not None:
                     surviving = getattr(e, "surviving", 0)
                     if surviving < 1:
-                        self._log(f"[supervisor] {msg}: no chips "
-                                  f"survive; giving up")
+                        self._log(f"[supervisor] {msg}: nothing "
+                                  f"survives; giving up")
                         raise
                     self._log(f"[supervisor] {msg}: resize-resuming "
-                              f"onto {surviving} chip(s)")
-                    self.run_fn = self.resize_fn(e)
+                              f"onto {surviving} survivor(s)")
+                    self.run_fn = self._resize_with_backoff(
+                        e, kind, t_start)
                     self.recoveries.append((kind, "resize_resume"))
                     self._event("supervisor_recovery",
                                 action="resize_resume",

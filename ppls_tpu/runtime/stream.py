@@ -222,6 +222,11 @@ class CompletedRequest:
     tenant: str = "default"
     priority: int = 1
     failure: Optional[str] = None
+    # round 18: True when the request completed on the CPU SPILLOVER
+    # backend (off-mesh pure-f64 bag rounds) instead of the engine —
+    # the attribution marker of graceful degradation. Default False
+    # keeps pre-round-18 snapshots loading.
+    spillover: bool = False
 
     @property
     def phases_in_flight(self) -> int:
@@ -321,6 +326,18 @@ class StreamResult:
                 "p50_phases": float(h.quantile(0.5)),
                 "p99_phases": float(h.quantile(0.99)),
             } for p, h in sorted(by_class.items())}
+
+    def spillover_summary(self) -> dict:
+        """Graceful-degradation accounting (round 18): how much of
+        the completed work ran on the CPU spillover backend instead of
+        the engine, from the deterministic completed record."""
+        done = [c for c in self.completed
+                if getattr(c, "spillover", False)]
+        return {
+            "spillover_completed": len(done),
+            "spillover_fraction": (len(done) / len(self.completed)
+                                   if self.completed else 0.0),
+        }
 
     def tenant_summary(self) -> dict:
         """Per-tenant accounting: retired / failed / shed counts and
@@ -523,7 +540,9 @@ class StreamEngine:
                  queue_limit: Optional[int] = None,
                  tenant_quotas: Optional[dict] = None,
                  default_deadline_phases: Optional[int] = None,
-                 on_shed=None):
+                 on_shed=None,
+                 spillover: bool = False,
+                 spillover_limit: int = 4):
         from ppls_tpu.models.integrands import get_family, get_family_ds
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -688,6 +707,31 @@ class StreamEngine:
         self.on_shed = on_shed
         self.shed: List[ShedRecord] = []
         self._tokens: dict = {}
+        # round 18: CPU spillover — queue-overflow victims without a
+        # deadline run as pure-f64 bag rounds off-mesh instead of
+        # shedding (slower-but-correct capacity before rejection).
+        # Host-side boundary policy like the shed machinery: never on
+        # the snapshot identity, but the spill queue rides every
+        # snapshot so an acknowledged spillover request survives a
+        # restart.
+        self.spillover_limit = int(spillover_limit)
+        # bounded spill queue (round-18 review): beyond ~8 phases of
+        # spillover backlog the victim sheds explicitly — sustained
+        # deadline-less overload must not re-grow the unbounded
+        # backlog queue_limit exists to prevent
+        self._spill_cap = 8 * max(self.spillover_limit, 1)
+        self._spill = None
+        if spillover:
+            from ppls_tpu.backends.spillover import SpilloverExecutor
+            self._spill = SpilloverExecutor(
+                family, self.eps, rule=self.rule,
+                chunk=int(chunk),       # the executor owns the cap
+                capacity=int(capacity), telemetry=tel)
+        self._spill_queue: List[StreamRequest] = []
+        self._c_spillover = tel.registry.counter(
+            "ppls_stream_spillover_total",
+            "requests completed on the CPU spillover backend "
+            "instead of being shed")
         # round 16: a JSON-serializable scratch dict for the DRIVER'S
         # resume bookkeeping, carried by every snapshot. The serve CLI
         # stores its batch-list cursor here — rids alone cannot serve
@@ -854,12 +898,28 @@ class StreamEngine:
                          key=lambda r: (r.priority, r.rid))
             if victim.priority < req.priority:
                 self._pending.remove(victim)
-                self._shed(victim, "queue_full")
+                self._shed_or_spill(victim)
             else:
-                self._shed(req, "queue_full")
+                self._shed_or_spill(req)
                 return rid
         self._pending.append(req)
         return rid
+
+    def _shed_or_spill(self, req: StreamRequest) -> None:
+        """Queue-overflow policy (round 18): route the victim to the
+        CPU spillover backend when one is armed and the request is
+        spill-eligible (no deadline — slower capacity cannot bound
+        latency); otherwise shed with the explicit record, as before."""
+        spillable = (self._spill is not None
+                     and req.deadline_phases is None)
+        if spillable and len(self._spill_queue) < self._spill_cap:
+            self._spill_queue.append(req)
+            self.telemetry.event(
+                "spillover_enqueued", rid=req.rid, tenant=req.tenant,
+                phase=self.phase, submit_phase=req.submit_phase)
+            return
+        self._shed(req,
+                   "spill_queue_full" if spillable else "queue_full")
 
     def _quota_for(self, tenant: str) -> Optional[dict]:
         if self.tenant_quotas is None:
@@ -899,9 +959,10 @@ class StreamEngine:
 
     @property
     def idle(self) -> bool:
-        """Nothing queued, resident, or live on device."""
+        """Nothing queued, resident, live on device, or awaiting the
+        spillover backend."""
         return not self._pending and not self._slot_req \
-            and self._count == 0
+            and self._count == 0 and not self._spill_queue
 
     # ------------------------------------------------------------------
     # device state
@@ -1401,6 +1462,72 @@ class StreamEngine:
         # the host-side live view consistent for result()/idle
         self._last_fam_live = np.where(kill, 0, self._last_fam_live)
 
+    def last_phase_row(self) -> Optional[dict]:
+        """The most recent device-counted phase row as a field dict
+        (None before the first non-idle phase). The cluster worker
+        protocol reads its per-phase deltas here — host values the
+        boundary already fetched, no device work."""
+        if not self._phase_rows:
+            return None
+        return {k: int(v) for k, v in
+                zip(STREAM_STAT_FIELDS, self._phase_rows[-1])}
+
+    def phase_rows_len(self) -> int:
+        """How many non-idle phase rows exist (the cluster worker
+        pairs this with :meth:`last_phase_row` to tell a fresh row
+        from a stale one across an idle phase)."""
+        return len(self._phase_rows)
+
+    def _run_spillover_phase(self) -> List[CompletedRequest]:
+        """Phase-boundary spillover batch (round 18): up to
+        ``spillover_limit`` queued overflow victims run TO COMPLETION
+        on the CPU backend — deterministic schedule (rid order),
+        host-side boundary work only. The completed record carries
+        ``spillover=True`` and the engagement is device-counted by
+        the bag engine's own task counters
+        (``ppls_spillover_tasks_total``)."""
+        if self._spill is None or not self._spill_queue:
+            return []
+        out = []
+        n = 0
+        while self._spill_queue and n < self.spillover_limit:
+            req = self._spill_queue.pop(0)
+            failed = False
+            areas = None
+            try:
+                areas, _tasks, _wall = self._spill.run(req.theta,
+                                                       req.bounds)
+            except FloatingPointError:
+                # the quarantine contract covers the spillover path
+                # too: a poisoned request becomes a FAILED record,
+                # never an engine-wide abort stranding healthy work
+                if not self.quarantine:
+                    raise
+                failed = True
+                self.telemetry.event("quarantine", rid=req.rid,
+                                     phase=self.phase,
+                                     spillover=True)
+                self._c_quarantined.inc()
+            batched = isinstance(req.theta, (tuple, list))
+            c = CompletedRequest(
+                rid=req.rid, theta=req.theta, bounds=req.bounds,
+                area=(float("nan") if failed else areas[0]),
+                areas=(list(areas) if batched and not failed
+                       else None),
+                submit_phase=req.submit_phase,
+                admit_phase=self.phase, retire_phase=self.phase,
+                latency_s=time.perf_counter() - req.submit_t,
+                first_seeded_phase=-1, last_credited_phase=-1,
+                failed=failed,
+                failure=("nan" if failed else None),
+                tenant=req.tenant, priority=req.priority,
+                spillover=True)
+            out.append(c)
+            self._c_spillover.inc()
+            self._account_retirement(c, slot=-1)
+            n += 1
+        return out
+
     def step(self) -> List[CompletedRequest]:
         """One phase: admit -> cycle -> retire. Returns the requests
         retired this phase (empty when idle)."""
@@ -1421,13 +1548,26 @@ class StreamEngine:
         self._admit()
         if self._count == 0 and not self._slot_req:
             # nothing live on device (and nothing was admissible): an
-            # idle phase costs no device work, but the phase counter
-            # still advances so open-loop arrival schedules with gaps
-            # make progress
+            # idle phase costs no device work — but a queued spillover
+            # batch still runs (the drained-tail engagement case) —
+            # and the phase counter still advances so open-loop
+            # arrival schedules with gaps make progress
+            spilled = self._run_spillover_phase()
+            self.completed.extend(spilled)
             self.phase += 1
             self._publish_gauges()
-            span.close(idle=True)
-            return []
+            span.close(idle=not spilled, retired=len(spilled))
+            # the idle branch still honors the snapshot cadence and
+            # the phase-close fault boundary: a drained-tail spillover
+            # run makes real progress here, and a kill mid-tail must
+            # not re-run (and re-print) every completed bag round
+            if self.checkpoint_path and \
+                    self.phase % self.checkpoint_every == 0:
+                self.snapshot()
+            if self.fault_injector is not None:
+                self.fault_injector.on_phase_close(
+                    self.phase - 1, n_dev=self._mesh_width())
+            return spilled
         (fam_live, acc, acc_c, fam_last, count, overflow,
          stats) = self._cycle_and_pull()
         if self.engine == "walker-dd" and \
@@ -1544,6 +1684,7 @@ class StreamEngine:
         if kill is not None:
             self._cancel_slots(kill)
         self._free.sort()
+        retired.extend(self._run_spillover_phase())
         self.completed.extend(retired)
         self.phase += 1
         self._publish_gauges(step_wall_s=time.perf_counter() - t_step0)
@@ -1651,6 +1792,24 @@ class StreamEngine:
                                 rows, STREAM_STAT_FIELDS),
                             shed=list(self.shed))
 
+    def spillover_summary(self) -> dict:
+        """Graceful-degradation accounting, the CLUSTER-shape twin
+        (``ClusterStreamEngine.spillover_summary``): record counts
+        plus the executor's device-counted task total — the serve
+        summary's ``spillover`` block must not drift between the
+        single-process and cluster paths."""
+        done = [c for c in self.completed
+                if getattr(c, "spillover", False)]
+        total = len(self.completed)
+        tasks = (self._spill.tasks_total
+                 if self._spill is not None else 0)
+        return {
+            "spillover_completed": len(done),
+            "spillover_fraction": (len(done) / total if total
+                                   else 0.0),
+            "spillover_tasks": int(tasks),
+        }
+
     # ------------------------------------------------------------------
     # snapshot / resume
     # ------------------------------------------------------------------
@@ -1711,6 +1870,19 @@ class StreamEngine:
             # acks contract covers refusals too: an acknowledged shed
             # stays a shed after the restart)
             "shed": [dataclasses.asdict(s) for s in self.shed],
+            # round 18: acknowledged spillover-queued requests ride
+            # the snapshot too (the zero-lost-acks contract covers
+            # the spill queue exactly like the pending queue)
+            "spill_queue": [dataclasses.asdict(r)
+                            for r in self._spill_queue],
+            # ... and so do the executor's device-counted engagement
+            # totals (ppls_spillover_{requests,tasks}_total must not
+            # restart at zero after a kill — same contract as the
+            # cluster coordinator's snapshot)
+            "spill_requests_total": int(
+                self._spill.requests_total if self._spill else 0),
+            "spill_tasks_total": int(
+                self._spill.tasks_total if self._spill else 0),
             "tokens": dict(self._tokens),
             "client_state": dict(self.client_state),
         }
@@ -1829,6 +2001,29 @@ class StreamEngine:
                 deadline_phases=d.get("deadline_phases"))
 
         eng._pending = [_req_in(d) for d in totals["pending"]]
+        eng._spill_queue = [_req_in(d)
+                            for d in totals.get("spill_queue", [])]
+        if eng._spill_queue and eng._spill is None:
+            # without the backend the spill queue can never drain:
+            # idle stays False forever while every phase is a no-op —
+            # refuse loudly instead of stranding acknowledged requests
+            raise ValueError(
+                f"snapshot carries {len(eng._spill_queue)} "
+                f"spillover-queued request(s) but spillover is not "
+                f"armed on this resume; pass spillover=True")
+        if eng._spill is not None:
+            # pre-crash engagement totals (old snapshots: zero); the
+            # registry counters replay too so the /metrics exposition
+            # matches the ints — same discipline as _replay_registry
+            eng._spill.requests_total = int(
+                totals.get("spill_requests_total", 0))
+            eng._spill.tasks_total = int(
+                totals.get("spill_tasks_total", 0))
+            if eng._spill._c_req is not None:
+                if eng._spill.requests_total:
+                    eng._spill._c_req.inc(eng._spill.requests_total)
+                if eng._spill.tasks_total:
+                    eng._spill._c_tasks.inc(eng._spill.tasks_total)
         eng.completed = [CompletedRequest(
             **{k: (tuple(v) if k == "bounds"
                    else _theta_in(v) if k == "theta" else v)
@@ -1879,12 +2074,18 @@ class StreamEngine:
         match the uninterrupted run's bit-for-bit."""
         for row in self._phase_rows:
             self._publish_phase_row(np.asarray(row, dtype=np.int64))
-        n_admitted = len(self.completed) + len(self._slot_req)
+        # spillover completions never held a slot, so they are not
+        # part of the admitted count the undisturbed run produced
+        n_admitted = sum(1 for c in self.completed
+                         if not getattr(c, "spillover", False)) \
+            + len(self._slot_req)
         if n_admitted:
             self._c_admitted.inc(n_admitted)
         for c in self.completed:
             self._c_retired.inc()
             self._c_tenant_retired.labels(tenant=c.tenant).inc()
+            if getattr(c, "spillover", False):
+                self._c_spillover.inc()
             if c.failed:
                 # failure taxonomy (round 16): deadline expiries have
                 # their own counter; every other failed record is the

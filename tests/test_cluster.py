@@ -1,0 +1,955 @@
+"""Multi-process resilience (round 18, ISSUE 13).
+
+Acceptance surface of the robustness tentpole:
+
+* BOOTSTRAP — real worker subprocesses behind one coordinator; the
+  ``jax.distributed.initialize`` code path (the TPU-pod bootstrap)
+  exercised for real on this container's CPU coordination service;
+  the coordinator-held manifest (process -> devices) joins the
+  checkpoint identity so cross-topology resume is deliberate;
+* SURVIVING-HOST DISCOVERY — SIGKILL one worker mid-stream; the
+  supervisor's new ``host_loss`` arm discovers the surviving topology
+  (ping, not a hand-built smaller mesh) and re-deals the lost host's
+  outstanding requests through ``mesh.host_strided_redeal``;
+  per-request areas BIT-IDENTICAL to the undisturbed run on the
+  dyadic-exact workload, zero lost acknowledged requests;
+* CROSS-TOPOLOGY RESUME both directions (n->m and m->n) behind the
+  ``cluster_resize`` gate, and the corrupt-snapshot-on-ONE-host path
+  routing through recovery (replay from the coordinator ledger)
+  instead of poisoning the cluster;
+* CPU SPILLOVER — queue-overflow victims run as pure-f64 bag rounds
+  off-mesh instead of shedding; spillover areas bit-identical to the
+  engine path on dyadic workloads; engagement device-counted;
+* ``host_loss`` fault kind opt-in: the seeded-schedule pool is
+  regression-pinned so existing seeds keep their schedules;
+* supervisor resize-backoff fix: a resize racing a slow worker
+  teardown retries with the deterministic backoff instead of
+  aborting after one attempt.
+
+Worker engines run the pure-f64 streaming mode (``f64_rounds``) over
+the PACKAGE-registered dyadic family ``quad_scaled`` — worker
+subprocesses cannot see test-module registrations, and dyadic credits
+make per-request areas schedule-independent to the bit.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ppls_tpu.runtime import guard
+from ppls_tpu.runtime.cluster import ClusterStreamEngine
+from ppls_tpu.runtime.faults import (FAULT_KINDS, PHASE_KINDS,
+                                     FaultEvent, FaultInjector,
+                                     FaultPlan)
+from ppls_tpu.runtime.stream import StreamEngine
+from ppls_tpu.obs import Telemetry
+
+# worker sizing: pure-f64 streaming (no Pallas) — fast in a
+# subprocess, and the mode the dyadic bit-identity contract is
+# stated on
+WKW = dict(slots=4, chunk=1 << 10, capacity=1 << 16, lanes=256,
+           roots_per_lane=2, refill_slots=2, seg_iters=32,
+           min_active_frac=0.05, f64_rounds=2)
+
+THETA6 = [1.0, 1.25, 1.5, 2.0, 0.75, 3.0]
+REQS6 = [(t, (0.0, 1.0)) for t in THETA6]
+ARR6 = [0, 0, 1, 2, 3, 4]
+
+
+@pytest.fixture(scope="module")
+def base6():
+    """Single-engine ground truth for the dyadic workload (the
+    undisturbed run every recovery contract compares against)."""
+    return StreamEngine("quad_scaled", 1e-9, **WKW).run(
+        REQS6, arrival_phase=ARR6)
+
+
+def _drive(eng, reqs, arr):
+    k = eng.next_rid
+    while not eng.idle or k < len(reqs):
+        while k < len(reqs) and arr[k] <= eng.phase:
+            eng.submit(*reqs[k])
+            k += 1
+        eng.step()
+    return eng.result()
+
+
+def _spying_telemetry():
+    tel = Telemetry()
+    events = []
+    orig = tel.event
+
+    def spy(name, **kw):
+        events.append((name, kw))
+        return orig(name, **kw)
+
+    tel.event = spy
+    return tel, events
+
+
+# ---------------------------------------------------------------------------
+# host_loss fault kind: opt-in, seeded pool regression-pinned
+# ---------------------------------------------------------------------------
+
+def test_host_loss_fault_kind_is_opt_in_and_pool_unchanged():
+    assert "host_loss" in FAULT_KINDS
+    assert "host_loss" not in PHASE_KINDS
+    # the same-seed-same-schedule contract: adding host_loss must not
+    # move ANY existing seed's schedule (pinned pre-round-18 values)
+    assert [e.describe() for e in FaultPlan.seeded(0).events] == [
+        {"kind": "chip_loss", "at": 1}, {"kind": "crash", "at": 1},
+        {"kind": "hang", "at": 3, "seconds": 1048576.0},
+        {"kind": "nan_poison", "at": 8}]
+    assert [e.describe() for e in FaultPlan.seeded(3).events] == [
+        {"kind": "nan_poison", "at": 1},
+        {"kind": "chip_loss", "at": 3},
+        {"kind": "nan_poison", "at": 7},
+        {"kind": "chip_loss", "at": 9}]
+    for seed in range(24):
+        kinds = {e.kind for e in FaultPlan.seeded(seed).events}
+        assert "host_loss" not in kinds and "sigterm" not in kinds
+
+
+def test_host_loss_event_without_kill_hook_raises_directly():
+    inj = FaultInjector(FaultPlan.from_events(
+        [{"kind": "host_loss", "at": 2, "chip": 1}]))
+    inj.on_phase_open(1, n_dev=3)          # not its phase: no fire
+    with pytest.raises(guard.HostLossError) as ei:
+        inj.on_phase_open(2, n_dev=3)
+    assert ei.value.process == 1
+    assert ei.value.surviving == 2
+    assert guard.classify_failure(ei.value) == "host_loss"
+    # one-shot: the claimed event never re-fires
+    inj.on_phase_open(2, n_dev=3)
+
+
+def test_host_loss_event_with_kill_hook_calls_it():
+    inj = FaultInjector(FaultPlan.from_events(
+        [{"kind": "host_loss", "at": 1}]))
+    killed = []
+    inj.host_kill_fn = killed.append
+    inj.on_phase_open(1, n_dev=2)
+    assert killed == [None]                # default: coordinator picks
+    ev = FaultEvent(kind="host_loss", at=4, chip=0)
+    assert ev.describe() == {"kind": "host_loss", "at": 4, "chip": 0}
+
+
+# ---------------------------------------------------------------------------
+# supervisor: resize failures retry with deterministic backoff
+# ---------------------------------------------------------------------------
+
+def test_supervisor_resize_backoff_retries_then_recovers():
+    """Satellite fix: a resize racing a slow worker teardown (its
+    first attempts fail with a transient connection error) must back
+    off deterministically and retry, not abort the supervised run."""
+    calls = {"run": 0, "resize": 0}
+    slept = []
+
+    def loop():
+        calls["run"] += 1
+        if calls["run"] == 1:
+            raise guard.HostLossError(1, 2, detail="test kill")
+        return "recovered"
+
+    def resize_fn(exc):
+        calls["resize"] += 1
+        if calls["resize"] < 3:
+            raise ConnectionError(
+                "connection reset by worker teardown race")
+        return loop
+
+    sup = guard.Supervisor(loop, resize_fn=resize_fn,
+                           backoff_base=1.0, backoff_cap=60.0,
+                           log=lambda m: None, sleep=slept.append)
+    assert sup.run() == "recovered"
+    assert calls["resize"] == 3
+    assert slept == [1.0, 2.0]             # deterministic exponential
+    assert sup.recoveries == [
+        ("host_loss", "resize_backoff"),
+        ("host_loss", "resize_backoff"),
+        ("host_loss", "resize_resume")]
+
+
+def test_supervisor_resize_backoff_budget_exhausts():
+    def loop():
+        raise guard.HostLossError(0, 2, detail="test kill")
+
+    def resize_fn(exc):
+        raise ConnectionError("connection reset")
+
+    # backoff schedule 10, 20, ...: the second resize failure's 20 s
+    # backoff would blow the 15 s budget -> RetryBudgetExhausted
+    # (no real sleeping: the first 10 s backoff is a no-op stub and
+    # elapsed wall stays ~0)
+    sup = guard.Supervisor(
+        loop, resize_fn=resize_fn, backoff_base=10.0,
+        total_deadline=15.0, log=lambda m: None,
+        sleep=lambda s: None)
+    with pytest.raises(guard.RetryBudgetExhausted):
+        sup.run()
+    assert ("host_loss", "resize_backoff") in sup.recoveries
+
+
+def test_supervisor_fatal_resize_failure_propagates():
+    def loop():
+        raise guard.HostLossError(0, 2, detail="test kill")
+
+    def resize_fn(exc):
+        raise ValueError("store does not fit")   # classified fatal
+
+    sup = guard.Supervisor(loop, resize_fn=resize_fn,
+                           log=lambda m: None, sleep=lambda s: None)
+    with pytest.raises(ValueError, match="store does not fit"):
+        sup.run()
+    assert sup.recoveries == []
+
+
+# ---------------------------------------------------------------------------
+# CPU spillover (single engine)
+# ---------------------------------------------------------------------------
+
+def test_spillover_engages_under_overload_and_matches_engine():
+    """Overload victims run off-mesh instead of shedding; spillover
+    areas are BIT-IDENTICAL to the engine path on the dyadic
+    workload, and the accounting invariant holds with zero sheds."""
+    reqs = [(t, (0.0, 1.0))
+            for t in [1.0, 1.25, 1.5, 2.0, 0.75, 3.0, 1.75, 2.5]]
+    base = StreamEngine("quad_scaled", 1e-9, **WKW).run(reqs)
+    tel, events = _spying_telemetry()
+    eng = StreamEngine("quad_scaled", 1e-9, queue_limit=2,
+                       spillover=True, spillover_limit=2,
+                       telemetry=tel, **WKW)
+    res = eng.run(reqs, arrival_phase=[0] * len(reqs))
+    assert np.array_equal(res.areas, base.areas)
+    assert len(res.completed) == len(reqs)
+    assert not res.shed
+    s = res.spillover_summary()
+    assert s["spillover_completed"] > 0
+    assert 0.0 < s["spillover_fraction"] <= 1.0
+    assert any(n == "spillover_enqueued" for n, _ in events)
+    # device-counted engagement on the registry
+    assert tel.registry.value("ppls_spillover_tasks_total") > 0
+    assert tel.registry.value("ppls_stream_spillover_total") \
+        == s["spillover_completed"]
+
+
+def test_spillover_deadline_requests_still_shed():
+    """Slower capacity cannot bound latency: a deadline-carrying
+    overflow victim sheds with the explicit record, as before."""
+    eng = StreamEngine("quad_scaled", 1e-9, queue_limit=1,
+                       spillover=True, **WKW)
+    for t in [1.0, 1.25, 1.5]:
+        eng.submit(t, (0.0, 1.0), deadline_phases=2)
+    assert len(eng.shed) == 2
+    assert all(s.reason == "queue_full" for s in eng.shed)
+    eng.drain()
+
+
+def test_spillover_queue_survives_kill_and_resume(tmp_path):
+    """The zero-lost-acks contract covers the spill queue: a crash
+    with spillover work queued resumes and completes everything with
+    the identical areas."""
+    reqs = [(t, (0.0, 1.0))
+            for t in [1.0, 1.25, 1.5, 2.0, 0.75, 3.0, 1.75, 2.5]]
+    base = StreamEngine("quad_scaled", 1e-9, **WKW).run(reqs)
+    ck = str(tmp_path / "spill.ckpt")
+    kw = dict(WKW, queue_limit=2, spillover=True, spillover_limit=1)
+    eng = StreamEngine("quad_scaled", 1e-9, checkpoint_path=ck,
+                       checkpoint_every=1, **kw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(reqs, arrival_phase=[0] * len(reqs),
+                _crash_after_phases=2)
+    eng2 = StreamEngine.resume(ck, "quad_scaled", 1e-9,
+                               checkpoint_every=1, **kw)
+    assert eng2._spill_queue            # acked work survived the kill
+    res = _drive(eng2, reqs, [0] * len(reqs))
+    assert np.array_equal(res.areas, base.areas)
+    assert len(res.completed) == len(reqs)
+
+
+def test_spillover_executor_matches_bag_engine():
+    from ppls_tpu.backends.spillover import (SpilloverExecutor,
+                                             spillover_available)
+    from ppls_tpu.parallel.bag_engine import integrate_family
+    from ppls_tpu.models.integrands import get_family
+    assert spillover_available()
+    ex = SpilloverExecutor("quad_scaled", 1e-9, chunk=1 << 10,
+                           capacity=1 << 16)
+    areas, tasks, wall = ex.run(1.5, (0.0, 1.0))
+    ref = integrate_family(get_family("quad_scaled"),
+                           np.array([1.5]), (0.0, 1.0), 1e-9,
+                           chunk=1 << 10, capacity=1 << 16)
+    assert areas == [float(np.asarray(ref.areas)[0])]
+    assert tasks == int(ref.metrics.tasks) > 0
+    assert ex.tasks_total == tasks
+
+
+def test_spillover_single_backend_dispatch():
+    from ppls_tpu.backends import run_spillover_single
+    from ppls_tpu.config import QuadConfig
+    cfg = QuadConfig(integrand="sin", a=0.0, b=1.0, eps=1e-6,
+                     capacity=1 << 16)
+    res = run_spillover_single(cfg)
+    assert res.exact is not None
+    assert res.global_error < 1e-3
+    assert res.metrics.tasks > 0
+
+
+# ---------------------------------------------------------------------------
+# the cluster: bootstrap, parity, manifest identity
+# ---------------------------------------------------------------------------
+
+def test_cluster_bootstrap_manifest_and_area_parity(base6, tmp_path):
+    tel, events = _spying_telemetry()
+    ck = str(tmp_path / "c.ckpt")
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=WKW, telemetry=tel,
+                              checkpoint_path=ck)
+    try:
+        ident = eng.manifest.identity()
+        assert ident["processes"] == 2
+        assert len(ident["devices"]) == 2
+        assert all(d >= 1 for d in ident["devices"])
+        assert any(n == "cluster_bootstrap" for n, _ in events)
+        res = eng.run(REQS6, arrival_phase=ARR6)
+        # per-request areas: bit-identical to the single-process
+        # engine (requests are the unit of cross-host state; dyadic
+        # credits are schedule-independent to the bit)
+        assert np.array_equal(res.areas, base6.areas)
+        assert len(res.completed) == len(REQS6)
+        # the manifest rides the checkpoint identity
+        eng.snapshot()
+        from ppls_tpu.runtime.checkpoint import \
+            load_family_checkpoint
+        with pytest.raises(ValueError, match="different run"):
+            load_family_checkpoint(ck, {"engine": "cluster-stream"})
+    finally:
+        eng.close()
+
+
+def test_cluster_host_loss_discovery_redeal_bit_identical(base6):
+    """THE ROUND-18 ACCEPTANCE, engine level: SIGKILL worker 1
+    mid-stream; the supervisor's host_loss arm discovers the
+    surviving topology and re-deals through host_strided_redeal;
+    areas bit-identical, zero lost acknowledged requests."""
+    tel, events = _spying_telemetry()
+    inj = FaultInjector(FaultPlan.from_events(
+        [{"kind": "host_loss", "at": 2, "chip": 1}]), telemetry=tel)
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=WKW, fault_injector=inj,
+                              telemetry=tel)
+
+    def loop():
+        return _drive(eng, REQS6, ARR6)
+
+    def resize_fn(exc):
+        eng.recover_host_loss(exc)
+        return loop
+
+    sup = guard.Supervisor(loop, resize_fn=resize_fn,
+                           log=lambda m: None, sleep=lambda s: None)
+    try:
+        res = sup.run()
+        assert sup.recoveries == [("host_loss", "resize_resume")]
+        assert eng.manifest.identity()["processes"] == 1
+        assert np.array_equal(res.areas, base6.areas)
+        # zero lost acks: every submitted rid retired exactly once
+        assert sorted(c.rid for c in res.completed) \
+            == list(range(len(REQS6)))
+        names = [n for n, _ in events]
+        assert "host_killed" in names
+        assert "host_loss_discovery" in names
+        assert "cluster_redeal" in names
+        assert eng.redeal_walls and eng.redeal_walls[0] < 30.0
+    finally:
+        eng.close()
+
+
+def test_cluster_cross_topology_resume_both_directions(base6,
+                                                       tmp_path):
+    ck = str(tmp_path / "xt.ckpt")
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=WKW, checkpoint_path=ck,
+                              checkpoint_every=1)
+    try:
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            eng.run(REQS6, arrival_phase=ARR6,
+                    _crash_after_phases=3)
+    finally:
+        eng.close()
+    # without the flag: the deliberate-resize gate refuses
+    with pytest.raises(ValueError, match="different run"):
+        ClusterStreamEngine.resume(ck, "quad_scaled", 1e-9,
+                                   n_processes=1, worker_kw=WKW)
+    # n -> m (2 -> 1): outstanding re-deals, drain completes, areas
+    # bit-identical
+    e1 = ClusterStreamEngine.resume(ck, "quad_scaled", 1e-9,
+                                    n_processes=1, worker_kw=WKW,
+                                    cluster_resize=True,
+                                    checkpoint_every=1)
+    try:
+        res = _drive(e1, REQS6, ARR6)
+        assert np.array_equal(res.areas, base6.areas)
+        assert len(res.completed) == len(REQS6)
+        e1.snapshot()
+    finally:
+        e1.close()
+    # m -> n (1 -> 2): the finished ledger carries over intact
+    e2 = ClusterStreamEngine.resume(ck, "quad_scaled", 1e-9,
+                                    n_processes=2, worker_kw=WKW,
+                                    cluster_resize=True)
+    try:
+        assert len(e2.completed) == len(REQS6)
+        assert e2.idle
+        assert np.array_equal(e2.result().areas, base6.areas)
+    finally:
+        e2.close()
+
+
+def test_cluster_corrupt_worker_snapshot_is_recoverable(base6,
+                                                        tmp_path):
+    """CheckpointCorruptError on ONE host routes through recovery
+    (fresh worker + ledger replay), never poisons the cluster."""
+    ck = str(tmp_path / "cw.ckpt")
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=WKW, checkpoint_path=ck,
+                              checkpoint_every=1)
+    try:
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            eng.run(REQS6, arrival_phase=ARR6,
+                    _crash_after_phases=3)
+    finally:
+        eng.close()
+    p0 = ck + ".p0"
+    assert os.path.exists(p0)
+    with open(p0, "r+b") as fh:           # truncation: always caught
+        fh.truncate(os.path.getsize(p0) // 2)
+    tel, events = _spying_telemetry()
+    e2 = ClusterStreamEngine.resume(ck, "quad_scaled", 1e-9,
+                                    n_processes=2, worker_kw=WKW,
+                                    checkpoint_every=1,
+                                    telemetry=tel)
+    try:
+        res = _drive(e2, REQS6, ARR6)
+        assert np.array_equal(res.areas, base6.areas)
+        assert len(res.completed) == len(REQS6)
+        assert any(n == "worker_snapshot_corrupt" for n, _ in events)
+    finally:
+        e2.close()
+
+
+def test_cluster_spillover_under_overload_and_host_loss(base6):
+    """The degraded-cluster acceptance: overload + one host killed —
+    the survivors shed load to the CPU spillover backend (engaged
+    share > 0) before shedding any request, and every area still
+    matches the undisturbed single-engine run to the bit."""
+    inj = FaultInjector(FaultPlan.from_events(
+        [{"kind": "host_loss", "at": 1, "chip": 1}]))
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=WKW, fault_injector=inj,
+                              queue_limit=2, spillover=True,
+                              spillover_limit=2)
+
+    def loop():
+        return _drive(eng, REQS6, [0] * len(REQS6))
+
+    def resize_fn(exc):
+        eng.recover_host_loss(exc)
+        return loop
+
+    sup = guard.Supervisor(loop, resize_fn=resize_fn,
+                           log=lambda m: None, sleep=lambda s: None)
+    base = StreamEngine("quad_scaled", 1e-9, **WKW).run(REQS6)
+    try:
+        res = sup.run()
+        assert len(res.completed) == len(REQS6)
+        assert not res.shed                 # spillover, not rejection
+        assert np.array_equal(res.areas, base.areas)
+        s = eng.spillover_summary()
+        assert s["spillover_completed"] > 0
+        assert s["spillover_tasks"] > 0     # device-counted share
+        assert 0.0 < s["spillover_fraction"] <= 1.0
+    finally:
+        eng.close()
+
+
+def test_jax_distributed_bootstrap_code_path():
+    """The TPU-pod bootstrap for real: two workers call
+    ``jax.distributed.initialize`` against a shared coordination
+    service and each reports the GLOBAL device picture spanning both
+    processes — proving the initialize code path works on this
+    container (cross-process computations stay host-local; that is
+    the documented CPU-backend limitation the census pins)."""
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=dict(WKW),
+                              jax_distributed=True)
+    try:
+        infos = [w.hello.get("jax_distributed")
+                 for w in eng._workers]
+        assert all(i is not None for i in infos)
+        local = [i["local_devices"] for i in infos]
+        assert all(i["global_devices"] == sum(local) for i in infos)
+        assert sorted(i["process_id"] for i in infos) == [0, 1]
+        # and the cluster still serves over it
+        res = eng.run(REQS6[:2])
+        assert len(res.completed) == 2
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# serve CLI: the full acceptance (kill one HOST under --supervise)
+# ---------------------------------------------------------------------------
+
+def _cli_wkw() -> dict:
+    """Worker kwargs matching what the serve CLI sends its workers —
+    the engine-level crash runs in the CLI restart tests must produce
+    per-worker snapshots the CLI-spawned workers can resume, so the
+    identity-bearing keys (and ONLY the keys the CLI passes) agree."""
+    kw = dict(WKW, theta_block=1)
+    for k in ("roots_per_lane", "seg_iters", "min_active_frac"):
+        kw.pop(k, None)
+    return kw
+
+
+def _serve_cluster_args(tmp_path, tag, extra):
+    ev = str(tmp_path / f"{tag}.events.jsonl")
+    return [
+        "serve", "--processes", "2", "--f64-rounds", "2",
+        "--family", "quad_scaled",
+        # DYADIC thetas (the linspace default is not): per-request
+        # areas are then schedule-independent to the bit, which is
+        # what the kill-vs-undisturbed comparison asserts
+        "--theta", "1.0,1.25,1.5,2.0,0.75,3.0",
+        "--arrival-rate", "2", "--seed", "0", "--eps", "1e-9",
+        "-a", "0.0", "-b", "1.0", "--slots", "4",
+        "--chunk", "1024", "--capacity", "65536",
+        "--lanes", "256", "--refill-slots", "2",
+        "--events", ev] + extra, ev
+
+
+def test_serve_cli_kill_one_host_under_supervise(tmp_path, capsys):
+    """THE ROUND-18 ACCEPTANCE, CLI level: kill one HOST mid-stream
+    under ``serve --supervise`` on a 2-process local cluster — the
+    run resumes onto the survivor, per-request areas are
+    bit-identical to the undisturbed run, zero lost acks, and the
+    events timeline validates with per-process spans."""
+    from ppls_tpu import __main__ as cli
+    from ppls_tpu.utils.artifact_schema import (
+        validate_events_text, validate_serve_output_text)
+
+    argv, ev0 = _serve_cluster_args(tmp_path, "base", [])
+    assert cli.main(argv) == 0
+    out0 = capsys.readouterr().out
+    base = {d["rid"]: d["area"] for d in
+            map(json.loads, out0.strip().splitlines())
+            if "rid" in d and not d.get("summary")}
+
+    argv, ev1 = _serve_cluster_args(
+        tmp_path, "kill",
+        ["--supervise", "--fault-plan",
+         '[{"kind": "host_loss", "at": 2, "chip": 1}]'])
+    assert cli.main(argv) == 0
+    out1 = capsys.readouterr().out
+    lines = [json.loads(ln) for ln in out1.strip().splitlines()]
+    summary = lines[-1]
+    assert summary["summary"] and summary["supervised"]
+    assert summary["completed"] == 6                # zero lost acks
+    assert summary["manifest"]["processes"] == 1    # survivor only
+    assert [r["kind"] for r in summary["recoveries"]] \
+        == ["host_loss"]
+    assert summary["redeal_walls_s"]
+    got = {d["rid"]: d["area"] for d in lines[:-1]
+           if "rid" in d and not d.get("summary")}
+    assert got == base                              # bit-identical
+    assert validate_serve_output_text(out1) == []
+    ev_text = open(ev1).read()
+    assert validate_events_text(ev_text) == []
+    # the flight recorder's per-process spans tell the story
+    recs = [json.loads(ln) for ln in ev_text.splitlines()
+            if ln.strip()]
+    assert any(d.get("ev") == "span_open"
+               and d.get("name") == "process" for d in recs)
+    names = {d.get("name") for d in recs if d.get("ev") == "event"}
+    assert {"cluster_bootstrap", "host_killed",
+            "host_loss_discovery", "cluster_redeal"} <= names
+
+
+def test_serve_cli_cluster_checkpoint_restart(base6, tmp_path,
+                                              capsys):
+    """Review fix (round 18): the CLI restart path used to pass
+    checkpoint_path twice into ``ClusterStreamEngine.resume`` (once
+    positionally, once inside the kwarg dict) and crash with a
+    TypeError — the advertised zero-lost-acks restart never worked.
+    Crash an engine-level run mid-stream, then restart through the
+    REAL serve CLI pointing at its snapshot: every request completes
+    with the undisturbed areas."""
+    from ppls_tpu import __main__ as cli
+
+    ck = str(tmp_path / "cli.ckpt")
+    # theta_block=1 matches the CLI's worker_kw so the snapshot
+    # identity agrees between the two spellings
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=_cli_wkw(),
+                              checkpoint_path=ck, checkpoint_every=1)
+    try:
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            eng.run(REQS6, arrival_phase=ARR6,
+                    _crash_after_phases=3)
+    finally:
+        eng.close()
+    assert os.path.exists(ck)
+
+    argv, _ev = _serve_cluster_args(tmp_path, "restart",
+                                    ["--checkpoint", ck])
+    assert cli.main(argv) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    summary = lines[-1]
+    assert summary["summary"] and summary["completed"] == 6
+    got = {d["rid"]: d["area"] for d in lines[:-1]
+           if "rid" in d and not d.get("summary")}
+    assert sorted(got) == list(range(6))
+    assert np.array_equal(
+        np.array([got[r] for r in sorted(got)]), base6.areas)
+    assert not os.path.exists(ck)       # drained runs clean up
+
+
+def test_serve_cli_cluster_sigterm_graceful_restart(base6, tmp_path,
+                                                    capsys):
+    """Review fix (round 18): the cluster serve path had NO
+    GracefulShutdown — a fault-plan SIGTERM killed the coordinator
+    with exit 143 (no final snapshot beyond the cadence, no summary
+    line). The documented sigterm contract now holds under
+    --processes too: flag at the boundary, final snapshot KEPT,
+    summary carries "terminated", exit 0, and the same-command
+    restart completes with zero lost acks and the undisturbed
+    areas."""
+    from ppls_tpu import __main__ as cli
+
+    ck = str(tmp_path / "sig.ckpt")
+    argv, _ev = _serve_cluster_args(
+        tmp_path, "sig",
+        ["--checkpoint", ck, "--checkpoint-every", "1",
+         "--fault-plan",
+         '[{"kind": "sigterm", "at": 2, "edge": "close"}]'])
+    assert cli.main(argv) == 0
+    lines1 = [json.loads(ln) for ln in
+              capsys.readouterr().out.strip().splitlines()]
+    s1 = lines1[-1]
+    assert s1["summary"] and s1.get("terminated") == "SIGTERM"
+    assert os.path.exists(ck), "graceful shutdown must keep the " \
+                               "snapshot (it IS the restart state)"
+    argv, _ev = _serve_cluster_args(tmp_path, "sig2",
+                                    ["--checkpoint", ck])
+    assert cli.main(argv) == 0
+    lines2 = [json.loads(ln) for ln in
+              capsys.readouterr().out.strip().splitlines()]
+    s2 = lines2[-1]
+    assert s2["summary"] and s2["completed"] == 6
+    got = {}
+    for d in lines1[:-1] + lines2[:-1]:
+        if "rid" in d and not d.get("summary"):
+            got[d["rid"]] = d["area"]
+    assert sorted(got) == list(range(6))
+    assert np.array_equal(
+        np.array([got[r] for r in sorted(got)]), base6.areas)
+
+
+def test_serve_cli_cluster_watchdog_hang_rebuilds_engine(
+        base6, tmp_path, capsys):
+    """Review fix (round 18): a --watchdog timeout abandons its
+    attempt thread mid-phase, so the supervisor's transient retry
+    must NOT re-drive the same live cluster — the stale thread may
+    still own the worker sockets, and two drivers desync the
+    newline-JSON command/reply pairing. The retry now force-kills
+    the stale cluster and rebuilds from the checkpoint (the
+    single-process loop's self-resuming shape). Inject a
+    forever-hang at a phase boundary: the watchdog fires, and the
+    rebuilt engine finishes with zero lost acks and the undisturbed
+    areas."""
+    from ppls_tpu import __main__ as cli
+
+    ck = str(tmp_path / "hang.ckpt")
+    argv, _ev = _serve_cluster_args(
+        tmp_path, "hang",
+        ["--supervise", "--watchdog", "15",
+         "--checkpoint", ck, "--checkpoint-every", "1",
+         "--fault-plan", '[{"kind": "hang", "at": 2}]'])
+    assert cli.main(argv) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    summary = lines[-1]
+    assert summary["summary"] and summary["supervised"]
+    assert summary["completed"] == 6                # zero lost acks
+    assert summary["attempts"] >= 2
+    assert {"kind": "transient", "action": "backoff_resume"} \
+        in summary["recoveries"]
+    assert [f["kind"] for f in summary["faults_injected"]] \
+        == ["hang"]
+    # the rebuilt ledger re-prints from 0 (rid dedupe, the restart
+    # contract) — dedupe and compare against the undisturbed run
+    got = {d["rid"]: d["area"] for d in lines[:-1]
+           if "rid" in d and not d.get("summary")}
+    assert sorted(got) == list(range(6))
+    assert np.array_equal(
+        np.array([got[r] for r in sorted(got)]), base6.areas)
+
+
+def test_serve_cli_cluster_corrupt_coordinator_starts_clean(
+        base6, tmp_path, capsys):
+    """Review fix (round 18): a corrupt COORDINATOR snapshot must
+    take the per-process sibling snapshots down with it — a fresh
+    coordinator re-issues grids from 0, so a stale worker gmap would
+    credit ghost retirements against the wrong new request."""
+    from ppls_tpu import __main__ as cli
+
+    ck = str(tmp_path / "corrupt.ckpt")
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=_cli_wkw(),
+                              checkpoint_path=ck, checkpoint_every=1)
+    try:
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            eng.run(REQS6, arrival_phase=ARR6,
+                    _crash_after_phases=3)
+    finally:
+        eng.close()
+    assert os.path.exists(ck + ".p0")
+    with open(ck, "r+b") as fh:
+        fh.truncate(os.path.getsize(ck) // 2)
+
+    argv, _ev = _serve_cluster_args(tmp_path, "fresh",
+                                    ["--checkpoint", ck])
+    assert cli.main(argv) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    summary = lines[-1]
+    assert summary["summary"] and summary["completed"] == 6
+    got = {d["rid"]: d["area"] for d in lines[:-1]
+           if "rid" in d and not d.get("summary")}
+    assert sorted(got) == list(range(6))
+    assert np.array_equal(
+        np.array([got[r] for r in sorted(got)]), base6.areas)
+
+
+def test_cluster_deal_partial_failure_preserves_survivor_batches(
+        base6):
+    """Review fix (round 18): a worker death surfacing DURING the
+    deal must not strand the batches destined for later, still-alive
+    workers — un-sent batches roll back to pending (state no recovery
+    arm would otherwise cover) and the run completes on the
+    survivor."""
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=2,
+                              worker_kw=WKW)
+    try:
+        for t in THETA6:
+            eng.submit(t, (0.0, 1.0))
+        eng.kill_process(0)             # dies before the next deal
+        with pytest.raises(guard.HostLossError):
+            eng.step()
+        # worker 1's batch rolled back instead of vanishing
+        assert eng.pending > 0
+        assert eng.recover_host_loss() == 1
+        res = _drive(eng, [], [])
+        assert sorted(c.rid for c in res.completed) \
+            == list(range(len(THETA6)))
+        assert np.array_equal(res.areas, base6.areas)
+    finally:
+        eng.close()
+
+
+def test_spillover_resume_without_backend_refuses(tmp_path):
+    """Review fix (round 18): a snapshot carrying a non-empty spill
+    queue resumed WITHOUT spillover armed used to hang forever (idle
+    never True, every phase a no-op); now it refuses loudly."""
+    reqs = [(t, (0.0, 1.0))
+            for t in [1.0, 1.25, 1.5, 2.0, 0.75, 3.0, 1.75, 2.5]]
+    ck = str(tmp_path / "nospill.ckpt")
+    kw = dict(WKW, queue_limit=2, spillover=True, spillover_limit=1)
+    eng = StreamEngine("quad_scaled", 1e-9, checkpoint_path=ck,
+                       checkpoint_every=1, **kw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(reqs, arrival_phase=[0] * len(reqs),
+                _crash_after_phases=2)
+    with pytest.raises(ValueError, match="spillover"):
+        StreamEngine.resume(ck, "quad_scaled", 1e-9,
+                            checkpoint_every=1,
+                            **dict(WKW, queue_limit=2))
+
+
+def test_serve_cli_cluster_refuses_tenant_quotas(tmp_path):
+    """The cluster coordinator does not implement per-tenant token
+    buckets — the flag must refuse loudly, not silently drop."""
+    from ppls_tpu import __main__ as cli
+    argv, _ev = _serve_cluster_args(
+        tmp_path, "quotas",
+        ["--tenant-quotas", '{"a": {"rate": 1, "burst": 1}}'])
+    with pytest.raises(SystemExit, match="tenant-quotas"):
+        cli.main(argv)
+
+
+def test_serve_cli_cluster_refuses_metrics_port(tmp_path):
+    """Review fix (round 18): --metrics-port with --processes used to
+    be silently ignored (no listener, no metrics_port in the summary)
+    — a scrape-based harness would collect nothing for the whole run.
+    Unsupported cluster flags refuse loudly."""
+    from ppls_tpu import __main__ as cli
+    argv, _ev = _serve_cluster_args(tmp_path, "mport",
+                                    ["--metrics-port", "0"])
+    with pytest.raises(SystemExit, match="metrics-port"):
+        cli.main(argv)
+
+
+def test_serve_cli_cluster_refuses_bad_process_counts(tmp_path):
+    """Review fix (round 18): --processes 0 used to fall through the
+    truthiness check into the SINGLE-process serve path (a sweep
+    script got a silently different engine for P=0) and negative
+    counts surfaced as raw tracebacks — both are clean usage errors
+    now."""
+    from ppls_tpu import __main__ as cli
+    for bad in ("0", "-1"):
+        argv, _ev = _serve_cluster_args(tmp_path, f"p{bad}",
+                                        ["--processes", bad])
+        with pytest.raises(SystemExit, match="processes"):
+            cli.main(argv)
+
+
+def test_spillover_idle_tail_phases_checkpoint(tmp_path):
+    """Review fix (round 18): the idle branch of ``step()`` (device
+    drained, spill queue still busy) used to skip the checkpoint
+    cadence entirely — a kill mid-tail replayed every completed bag
+    round and re-printed its rids. Idle phases now honor
+    checkpoint_every like every other phase."""
+    # 12 dyadic thetas: pending holds queue_limit=2, the spill queue
+    # caps at 8, the rest shed — by the time the two admitted
+    # requests retire (~6 phases, one spill batch each) the device is
+    # drained with spillover work still queued: the tail state
+    reqs = [(t, (0.0, 1.0))
+            for t in [1.0, 1.25, 1.5, 2.0, 0.75, 3.0, 1.75, 2.5,
+                      0.5, 1.125, 2.25, 2.75]]
+    ck = str(tmp_path / "tail.ckpt")
+    kw = dict(WKW, queue_limit=2, spillover=True, spillover_limit=1)
+    eng = StreamEngine("quad_scaled", 1e-9, checkpoint_path=ck,
+                       checkpoint_every=1, **kw)
+    for r in reqs:
+        eng.submit(*r)
+    for _ in range(64):        # drive to the drained-tail state
+        if eng._count == 0 and not eng.pending and eng._spill_queue:
+            break
+        eng.step()
+    qlen = len(eng._spill_queue)
+    assert qlen >= 1
+    eng.step()                 # one IDLE phase: spillover batch only
+    assert len(eng._spill_queue) == qlen - 1
+    eng2 = StreamEngine.resume(ck, "quad_scaled", 1e-9,
+                               checkpoint_every=1, **kw)
+    # the idle phase checkpointed: the resumed queue matches the live
+    # one instead of replaying the whole tail
+    assert len(eng2._spill_queue) == len(eng._spill_queue)
+    assert eng2.phase == eng.phase
+
+
+def test_spillover_engagement_totals_survive_kill_and_resume(
+        tmp_path):
+    """Review fix (round 18): the single-process snapshot persisted
+    the spill QUEUE but not the executor's engagement totals, so
+    ``ppls_spillover_{requests,tasks}_total`` restarted at zero after
+    every kill — the device-counted engagement metric the bench gate
+    keys on underreported all pre-crash work."""
+    reqs = [(t, (0.0, 1.0))
+            for t in [1.0, 1.25, 1.5, 2.0, 0.75, 3.0, 1.75, 2.5]]
+    ck = str(tmp_path / "spilltot.ckpt")
+    kw = dict(WKW, queue_limit=2, spillover=True, spillover_limit=1)
+    eng = StreamEngine("quad_scaled", 1e-9, checkpoint_path=ck,
+                       checkpoint_every=1, **kw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(reqs, arrival_phase=[0] * len(reqs),
+                _crash_after_phases=3)
+    pre_req = eng._spill.requests_total
+    pre_tasks = eng._spill.tasks_total
+    assert pre_req > 0 and pre_tasks > 0    # spillover engaged
+    eng2 = StreamEngine.resume(ck, "quad_scaled", 1e-9,
+                               checkpoint_every=1, **kw)
+    # the snapshot may trail the crash by at most the final phase's
+    # batch; it must never restart at zero
+    assert 0 < eng2._spill.requests_total <= pre_req
+    assert 0 < eng2._spill.tasks_total <= pre_tasks
+    # the registry exposition replays the restored totals too
+    assert eng2.telemetry.registry.value(
+        "ppls_spillover_requests_total") \
+        == eng2._spill.requests_total
+    restored = eng2._spill.tasks_total
+    res = _drive(eng2, reqs, [0] * len(reqs))
+    assert len(res.completed) == len(reqs)
+    assert eng2._spill.tasks_total > restored   # kept accumulating
+
+
+def test_spillover_queue_is_bounded_then_sheds():
+    """Review fix (round 18): the spill queue is capped (8x
+    spillover_limit) — sustained deadline-less overload beyond it
+    sheds with an explicit record instead of re-growing the unbounded
+    backlog queue_limit exists to prevent."""
+    eng = StreamEngine("quad_scaled", 1e-9, queue_limit=1,
+                       spillover=True, spillover_limit=1, **WKW)
+    for k in range(12):
+        eng.submit(1.0 + 0.25 * k, (0.0, 1.0))
+    assert len(eng._spill_queue) == 8          # the cap
+    assert len(eng.shed) == 3                  # 12 - 1 pending - 8
+    assert all(s.reason == "spill_queue_full" for s in eng.shed)
+    res = _drive(eng, [], [])
+    assert len(res.completed) == 9
+    assert not any(c.failed for c in res.completed)
+
+
+def test_spillover_quarantines_poisoned_request():
+    """Review fix (round 18): the NaN-quarantine contract covers the
+    spillover path — a poisoned spilled request retires as a FAILED
+    record while healthy concurrent work (engine and spillover alike)
+    completes, never an engine-wide FloatingPointError."""
+    eng = StreamEngine("quad_scaled", 1e-9, queue_limit=1,
+                       spillover=True, spillover_limit=2,
+                       quarantine=True, **WKW)
+    eng.submit(2.0, (0.0, 1.0))                # engine path
+    eng.submit(3.0, (0.0, 1.0))                # healthy spill
+    eng.submit(1.5, (0.0, 1.0))                # to be poisoned
+    assert len(eng._spill_queue) == 2
+    # the round-14 injector shape: corrupt POST-validation, so the
+    # engine genuinely computes with the non-finite payload
+    eng._spill_queue[1].theta = float("nan")
+    res = _drive(eng, [], [])
+    assert len(res.completed) == 3
+    by_rid = {c.rid: c for c in res.completed}
+    assert by_rid[2].failed and by_rid[2].failure == "nan"
+    assert by_rid[2].spillover
+    assert not by_rid[0].failed and not by_rid[1].failed
+
+
+def test_cluster_worker_deadline_sheds_reach_coordinator():
+    """Review fix (round 18): a worker-side deadline shed is a
+    TERMINAL outcome the coordinator must adopt — otherwise the
+    ledger entry stays 'dealt' forever and the cluster never goes
+    idle. Also pins the coordinator's mirrored pre-rid validation."""
+    eng = ClusterStreamEngine("quad_scaled", 1e-9, n_processes=1,
+                              worker_kw=WKW)
+    try:
+        with pytest.raises(ValueError, match="deadline_phases"):
+            eng.submit(1.0, (0.0, 1.0), deadline_phases=0)
+        with pytest.raises(ValueError, match="theta_block"):
+            eng.submit([1.0, 2.0], (0.0, 1.0))
+        for t in THETA6:
+            eng.submit(t, (0.0, 1.0), deadline_phases=1)
+        for _ in range(60):
+            eng.step()
+            if eng.idle:
+                break
+        assert eng.idle                    # terminates, never spins
+        res = eng.result()
+        assert len(res.completed) + len(res.shed) == len(THETA6)
+        # every acknowledged rid ends in exactly one terminal state
+        rids = sorted([c.rid for c in res.completed]
+                      + [s.rid for s in res.shed])
+        assert rids == list(range(len(THETA6)))
+    finally:
+        eng.close()

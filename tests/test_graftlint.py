@@ -763,6 +763,62 @@ def test_gl01_mesh_resize_fixed_by_identity_key(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Round 18 — the cluster_resize compat rule vs the GL01 surface
+# ---------------------------------------------------------------------------
+
+GL01_CLUSTER_BROKEN = """
+    from typing import NamedTuple
+
+    class _CoordCarry(NamedTuple):
+        bag_l: object
+        acc: object
+        cluster: object  # <- the process->devices manifest: the
+        #                   topology the resume must re-deal by, so
+        #                   it is identity
+
+    def run_cluster(c: _CoordCarry):
+        return c
+
+    def integrate(state, checkpoint_path):
+        out = run_cluster(state)
+        identity = {"engine": "cluster-stream", "eps": 1e-6}
+        save_family_checkpoint(
+            checkpoint_path, identity=identity,
+            bag_cols={"l": out.bag_l}, count=1, acc=out.acc,
+            totals={})
+        return out
+
+    def resume(path, identity):
+        return load_family_checkpoint(path, identity,
+                                      cluster_resize=True)
+"""
+
+
+def test_gl01_cluster_resize_keyword_does_not_cover_manifest(
+        tmp_path):
+    # the round-18 compat rule relaxes the `cluster` COMPARISON at
+    # load time — it must not relax the GL01 surface: a coordinator
+    # carry whose manifest never reaches the identity dict still
+    # fires even though the resume path spells "cluster_resize"
+    pkg = _mkpkg(tmp_path,
+                 {"runtime/cluster.py": GL01_CLUSTER_BROKEN})
+    got = [v for v in run_lint(pkg) if v.code == "GL01"]
+    assert [v.symbol for v in got] == ["_CoordCarry.cluster"], got
+
+
+def test_gl01_cluster_manifest_fixed_by_identity_key(tmp_path):
+    # the real coordinator's shape: the manifest ON the identity (the
+    # elastic loader then relaxes exactly that one key under
+    # cluster_resize — cross-topology resume stays deliberate)
+    fixed = GL01_CLUSTER_BROKEN.replace(
+        '{"engine": "cluster-stream", "eps": 1e-6}',
+        '{"engine": "cluster-stream", "eps": 1e-6,\n'
+        '                    "cluster": {"processes": 2}}')
+    pkg = _mkpkg(tmp_path, {"runtime/cluster.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL01"] == []
+
+
+# ---------------------------------------------------------------------------
 # Round 17 — GL11 lock discipline (the PR-10 ingest race shape)
 # ---------------------------------------------------------------------------
 

@@ -45,6 +45,7 @@ import json
 import math
 import os
 import sys
+import time
 from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -119,6 +120,33 @@ STREAM_SLO_FAULTS = (
 # (phases) may grow <= 25% over the reference
 GATE_SHED_ABS_TOL = 0.15
 GATE_STREAM_P99_TOL = 0.25
+
+# Round 18: the multi-host proxy leg (bench.py multihost / the quick
+# record's multihost block). A real 2-process local cluster under
+# overload (bounded coordinator queue + CPU spillover armed) with ONE
+# HOST KILLED mid-stream: the proxies are the redeal wall (surviving-
+# host discovery + host_strided_redeal of the lost host's outstanding
+# requests), the spillover-engaged fraction (device-counted), the
+# zero-lost-acks accounting invariant, and per-request-area
+# bit-identity against the undisturbed single-engine run (the dyadic
+# quad_scaled workload makes that assertable as a boolean).
+MULTIHOST_FAMILY = "quad_scaled"
+MULTIHOST_EPS = 1e-9
+MULTIHOST_K = 8
+MULTIHOST_PROCESSES = 2
+MULTIHOST_QUEUE_LIMIT = 2
+MULTIHOST_SPILL_LIMIT = 2
+MULTIHOST_WKW = dict(slots=4, chunk=1 << 10, capacity=1 << 16,
+                     lanes=256, roots_per_lane=2, refill_slots=2,
+                     seg_iters=32, min_active_frac=0.05,
+                     f64_rounds=2)
+MULTIHOST_FAULTS = ({"kind": "host_loss", "at": 1, "chip": 1},)
+# gate bands: spillover share must stay ENGAGED (> 0) and within
+# +-0.25 absolute of the reference; the redeal must finish inside an
+# absolute wall budget (generous — it is a host-side request re-deal,
+# not a recompile)
+GATE_SPILL_ABS_TOL = 0.25
+GATE_REDEAL_WALL_BUDGET_S = 10.0
 
 # gate tolerances (the "stated tolerance" of the round-11 acceptance)
 GATE_STEP_TOL = 0.5      # kernel_steps / boundaries may grow <= 1.5x
@@ -459,6 +487,148 @@ def run_stream_slo_proxies() -> dict:
     }
 
 
+def run_multihost_proxies() -> dict:
+    """The ``bench.py multihost`` leg, standalone (one definition for
+    the bench record, the committed gate reference, and the CI
+    --gate-run measurement — the :func:`run_quick_proxies` ownership
+    contract).
+
+    Stands up a REAL 2-process local cluster (worker subprocesses
+    behind the coordinator, ``runtime/cluster.py``) over the dyadic
+    ``quad_scaled`` workload with a bounded coordinator queue and the
+    CPU spillover backend armed, SIGKILLs worker 1 at phase 1 through
+    the ``host_loss`` fault, and lets the supervisor's host-loss arm
+    discover + re-deal. Proxies: redeal wall, spillover-engaged
+    fraction (device-counted tasks included), the zero-lost-acks
+    accounting invariant, and bit-identity of every per-request area
+    against the undisturbed single-engine run."""
+    import numpy as np
+
+    from ppls_tpu.runtime import guard
+    from ppls_tpu.runtime.cluster import ClusterStreamEngine
+    from ppls_tpu.runtime.faults import FaultInjector, FaultPlan
+    from ppls_tpu.runtime.stream import StreamEngine
+
+    thetas = [1.0 + i / 4.0 for i in range(MULTIHOST_K)]
+    reqs = [(t, (0.0, 1.0)) for t in thetas]
+    base = StreamEngine(MULTIHOST_FAMILY, MULTIHOST_EPS,
+                       **MULTIHOST_WKW).run(reqs)
+    injector = FaultInjector(FaultPlan.from_events(
+        [dict(e) for e in MULTIHOST_FAULTS]))
+    eng = ClusterStreamEngine(
+        MULTIHOST_FAMILY, MULTIHOST_EPS,
+        n_processes=MULTIHOST_PROCESSES, worker_kw=MULTIHOST_WKW,
+        fault_injector=injector,
+        queue_limit=MULTIHOST_QUEUE_LIMIT, spillover=True,
+        spillover_limit=MULTIHOST_SPILL_LIMIT)
+
+    def loop():
+        k = eng.next_rid
+        while not eng.idle or k < len(reqs):
+            while k < len(reqs):
+                eng.submit(*reqs[k])
+                k += 1
+            eng.step()
+        return eng.result()
+
+    def resize_fn(exc):
+        eng.recover_host_loss(exc)
+        return loop
+
+    sup = guard.Supervisor(loop, resize_fn=resize_fn,
+                           log=lambda m: None,
+                           sleep=lambda s: None)
+    try:
+        t0 = time.perf_counter()
+        res = sup.run()
+        wall = time.perf_counter() - t0
+        spill = eng.spillover_summary()
+        manifest = eng.manifest.identity()
+        return {
+            "metric": "multi-host cluster proxies",
+            "family": MULTIHOST_FAMILY, "eps": MULTIHOST_EPS,
+            "k_requests": MULTIHOST_K,
+            "processes": MULTIHOST_PROCESSES,
+            "processes_surviving": manifest["processes"],
+            "queue_limit": MULTIHOST_QUEUE_LIMIT,
+            "faults_injected": [e.describe()
+                                for e in injector.plan.events
+                                if e.fired],
+            "recoveries": [{"kind": k_, "action": a}
+                           for k_, a in sup.recoveries],
+            "completed": len(res.completed),
+            "shed": len(res.shed),
+            "accounting_ok": (len(res.completed) + len(res.shed)
+                              == MULTIHOST_K),
+            "areas_bit_identical": bool(
+                np.array_equal(res.areas, base.areas)),
+            "redeal_wall_s": round(
+                eng.redeal_walls[0] if eng.redeal_walls else -1.0,
+                4),
+            "spillover_fraction": round(
+                spill["spillover_fraction"], 4),
+            "spillover_completed": spill["spillover_completed"],
+            "spillover_tasks": spill["spillover_tasks"],
+            "wall_s": round(wall, 3),
+        }
+    finally:
+        eng.close()
+
+
+def gate_multihost_record(cur: dict, ref: dict) -> List[str]:
+    """Round-18 multi-host gate: the zero-lost-acks accounting and
+    the per-request bit-identity invariants must hold, spillover must
+    stay ENGAGED (share > 0, within +-GATE_SPILL_ABS_TOL of the
+    reference), the host-loss recovery must have fired, and the
+    redeal must finish inside the absolute wall budget. A reference
+    WITHOUT a multihost block skips the gate (pre-round-18 refs)."""
+    rm = (ref or {}).get("multihost")
+    if not isinstance(rm, dict):
+        return []
+    cm = (cur or {}).get("multihost")
+    if not isinstance(cm, dict):
+        # an offline --gate FILE record without the block; the CI
+        # path uses --gate-run, which always re-measures
+        return []
+    fails: List[str] = []
+    if cm.get("accounting_ok") is False:
+        fails.append("REGRESSION multihost: completed + shed != "
+                     "offered requests (lost or duplicated work "
+                     "across the host loss)")
+    if cm.get("areas_bit_identical") is False:
+        fails.append("REGRESSION multihost: per-request areas "
+                     "diverged from the undisturbed run on the "
+                     "dyadic workload (the redeal/spillover "
+                     "determinism contract broke)")
+    if not any(r.get("kind") == "host_loss"
+               for r in cm.get("recoveries", [])):
+        fails.append("REGRESSION multihost: the injected host loss "
+                     "was not recovered through the host_loss arm")
+    sf, sf_ref = cm.get("spillover_fraction"), rm.get(
+        "spillover_fraction")
+    if not isinstance(sf, (int, float)) or sf <= 0.0:
+        fails.append("REGRESSION multihost: spillover did not "
+                     "engage (share <= 0) under overload + host "
+                     "loss")
+    elif isinstance(sf_ref, (int, float)) \
+            and abs(sf - sf_ref) > GATE_SPILL_ABS_TOL:
+        fails.append(
+            f"REGRESSION multihost: spillover_fraction {sf:.3f} "
+            f"drifted >{GATE_SPILL_ABS_TOL} from the reference's "
+            f"{sf_ref:.3f}; re-record with --update-ref if intended")
+    rw = cm.get("redeal_wall_s")
+    if not isinstance(rw, (int, float)) or rw < 0:
+        fails.append("multihost proxy missing redeal_wall_s (no "
+                     "redeal happened?)")
+    elif rw > GATE_REDEAL_WALL_BUDGET_S:
+        fails.append(
+            f"REGRESSION multihost: redeal wall {rw:.2f}s over the "
+            f"{GATE_REDEAL_WALL_BUDGET_S:.0f}s budget (the "
+            f"surviving-host redeal is host-side bookkeeping — "
+            f"seconds mean something regressed structurally)")
+    return fails
+
+
 def gate_stream_record(cur: dict, ref: dict) -> List[str]:
     """Round-16 multi-tenant SLO gate: the accounting invariant must
     hold, the shed fraction at offered load ~8 must stay within
@@ -660,6 +830,7 @@ def main(argv: List[str]) -> int:
             "t1_bookkeeping_per_theta", "t1_solo_samples",
             "solo_max_abs_err")}
         rec["stream"] = run_stream_slo_proxies()
+        rec["multihost"] = run_multihost_proxies()
         with open(ref_path, "w", encoding="utf-8") as fh:
             json.dump(rec, fh, indent=1, sort_keys=True)
             fh.write("\n")
@@ -667,6 +838,7 @@ def main(argv: List[str]) -> int:
         print(json.dumps(rec["walker"]))
         print(json.dumps(rec["theta"]))
         print(json.dumps(rec["stream"]))
+        print(json.dumps(rec["multihost"]))
         return 0
 
     if gate_path or do_gate_run:
@@ -692,10 +864,16 @@ def main(argv: List[str]) -> int:
                 # proxies — re-measure so the overload numbers are
                 # regression-guarded like lane efficiency
                 cur["stream"] = run_stream_slo_proxies()
+            if isinstance(ref.get("multihost"), dict):
+                # round 18: the ref carries the multi-host proxies —
+                # re-measure so the redeal/spillover/zero-lost-acks
+                # invariants stay regression-guarded
+                cur["multihost"] = run_multihost_proxies()
         fails = gate_record(cur, ref, tolerance=tolerance,
                             eff_tolerance=eff_tol) \
             + gate_theta_record(cur, ref) \
-            + gate_stream_record(cur, ref)
+            + gate_stream_record(cur, ref) \
+            + gate_multihost_record(cur, ref)
         for msg in fails:
             print(f"bench_history: GATE {msg}", file=sys.stderr)
         verdict = "TRIPPED" if fails else "passed"

@@ -47,9 +47,20 @@
 #       == 1, resize-resume recovery) and the stdout ledger + events
 #       timeline validate through tools/check_artifacts.py --serve /
 #       --events (rid-deduped accounting invariants).
+#   5d. MULTICHIP process-count sweep (round 18): the real local CPU
+#       cluster (worker subprocesses behind one coordinator) serves
+#       the identical dyadic workload at {1, 2, 4} processes under a
+#       wall budget; per-request areas must be BIT-IDENTICAL across
+#       the sweep and every ledger validates via check_artifacts
+#       --serve
 #   6. bench observatory: tools/bench_history.py --check over the
 #      committed round artifacts + the quick-proxy regression gate
-#      (device-counted proxies vs tools/bench_quick_ref.json)
+#      (device-counted proxies vs tools/bench_quick_ref.json; round
+#      18 adds the multihost block — redeal wall, spillover-engaged
+#      fraction, zero-lost-acks + bit-identity invariants)
+#   6c. bench.py multihost record schema check (kill-one-host under
+#       overload on the 2-process cluster; exit nonzero when
+#       spillover failed to engage or areas diverged)
 #   7. C hygiene smoke: csrc compiles under -Wall -Wextra -Werror
 #      (skipped with a visible notice when no compiler is present)
 #
@@ -333,6 +344,58 @@ else
     echo "ci: chaos under load OK"
 fi
 
+# --- 5d. MULTICHIP process-count sweep (round 18) ---
+# The local CPU cluster (real worker subprocesses behind the
+# coordinator, runtime/cluster.py) must serve the identical dyadic
+# workload at {1, 2, 4} processes with BIT-IDENTICAL per-request
+# areas — the multi-process determinism contract, gated end-to-end
+# through the serve CLI. Each run carries a wall budget (a wedged
+# worker handshake must fail CI, not hang it); ledgers validate
+# through check_artifacts --serve.
+step "multi-process serve sweep (--processes {1,2,4})"
+MP_DIR="$(mktemp -d)"
+mp_fail=0
+for P in 1 2 4; do
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu python -m ppls_tpu serve \
+            --processes "$P" --f64-rounds 2 --family quad_scaled \
+            --theta "1.0,1.25,1.5,2.0,0.75,3.0" \
+            --arrival-rate 2 --seed 0 --eps 1e-9 -a 0.0 -b 1.0 \
+            --slots 4 --chunk 1024 --capacity 65536 \
+            --lanes 256 --refill-slots 2 \
+            > "$MP_DIR/p$P.out" 2> "$MP_DIR/p$P.err"; then
+        python tools/check_artifacts.py --serve "$MP_DIR/p$P.out" \
+            || mp_fail=1
+    else
+        echo "ci: --processes $P serve FAILED"
+        mp_fail=1
+    fi
+done
+python - "$MP_DIR" <<'PYEOF' || mp_fail=1
+import glob
+import json
+import sys
+areas = {}
+for p in sorted(glob.glob(sys.argv[1] + "/p*.out")):
+    recs = [json.loads(ln) for ln in open(p) if ln.strip()]
+    s = recs[-1]
+    assert s.get("summary") and s["completed"] == 6, (p, s)
+    areas[p] = {r["rid"]: r["area"] for r in recs
+                if "rid" in r and not r.get("summary")}
+vals = list(areas.values())
+assert len(vals) == 3 and len(vals[0]) == 6
+assert all(v == vals[0] for v in vals[1:]), \
+    "process-count sweep areas diverged"
+print("ci: process sweep OK (6 areas bit-identical across "
+      "{1,2,4} processes)")
+PYEOF
+rm -rf "$MP_DIR"
+if [ "$mp_fail" -ne 0 ]; then
+    echo "ci: multi-process sweep FAILED"
+    FAILURES=$((FAILURES + 1))
+else
+    echo "ci: multi-process sweep OK"
+fi
+
 # --- 6. bench observatory: trajectory check + quick-proxy gate ---
 # tools/bench_history.py --check normalizes the committed
 # BENCH_r*/MULTICHIP_r* wrappers into one trajectory and fails on
@@ -360,6 +423,23 @@ if JAX_PLATFORMS=cpu python bench.py theta --quick \
     echo "ci: bench theta artifact OK"
 else
     echo "ci: bench theta artifact FAILED"
+    FAILURES=$((FAILURES + 1))
+fi
+
+# --- 6c. multi-host resilience leg: record must schema-validate ---
+# `bench.py multihost` (round 18) kills one host of a real 2-process
+# cluster under overload and records redeal wall + spillover-engaged
+# fraction + the zero-lost-acks/bit-identity invariants; the record
+# is gated through the artifact schema and the leg's own acceptance
+# booleans (exit nonzero when spillover failed to engage or areas
+# diverged). The proxy bands themselves are held by step 6's
+# --gate-run via the multihost block of bench_quick_ref.json.
+step "bench multihost artifact check"
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py multihost \
+        | python tools/check_artifacts.py -; then
+    echo "ci: bench multihost artifact OK"
+else
+    echo "ci: bench multihost artifact FAILED"
     FAILURES=$((FAILURES + 1))
 fi
 

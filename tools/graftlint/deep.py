@@ -97,6 +97,22 @@ GL07_CROUNDS_MODEL: Dict[str, Dict[str, object]] = {
             "rounds, deliberately outside the crounds claim. "
             "axis_index is deal-index math, no communication."),
     },
+    "cluster.worker_dd_stream": {
+        "collectives": {"psum": 10, "all_gather": 11,
+                        "axis_index": 2},
+        "reason": (
+            "round 18, the DISTRIBUTED dd program: the phase program "
+            "a cluster worker runs (build_dd_walker_run with the "
+            "admit window armed) over its LOCAL mesh. Same census as "
+            "sharded_walker.dd_refill plus ONE psum — the admission "
+            "path's replicated offered-load occupancy predicate "
+            "(phase_reshard folds admitted seeds into its decision). "
+            "This entry PINS that cluster collectives stay host-"
+            "local by construction: cross-process exchange is the "
+            "coordinator socket boundary, never a compiled "
+            "collective (the CPU backend has none, and a TPU pod "
+            "must opt in deliberately)."),
+    },
     "sharded_walker.dd_legacy": {
         "collectives": {"psum": 5, "all_gather": 5, "axis_index": 1},
         "reason": (
@@ -190,13 +206,14 @@ def default_probes():
     _ensure_jax_env()
     from ppls_tpu.parallel import (bag_engine, device_engine,
                                    sharded_walker, walker)
-    from ppls_tpu.runtime import stream
+    from ppls_tpu.runtime import cluster, stream
     paths = {
         bag_engine: "ppls_tpu/parallel/bag_engine.py",
         device_engine: "ppls_tpu/parallel/device_engine.py",
         walker: "ppls_tpu/parallel/walker.py",
         stream: "ppls_tpu/runtime/stream.py",
         sharded_walker: "ppls_tpu/parallel/sharded_walker.py",
+        cluster: "ppls_tpu/runtime/cluster.py",
     }
     out = []
     for mod, path in paths.items():
