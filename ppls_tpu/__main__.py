@@ -59,6 +59,17 @@ def tenant_quotas_arg(s: str) -> dict:
     return data
 
 
+def slo_config_arg(s: str) -> dict:
+    """``--slo-config`` argparse type: inline JSON or ``@file.json``
+    declaring per-tenant/per-class SLO targets + burn-rate windows
+    (``obs.slo.parse_slo_config`` is the one validator)."""
+    from ppls_tpu.obs.slo import parse_slo_config
+    try:
+        return parse_slo_config(s)
+    except (OSError, ValueError) as e:
+        raise argparse.ArgumentTypeError(f"bad SLO config: {e}")
+
+
 def tenants_arg(s: str) -> list:
     """``--tenants`` argparse type (synthetic load): either an integer
     N (tenants t0..tN-1, weight 1, priority i mod 3) or a
@@ -370,7 +381,37 @@ def build_parser() -> argparse.ArgumentParser:
                           "counters, compile-cache size, rolling "
                           "p50/p99 retire latency) on 127.0.0.1:PORT "
                           "for the lifetime of the run (0 = ephemeral "
-                          "port, printed to stderr)")
+                          "port, printed to stderr). With --processes "
+                          "(round 19) this is the FEDERATED cluster "
+                          "surface: every worker's registry merged "
+                          "under a process label plus the "
+                          "coordinator's own (process=coordinator), "
+                          "cluster totals reconciling exactly. GET "
+                          "/health returns the SLO burn verdict when "
+                          "--slo-config is armed")
+    srv.add_argument("--events-max-mb", type=float, default=None,
+                     dest="events_max_mb", metavar="MB",
+                     help="round 19: size-cap the --events file — "
+                          "past the cap the timeline rolls to "
+                          "FILE.1, FILE.2, ... at a span-safe "
+                          "boundary and continues in a fresh segment "
+                          "at FILE (every rolled file is a valid "
+                          "multi-meta-segment timeline; "
+                          "tools/analyze_request.py reads the whole "
+                          "chain automatically)")
+    srv.add_argument("--slo-config", type=slo_config_arg,
+                     default=None, dest="slo_config",
+                     metavar="JSON|@FILE",
+                     help="round 19: arm SLO burn-rate alerting — "
+                          "per-tenant/per-class targets "
+                          '({"slos": [{"slo": "p99_latency_phases", '
+                          '"target": 12, "objective": 0.99, '
+                          '"class": "2"}, ...]}) evaluated at every '
+                          "phase boundary over the registry the "
+                          "boundary already publishes (fast/slow "
+                          "phase windows; slo_burn events + "
+                          "ppls_slo_burn_total + the /health verdict "
+                          "on --metrics-port)")
     srv.add_argument("--watchdog", type=float, default=None,
                      metavar="SECONDS",
                      help="hang watchdog around the serve loop "
@@ -761,11 +802,6 @@ def _main_serve(args) -> int:
                 "(the cluster coordinator does not implement "
                 "per-tenant token buckets); drop the flag or run "
                 "single-process")
-        if args.metrics_port is not None:
-            raise SystemExit(
-                "--metrics-port is not supported with --processes "
-                "(the coordinator does not serve the registry yet); "
-                "read the summary line / --events timeline instead")
         return _main_serve_cluster(args, reqs, arrivals)
 
     kw = dict(rule=Rule(args.rule), slots=args.slots, chunk=args.chunk,
@@ -782,7 +818,8 @@ def _main_serve(args) -> int:
               default_deadline_phases=args.deadline_phases,
               spillover=bool(getattr(args, "spillover", False)),
               spillover_limit=int(getattr(args, "spillover_limit",
-                                          4)))
+                                          4)),
+              slo_config=getattr(args, "slo_config", None))
     if args.lanes:
         kw["lanes"] = args.lanes
 
@@ -862,7 +899,10 @@ def _main_serve(args) -> int:
                   "rule": args.rule, "slots": args.slots,
                   "lanes": args.lanes or 0, "seed": args.seed,
                   "requests": len(reqs), "resumed": resuming},
-            append=resuming)
+            append=resuming,
+            events_max_bytes=(
+                int(args.events_max_mb * (1 << 20))
+                if getattr(args, "events_max_mb", None) else None))
         holder["tel"] = tel
         ekw = dict(kw, n_devices=state["n_devices"],
                    quarantine=quarantine, fault_injector=injector,
@@ -889,22 +929,6 @@ def _main_serve(args) -> int:
         return StreamEngine(args.family, args.eps,
                             checkpoint_path=args.checkpoint, **ekw)
 
-    metrics_srv = None
-    if args.metrics_port is not None:
-        from ppls_tpu.obs import MetricsRegistry, MetricsServer
-        _empty = MetricsRegistry()
-        metrics_srv = MetricsServer(
-            lambda: (holder["tel"].registry if "tel" in holder
-                     else _empty),
-            port=args.metrics_port)
-        # --metrics-port 0 binds an ephemeral port (the only usable
-        # configuration on shared CI hosts): the BOUND port is
-        # announced here (stderr, before the first phase runs) and
-        # again on the summary line, so scrapers and test harnesses
-        # can discover it without racing the run
-        print(f"serve: metrics on {metrics_srv.url}", file=sys.stderr,
-              flush=True)
-
     # round 16: cooperative SIGTERM/SIGINT — the loop checks the flag
     # at phase boundaries and winds down with a final checkpoint +
     # balanced span close + summary (the zero-downtime-restart half).
@@ -917,7 +941,45 @@ def _main_serve(args) -> int:
     from ppls_tpu.runtime.guard import GracefulShutdown
     from ppls_tpu.runtime.ingest import EngineHandle
     stop = GracefulShutdown()
-    handle = EngineHandle()
+    # ONE handle PER ATTEMPT, resolved through the holder (round 19
+    # fix): an injected/real hang wedges its attempt thread INSIDE
+    # the engine lock, so a retry sharing that handle deadlocked on
+    # its first `with handle.lock():` and every supervised recovery
+    # of a hang burned the whole retry budget. A fresh handle per
+    # attempt lets the retry proceed; ingest threads resolve
+    # holder["handle"] at call time, so an ack either lands in the
+    # CURRENT attempt's engine, is refused (cleared handle), or
+    # blocks on the wedged attempt's own lock (client retries) —
+    # never silently lost.
+    holder["handle"] = EngineHandle()
+
+    metrics_srv = None
+    if args.metrics_port is not None:
+        from ppls_tpu.obs import MetricsRegistry, MetricsServer
+        _empty = MetricsRegistry()
+
+        def _health():
+            # the /health verdict reads the LIVE attempt's SLO
+            # evaluator (green default without --slo-config); a
+            # supervisor backoff window (no live engine) reports
+            # not-ok so a load balancer drains during recovery
+            eng = holder["handle"].peek()
+            if eng is None:
+                return {"ok": False, "burning": [],
+                        "ready": False}
+            return eng.slo_health()
+
+        metrics_srv = MetricsServer(
+            lambda: (holder["tel"].registry if "tel" in holder
+                     else _empty),
+            port=args.metrics_port, health_fn=_health)
+        # --metrics-port 0 binds an ephemeral port (the only usable
+        # configuration on shared CI hosts): the BOUND port is
+        # announced here (stderr, before the first phase runs) and
+        # again on the summary line, so scrapers and test harnesses
+        # can discover it without racing the run
+        print(f"serve: metrics on {metrics_srv.url}", file=sys.stderr,
+              flush=True)
 
     ingest_srv = None
     if args.ingest_port is not None:
@@ -926,8 +988,9 @@ def _main_serve(args) -> int:
         def ingest_submit(d):
             rec = parse_request_record(d, theta_block=T)
             rec.pop("arrival_phase", None)     # live ingest is "now"
-            with handle.lock():
-                eng = handle.peek()
+            h = holder["handle"]          # the CURRENT attempt's
+            with h.lock():
+                eng = h.peek()
                 if eng is None or stop.requested:
                     raise ValueError("service not accepting requests")
                 n0 = len(eng.shed)
@@ -940,7 +1003,7 @@ def _main_serve(args) -> int:
                 return {"rid": rid, "accepted": True}
 
         def ingest_stats():
-            eng = handle.peek()
+            eng = holder["handle"].peek()
             if eng is None:
                 return {"ready": False}
             return {"ready": True, "phase": eng.phase,
@@ -956,6 +1019,11 @@ def _main_serve(args) -> int:
 
     def serve_loop():
         t0 = time.perf_counter()
+        # fresh lock-cell per attempt (see the holder note above): a
+        # wedged previous attempt keeps ITS lock; this attempt and
+        # the ingest threads move to the new one
+        handle = EngineHandle()
+        holder["handle"] = handle
         eng = make_engine()
         handle.publish(eng)
         span = eng.telemetry.span("run", mode="serve",
@@ -1192,7 +1260,10 @@ def _main_serve_cluster(args, reqs, arrivals) -> int:
               "rule": args.rule, "slots": args.slots,
               "processes": int(args.processes), "seed": args.seed,
               "requests": len(reqs), "resumed": resuming},
-        append=resuming)
+        append=resuming,
+        events_max_bytes=(
+            int(args.events_max_mb * (1 << 20))
+            if getattr(args, "events_max_mb", None) else None))
     injector = (FaultInjector(plan, telemetry=tel)
                 if plan is not None else None)
 
@@ -1216,7 +1287,8 @@ def _main_serve_cluster(args, reqs, arrivals) -> int:
                telemetry=tel, fault_injector=injector,
                queue_limit=args.queue_limit,
                spillover=bool(args.spillover),
-               spillover_limit=int(args.spillover_limit))
+               spillover_limit=int(args.spillover_limit),
+               slo_config=getattr(args, "slo_config", None))
 
     def build_engine():
         if args.checkpoint and os.path.exists(args.checkpoint):
@@ -1252,6 +1324,21 @@ def _main_serve_cluster(args, reqs, arrivals) -> int:
     # summary/teardown below must follow the swap
     eng_box = {"eng": build_engine()}
     printed = {"done": 0, "shed": 0}
+
+    # round 19: the refusal is LIFTED — --metrics-port on the cluster
+    # path serves the FEDERATED registry (worker registries merged
+    # under process labels + the coordinator's own under
+    # process="coordinator") and the /health SLO verdict; the handle
+    # indirects through eng_box so a supervisor rebuild re-points it
+    metrics_srv = None
+    if args.metrics_port is not None:
+        from ppls_tpu.obs import MetricsServer
+        metrics_srv = MetricsServer(
+            lambda: eng_box["eng"].federated_registry,
+            port=args.metrics_port,
+            health_fn=lambda: eng_box["eng"].slo_health())
+        print(f"serve: metrics on {metrics_srv.url}", file=sys.stderr,
+              flush=True)
 
     def flush_ledger():
         # the print cursor trails the ledger instead of the step()
@@ -1398,10 +1485,25 @@ def _main_serve_cluster(args, reqs, arrivals) -> int:
             summary["faults_injected"] = [
                 ev.describe() for ev in injector.plan.events
                 if ev.fired]
-        print(json.dumps(summary))
+        if metrics_srv is not None:
+            summary["metrics_port"] = metrics_srv.port
+            summary["metrics_url"] = metrics_srv.url
+        print(json.dumps(summary), flush=True)
         return 0
     finally:
         stop.__exit__()
+        if metrics_srv is not None:
+            # PPLS_SERVE_METRICS_HOLD: keep the federated surface up
+            # for N seconds AFTER the summary line so an external
+            # scraper (the CI reconciliation step) can take a final
+            # post-drain sample race-free
+            import os as _os
+            import time as _time
+            hold = float(_os.environ.get("PPLS_SERVE_METRICS_HOLD",
+                                         "0") or 0)
+            if hold > 0:
+                _time.sleep(hold)
+            metrics_srv.close()
         eng_box["eng"].close()
         tel.close()
 

@@ -23,6 +23,11 @@ live only in host boundary hooks — never inside jitted cycle bodies.
 graftlint GL06 enforces it statically.
 """
 
+from ppls_tpu.obs.federation import (  # noqa: F401
+    COORDINATOR,
+    PROCESS_LABEL,
+    FederatedMetrics,
+)
 from ppls_tpu.obs.flight import ChipFlightRecorder  # noqa: F401
 from ppls_tpu.obs.registry import (  # noqa: F401
     Counter,
@@ -34,6 +39,10 @@ from ppls_tpu.obs.registry import (  # noqa: F401
     exp_buckets,
 )
 from ppls_tpu.obs.server import MetricsServer  # noqa: F401
+from ppls_tpu.obs.slo import (  # noqa: F401
+    SloEvaluator,
+    parse_slo_config,
+)
 from ppls_tpu.obs.spans import SpanTracer  # noqa: F401
 from ppls_tpu.obs.telemetry import (  # noqa: F401
     Telemetry,
@@ -55,4 +64,6 @@ __all__ = [
     "MetricsServer", "SpanTracer", "Telemetry", "default_telemetry",
     "set_default", "RoundStats", "RunMetrics", "round_stats_from_rows",
     "annotate", "trace",
+    "COORDINATOR", "PROCESS_LABEL", "FederatedMetrics",
+    "SloEvaluator", "parse_slo_config",
 ]

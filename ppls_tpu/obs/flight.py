@@ -97,10 +97,16 @@ class ChipFlightRecorder:
             "(1.0 = perfectly balanced)", lab).labels(engine=engine)
 
     def record_phase(self, phase: int, *, wsteps, tasks, live_rows,
-                     bank_delta, waste=None, crounds: int = 0) -> None:
+                     bank_delta, waste=None, crounds: int = 0,
+                     rids=None) -> None:
         """One phase's per-chip attribution. All arguments are host
         sequences of per-chip values (deltas for wsteps/tasks/waste;
-        absolutes for live_rows) the boundary fetch already produced."""
+        absolutes for live_rows) the boundary fetch already produced.
+
+        ``rids`` (round 19, cluster path): one list of GLOBAL request
+        ids per unit — the trace-context return leg, stamping each
+        process span with the rids that were live on it this phase so
+        worker-side spans carry the coordinator's rid linkage."""
         tel = self.tel
         n = self.n_dev
         wsteps = [int(v) for v in wsteps]
@@ -111,6 +117,8 @@ class ChipFlightRecorder:
                          tasks=int(tasks[chip]),
                          live_rows=int(live_rows[chip]),
                          bank_delta=int(bank_delta[chip]))
+            if rids is not None and chip < len(rids):
+                attrs["rids"] = [int(r) for r in rids[chip]]
             if waste is not None:
                 for k, v in zip(WASTE_BUCKETS, waste[chip]):
                     attrs[k] = int(v)

@@ -189,6 +189,25 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def merge_counts(self, counts: Sequence[int], sum_: float,
+                     count: int, max_: float) -> None:
+        """Fold another histogram's (delta) bucket counts into this
+        one — the federation merge path (round 19): the coordinator
+        adds each worker's shipped per-bucket deltas so the merged
+        histogram's quantiles are computed over the cluster-wide
+        sample set. ``counts`` must match this histogram's bucket
+        table (the shared PHASE/SECONDS tables guarantee it)."""
+        if len(counts) != len(self.counts):
+            raise ValueError(
+                f"histogram merge: {len(counts)} buckets vs "
+                f"{len(self.counts)}")
+        for i, c in enumerate(counts):
+            self.counts[i] += int(c)
+        self._sum += float(sum_)
+        self._count += int(count)
+        if count:
+            self._max = max(self._max, float(max_))
+
     def quantile(self, q: float) -> Optional[float]:
         """Deterministic bucket-edge quantile (see module docstring).
         Returns None on an empty histogram."""
@@ -325,6 +344,34 @@ class MetricsRegistry:
         except ValueError:
             return default
         return child.value
+
+    def dump(self) -> dict:
+        """JSON-serializable snapshot of every family and child — the
+        federation wire format (round 19): workers ship this in their
+        step/snapshot replies and the coordinator merges the deltas
+        into one registry with a ``process`` label
+        (``obs.federation``). Deterministically ordered; values are
+        CUMULATIVE (the receiver owns delta computation, so a
+        retransmit or a skipped phase cannot double-count)."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            families = sorted(self._families.items())
+        for name, fam in families:
+            children = []
+            for key, child in fam.items():
+                if fam.kind == "histogram":
+                    children.append({
+                        "labels": list(key),
+                        "counts": list(child.counts),
+                        "sum": child.sum, "count": child.count,
+                        "max": (child._max if child.count else 0.0)})
+                else:
+                    children.append({"labels": list(key),
+                                     "value": child.value})
+            out[name] = {"kind": fam.kind, "help": fam.help,
+                         "labelnames": list(fam.labelnames),
+                         "children": children}
+        return out
 
     def exposition(self) -> str:
         """Prometheus text format 0.0.4."""

@@ -2,6 +2,12 @@
 server rendering the registry as Prometheus text (format 0.0.4) on
 ``GET /metrics`` (any path works — curl-from-memory friendly).
 
+Round 19: ``GET /health`` returns the SLO burn-rate verdict as JSON
+(``{"ok": bool, "burning": [...], "phase": p}``; HTTP 200 when ok,
+503 while any SLO is burning) when the caller supplies a ``health_fn``
+— the load-balancer yes/no face of ``obs.slo.SloEvaluator``. Without
+a health_fn the path serves metrics like every other.
+
 Runs in a daemon thread so the serve loop never blocks on a scraper;
 ``port=0`` binds an ephemeral port (tests read ``server.port``). The
 registry snapshot is rendered per request — scrape cost is linear in
@@ -10,6 +16,7 @@ metric count, zero cost when nobody scrapes.
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -18,14 +25,28 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 class MetricsServer:
     def __init__(self, registry, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", health_fn=None):
         """``registry``: a :class:`MetricsRegistry`, or a zero-arg
         callable returning one (the serve CLI re-points the handle
-        when a watchdog retry rebuilds its engine)."""
+        when a watchdog retry rebuilds its engine). ``health_fn``: a
+        zero-arg callable returning the /health verdict dict (an
+        ``"ok"`` bool plus whatever detail the evaluator carries)."""
         get_reg = registry if callable(registry) else (lambda: registry)
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):           # noqa: N802 — stdlib API name
+                if health_fn is not None \
+                        and self.path.split("?")[0] == "/health":
+                    verdict = health_fn()
+                    body = (json.dumps(verdict) + "\n").encode("utf-8")
+                    self.send_response(
+                        200 if verdict.get("ok", True) else 503)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 reg = get_reg()
                 body = reg.exposition().encode("utf-8")
                 self.send_response(200)

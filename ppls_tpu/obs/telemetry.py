@@ -68,10 +68,12 @@ class Telemetry:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  events_path: Optional[str] = None,
-                 meta: Optional[dict] = None, append: bool = False):
+                 meta: Optional[dict] = None, append: bool = False,
+                 events_max_bytes: Optional[int] = None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
-        self.tracer = SpanTracer(events_path, meta=meta, append=append)
+        self.tracer = SpanTracer(events_path, meta=meta, append=append,
+                                 max_bytes=events_max_bytes)
         # compile observability (round 11): last-seen pjit cache entry
         # count per engine, so growth — a recompile under the
         # compile-once invariant — surfaces as an event + counter
@@ -89,6 +91,27 @@ class Telemetry:
 
     def close(self) -> None:
         self.tracer.close()
+
+    # -- request-scoped tracing (round 19) ----------------------------------
+
+    def request_span(self, rid: int, **attrs):
+        """Open the DETACHED per-request span: the root of one rid's
+        causal trace, opened at ingest ack, closed at the terminal
+        disposition (retire/shed). Stays open across phase spans; its
+        child events link by ``request_event``. No-op without an
+        events file, like every tracer call."""
+        return self.tracer.span_detached("request", rid=int(rid),
+                                         **attrs)
+
+    def request_event(self, span, name: str, **attrs) -> None:
+        """Emit one child event of a request span (``span`` is the
+        handle ``request_span`` returned; a disabled/closed handle
+        degrades to an unlinked event so emit sites stay
+        unconditional). Routes through :meth:`event` so spies and
+        proxies that wrap it observe the request-trace emits too
+        (``span_id`` passes through to the tracer)."""
+        sid = span.sid if span is not None else None
+        self.event(name, span_id=sid, **attrs)
 
     # -- boundary-hook publishers -------------------------------------------
     # (host-only; each consumes values its caller already holds)

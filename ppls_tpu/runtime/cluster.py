@@ -320,6 +320,9 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
         "devices": int(jax.local_device_count()),
         "platform": str(jax.default_backend()),
         "resumed": bool(resumed),
+        # round 19 federation: the boot-time cumulative dump (a
+        # resumed worker's replayed registry; zeros on a fresh start)
+        "metrics": tel.registry.dump(),
     }
     if corrupt:
         hello["corrupt"] = corrupt
@@ -349,12 +352,14 @@ def _worker_dispatch(eng, cmd: dict) -> dict:
     if kind == "ping":
         return {"ok": True, "phase": int(eng.phase)}
     if kind == "state":
-        return dict(_worker_state(eng), ok=True)
+        return dict(_worker_state(eng), ok=True,
+                    metrics=eng.telemetry.registry.dump())
     if kind == "exit":
         return {"ok": True}
     if kind == "snapshot":
         eng.snapshot()
-        return {"ok": True}
+        return {"ok": True,
+                "metrics": eng.telemetry.registry.dump()}
     if kind == "submit":
         gmap = eng.client_state["gmap"]
         for r in cmd["reqs"]:
@@ -384,6 +389,18 @@ def _worker_dispatch(eng, cmd: dict) -> dict:
                      for s in eng.shed[s0:] if s.rid in gmap],
             "pending": int(eng.pending),
             "resident": int(eng.resident),
+            # round 19 trace context, the return leg: the global rids
+            # still resident on this worker after the phase — the
+            # coordinator stamps its process spans and per-rid
+            # request_phase events with them (retired rids ride the
+            # 'retired' list above)
+            "resident_grids": sorted(
+                gmap[r.rid] for r in eng._slot_req.values()
+                if r.rid in gmap),
+            # round 19 federation: the worker's CUMULATIVE registry
+            # dump — the coordinator owns delta computation, so a
+            # dropped or replayed reply cannot double-count
+            "metrics": eng.telemetry.registry.dump(),
             "live": int(row["live_tasks"]) if row else 0,
             "tasks": int(row["tasks"]) if row else 0,
             "wtasks": int(row["wtasks"]) if row else 0,
@@ -605,6 +622,9 @@ class _LedgerEntry:
     submit_t: float
     assigned: Optional[int] = None        # process_id, None = undealt
     state: str = "pending"      # pending | dealt | spill | done | shed
+    # round 19: the coordinator phase the request was first dealt at —
+    # the admit edge of its causal trace (queue wait = dealt - submit)
+    dealt_phase: Optional[int] = None
 
     def payload(self) -> dict:
         return {"grid": self.grid,
@@ -659,6 +679,7 @@ class ClusterStreamEngine:
                  jax_distributed: bool = False,
                  spawn_timeout: float = 180.0,
                  rpc_timeout: float = 600.0,
+                 slo_config=None,
                  _defer_spawn: bool = False):
         from ppls_tpu.models.integrands import get_family_ds
         from ppls_tpu.obs import Telemetry
@@ -713,6 +734,52 @@ class ClusterStreamEngine:
         self._rr = 0
         self._phases_after_recovery = 0
         self._closed = False
+
+        # round 19: COORDINATOR-SIDE SLO accounting — the same metric
+        # names the single-process engine publishes, observed at the
+        # coordinator's causal clock (submit -> retire in coordinator
+        # phases), so the SLO evaluator, the serve summary, and the
+        # federated /metrics read one surface on both paths. With the
+        # process label these are the "coordinator-merged counters"
+        # of the reconciliation invariant: coordinator retired ==
+        # sum over workers + spillover completions.
+        tel = self.telemetry
+        self._c_retired = tel.registry.counter(
+            "ppls_stream_retired_total", "requests retired with areas")
+        self._c_tenant_retired = tel.registry.counter(
+            "ppls_stream_tenant_retired_total",
+            "requests retired, by tenant", ("tenant",))
+        self._c_shed = tel.shed_counter()
+        self._c_deadline = tel.registry.counter(
+            "ppls_stream_deadline_exceeded_total",
+            "in-flight requests retired failed at their phase "
+            "deadline", ("tenant",))
+        self._c_quarantined = tel.registry.counter(
+            "ppls_stream_quarantined_total",
+            "requests retired as failed through the NaN quarantine")
+        self._c_spillover = tel.registry.counter(
+            "ppls_stream_spillover_total",
+            "requests completed on the CPU spillover backend "
+            "instead of being shed")
+        self._h_lat_phases = tel.latency_phases_histogram()
+        self._h_lat_seconds = tel.latency_seconds_histogram()
+        self._h_class_lat = tel.class_latency_histogram()
+        self._h_tenant_lat = tel.tenant_latency_histogram()
+        # round 19: FEDERATED METRICS — worker registry dumps merge
+        # into one process-labeled registry (obs.federation); the
+        # coordinator's own registry joins under process="coordinator"
+        # so the exposed surface has one uniform label space
+        from ppls_tpu.obs.federation import FederatedMetrics
+        self._federation = FederatedMetrics()
+        # round 19: SLO burn-rate evaluator over the coordinator
+        # registry (boundary hook, zero extra device/RPC work)
+        self._slo = None
+        if slo_config is not None:
+            from ppls_tpu.obs.slo import SloEvaluator
+            self._slo = SloEvaluator(slo_config, tel)
+        # round 19: per-rid request spans (the coordinator owns the
+        # trace; workers ship rid linkage back in their replies)
+        self._rid_spans: Dict[int, object] = {}
 
         if fault_injector is not None:
             fault_injector.host_kill_fn = self.kill_process
@@ -829,6 +896,11 @@ class ClusterStreamEngine:
             deadline_phases=deadline_phases,
             submit_phase=self.phase, submit_t=time.perf_counter())
         self._ledger[grid] = ent
+        # round 19: the rid's causal trace opens at the ack (the
+        # coordinator owns the trace; worker hops link back by grid)
+        self._rid_spans[grid] = self.telemetry.request_span(
+            grid, tenant=ent.tenant, priority=ent.priority,
+            submit_phase=ent.submit_phase)
         if self.queue_limit is not None \
                 and len(self._pending) >= self.queue_limit:
             victim_grid = min(
@@ -855,9 +927,10 @@ class ClusterStreamEngine:
         if spillable and len(self._spill_queue) < self._spill_cap:
             ent.state = "spill"
             self._spill_queue.append(ent.grid)
-            self.telemetry.event(
-                "spillover_enqueued", rid=ent.grid,
-                tenant=ent.tenant, phase=self.phase)
+            self.telemetry.request_event(
+                self._rid_spans.get(ent.grid), "spillover_enqueued",
+                rid=ent.grid, tenant=ent.tenant, phase=self.phase,
+                submit_phase=ent.submit_phase)
             return
         from ppls_tpu.runtime.stream import ShedRecord
         ent.state = "shed"
@@ -868,10 +941,15 @@ class ClusterStreamEngine:
             reason=reason, phase=self.phase,
             submit_phase=ent.submit_phase)
         self.shed.append(rec)
-        self.telemetry.event(
-            "request_shed", rid=ent.grid, tenant=ent.tenant,
+        self._c_shed.labels(tenant=ent.tenant, reason=reason).inc()
+        span = self._rid_spans.pop(ent.grid, None)
+        self.telemetry.request_event(
+            span, "request_shed", rid=ent.grid, tenant=ent.tenant,
             priority=ent.priority, reason=reason,
             phase=self.phase, submit_phase=ent.submit_phase)
+        if span is not None:
+            span.close(disposition="shed", reason=reason,
+                       phase=self.phase)
 
     def _adopt_worker_shed(self, ent: "_LedgerEntry", rec: dict,
                            process_id: int) -> None:
@@ -881,17 +959,22 @@ class ClusterStreamEngine:
         never go idle."""
         from ppls_tpu.runtime.stream import ShedRecord
         ent.state = "shed"
+        reason = rec.get("reason", "worker_shed")
         self.shed.append(ShedRecord(
             rid=ent.grid, theta=ent.theta, bounds=ent.bounds,
             tenant=ent.tenant, priority=ent.priority,
-            reason=rec.get("reason", "worker_shed"),
+            reason=reason,
             phase=self.phase, submit_phase=ent.submit_phase))
-        self.telemetry.event(
-            "request_shed", rid=ent.grid, tenant=ent.tenant,
-            priority=ent.priority,
-            reason=rec.get("reason", "worker_shed"),
+        self._c_shed.labels(tenant=ent.tenant, reason=reason).inc()
+        span = self._rid_spans.pop(ent.grid, None)
+        self.telemetry.request_event(
+            span, "request_shed", rid=ent.grid, tenant=ent.tenant,
+            priority=ent.priority, reason=reason,
             process=process_id, phase=self.phase,
             submit_phase=ent.submit_phase)
+        if span is not None:
+            span.close(disposition="shed", reason=reason,
+                       phase=self.phase)
 
     @property
     def next_rid(self) -> int:
@@ -930,9 +1013,24 @@ class ClusterStreamEngine:
                 ent = self._ledger[g]
                 ent.assigned = w.process_id
                 ent.state = "dealt"
+                if ent.dealt_phase is None:
+                    ent.dealt_phase = self.phase
                 reqs.append(ent.payload())
+                # round 19: the deal is the admit edge of the rid's
+                # trace — queue wait decomposes here, and the hop
+                # names the worker process the request landed on
+                self.telemetry.request_event(
+                    self._rid_spans.get(g), "request_dealt",
+                    rid=g, process=w.process_id, phase=self.phase,
+                    submit_phase=ent.submit_phase,
+                    queue_wait_phases=self.phase - ent.submit_phase)
             try:
-                w.call({"cmd": "submit", "reqs": reqs})
+                # round 19 trace context, the outbound leg: rid is in
+                # each payload's grid; the segment id names which
+                # events segment the coordinator's spans live in
+                w.call({"cmd": "submit", "reqs": reqs,
+                        "trace": {
+                            "segment": self.telemetry.tracer.segment}})
             except WorkerLost:
                 # batches not yet SENT roll back to pending (the next
                 # deal re-assigns them over whatever survives); this
@@ -951,13 +1049,17 @@ class ClusterStreamEngine:
                   spillover: bool = False) -> object:
         from ppls_tpu.runtime.stream import CompletedRequest
         now = time.perf_counter()
+        # the admit edge of the trace: the deal phase (or the
+        # spillover/retire phase for requests that never dealt)
+        admit_phase = (ent.dealt_phase if ent.dealt_phase is not None
+                       else self.phase)
         c = CompletedRequest(
             rid=ent.grid, theta=ent.theta, bounds=ent.bounds,
             area=(float("nan") if rec.get("failed")
                   else float(rec["area"])),
             areas=rec.get("areas"),
             submit_phase=ent.submit_phase,
-            admit_phase=ent.submit_phase,
+            admit_phase=admit_phase,
             retire_phase=self.phase,
             latency_s=now - ent.submit_t,
             first_seeded_phase=-1, last_credited_phase=-1,
@@ -967,14 +1069,30 @@ class ClusterStreamEngine:
             spillover=spillover)
         ent.state = "done"
         self.completed.append(c)
-        self.telemetry.event(
-            "retire", rid=c.rid,
+        # round 19 coordinator-side SLO accounting (the same names
+        # the single-process engine publishes; see __init__) — one
+        # helper shared with the resume replay so the two can never
+        # drift
+        self._publish_retirement(c)
+        span = self._rid_spans.pop(c.rid, None)
+        self.telemetry.request_event(
+            span, "retire", rid=c.rid,
             process=(-1 if spillover else ent.assigned),
             area=(None if c.failed else c.area),
             failed=c.failed,
             **({"failure": c.failure} if c.failure else {}),
-            spillover=spillover, retire_phase=self.phase,
+            spillover=spillover,
+            submit_phase=c.submit_phase,
+            admit_phase=c.admit_phase,
+            retire_phase=self.phase,
+            latency_phases=c.latency_phases,
             tenant=c.tenant, priority=c.priority)
+        if span is not None:
+            span.close(
+                disposition=("failed" if c.failed else "retired"),
+                **({"failure": c.failure} if c.failure else {}),
+                retire_phase=c.retire_phase,
+                latency_phases=c.latency_phases)
         return c
 
     def _run_spillover(self, retired: list) -> None:
@@ -991,9 +1109,9 @@ class ClusterStreamEngine:
                 # never an engine-wide abort stranding healthy work
                 if not self.quarantine:
                     raise
-                self.telemetry.event("quarantine", rid=ent.grid,
-                                     phase=self.phase,
-                                     spillover=True)
+                self.telemetry.request_event(
+                    self._rid_spans.get(ent.grid), "quarantine",
+                    rid=ent.grid, phase=self.phase, spillover=True)
                 rec = {"area": None, "failed": True,
                        "failure": "nan", "areas": None}
             else:
@@ -1032,6 +1150,8 @@ class ClusterStreamEngine:
                     stepped.append(w)
                 except WorkerLost as e:
                     lost = lost or e
+            rid_rows: List[list] = []
+            fed_dumps: Dict[str, dict] = {}
             for w in stepped:
                 try:
                     rep = w.recv_reply()
@@ -1042,6 +1162,24 @@ class ClusterStreamEngine:
                 wsteps.append(int(rep.get("wsteps", 0)))
                 rows.append(int(rep.get("live", 0)))
                 self._wtasks_total += int(rep.get("wtasks", 0))
+                if rep.get("metrics") is not None:
+                    # round 19 federation: the worker's cumulative
+                    # registry dump rode the step reply
+                    fed_dumps[str(w.process_id)] = rep["metrics"]
+                # round 19 trace linkage, the return leg: every rid
+                # live on this worker this phase (still-resident +
+                # retired-this-phase) gets a request_phase hop naming
+                # the process and this phase span — emitted BEFORE
+                # retirement adoption closes the rid spans
+                phase_rids = sorted(
+                    set(int(g) for g in rep.get("resident_grids", ()))
+                    | {int(r["grid"]) for r in rep.get("retired", ())})
+                rid_rows.append(phase_rids)
+                for g in phase_rids:
+                    tel.request_event(
+                        self._rid_spans.get(g), "request_phase",
+                        rid=g, process=w.process_id, phase=self.phase,
+                        phase_span=span.sid)
                 for rec in rep.get("retired", ()):
                     ent = self._ledger.get(int(rec["grid"]))
                     if ent is None or ent.state == "done":
@@ -1054,11 +1192,14 @@ class ClusterStreamEngine:
                     self._adopt_worker_shed(ent, rec, w.process_id)
             if lost is not None:
                 raise lost
+            for pid, dump in sorted(fed_dumps.items()):
+                self._federation.ingest_dump(pid, dump)
             if live:
                 self._flight.record_phase(
                     self.phase, wsteps=wsteps, tasks=tasks,
                     live_rows=rows,
-                    bank_delta=[0] * len(live))
+                    bank_delta=[0] * len(live),
+                    rids=rid_rows)
                 self._tasks_total += sum(tasks)
                 self._wsteps_total += sum(wsteps)
             # the cross-process occupancy sum: the host-side face of
@@ -1073,6 +1214,16 @@ class ClusterStreamEngine:
                 detail=str(e)) from e
         self.phase += 1
         self._phases_after_recovery += 1
+        if self._slo is not None:
+            # round 19: burn-rate evaluation over the coordinator
+            # registry this boundary just published into
+            self._slo.evaluate_slo(self.phase)
+        # the coordinator's own registry joins the federated surface
+        # under process="coordinator" — AFTER this phase's retire/SLO
+        # publishes so the exposed cut is phase-consistent
+        from ppls_tpu.obs.federation import COORDINATOR
+        self._federation.ingest_dump(
+            COORDINATOR, self.telemetry.registry.dump())
         span.close(retired=len(retired), occupancy=int(occupancy),
                    processes=len(self._live()))
         if self.checkpoint_path and \
@@ -1155,6 +1306,27 @@ class ClusterStreamEngine:
             "spillover_tasks": int(tasks),
         }
 
+    @property
+    def federated_registry(self):
+        """The ONE cluster metrics surface (round 19): every worker's
+        registry merged under its ``process`` label plus the
+        coordinator's own under ``process="coordinator"`` — what
+        ``serve --metrics-port`` exposes on the cluster path."""
+        return self._federation.registry
+
+    def federation_reconcile(self):
+        """Problem list for the federation reconciliation invariant
+        (empty = every federated child equals the matching process's
+        own cumulative value; see obs.federation)."""
+        return self._federation.reconcile()
+
+    def slo_health(self) -> dict:
+        """The /health verdict — same shape as
+        ``StreamEngine.slo_health`` so the serve CLI wires either."""
+        if self._slo is None:
+            return {"ok": True, "burning": [], "phase": self.phase}
+        return self._slo.health()
+
     # -- surviving-host discovery + redeal ---------------------------------
 
     def discover(self) -> List[int]:
@@ -1205,8 +1377,16 @@ class ClusterStreamEngine:
             reqs = []
             for g in grids:
                 ent = self._ledger[g]
+                prev = ent.assigned
                 ent.assigned = w_pid
                 reqs.append(ent.payload())
+                # round 19: the redeal-after-host-loss hop on the
+                # rid's causal trace — from the lost process to the
+                # survivor it re-dealt onto
+                self.telemetry.request_event(
+                    self._rid_spans.get(g), "request_redeal",
+                    rid=g, from_process=prev, process=w_pid,
+                    phase=self.phase)
             self._worker(w_pid).call({"cmd": "submit",
                                       "reqs": reqs})
             moved += len(reqs)
@@ -1284,7 +1464,8 @@ class ClusterStreamEngine:
             "phase": self.phase, "next_rid": self._next_rid,
             "rr": self._rr,
             "ledger": [dict(e.payload(), submit_phase=e.submit_phase,
-                            assigned=e.assigned, state=e.state)
+                            assigned=e.assigned, state=e.state,
+                            dealt_phase=e.dealt_phase)
                        for e in (self._ledger[g]
                                  for g in sorted(self._ledger))],
             "pending": sorted(self._pending),
@@ -1373,6 +1554,7 @@ class ClusterStreamEngine:
             ent = _LedgerEntry.from_payload(d)
             ent.assigned = d.get("assigned")
             ent.state = d.get("state", "pending")
+            ent.dealt_phase = d.get("dealt_phase")
             eng._ledger[ent.grid] = ent
         eng._pending = [int(g) for g in totals.get("pending", [])]
         eng._spill_queue = [int(g)
@@ -1404,6 +1586,21 @@ class ClusterStreamEngine:
         for rid in done:
             if rid in eng._ledger:
                 eng._ledger[rid].state = "done"
+        # round 19: rebuild the coordinator's SLO-accounting registry
+        # from the restored deterministic record (same discipline as
+        # StreamEngine._replay_registry), and re-open request spans
+        # for every non-terminal rid so the appended events segment
+        # keeps its rid linkage
+        eng._replay_registry()
+        if eng._slo is not None:
+            # burn windows re-base at resume (see StreamEngine.resume)
+            eng._slo.seed_base(eng.phase)
+        for g in sorted(eng._ledger):
+            ent = eng._ledger[g]
+            if ent.state in ("pending", "dealt", "spill"):
+                eng._rid_spans[g] = eng.telemetry.request_span(
+                    g, tenant=ent.tenant, priority=ent.priority,
+                    submit_phase=ent.submit_phase)
 
         if resized:
             # cross-topology: stale per-process snapshots must not be
@@ -1457,6 +1654,41 @@ class ClusterStreamEngine:
             rows=moved,
             wall_s=round(self.redeal_walls[-1], 4), phase=self.phase)
 
+    def _publish_retirement(self, c) -> None:
+        """The ONE registry-publication site for a completed record —
+        called at live completion (``_complete``) and at resume
+        replay (``_replay_registry``), so a metric added to one can
+        never silently undercount in the other (the exact gap class
+        round 18 hit with the spillover counters)."""
+        self._c_retired.inc()
+        self._c_tenant_retired.labels(tenant=c.tenant).inc()
+        self._h_lat_phases.observe(c.latency_phases)
+        self._h_lat_seconds.observe(c.latency_s)
+        self._h_class_lat.labels(priority=str(c.priority)) \
+            .observe(c.latency_phases)
+        self._h_tenant_lat.labels(tenant=c.tenant) \
+            .observe(c.latency_phases)
+        if getattr(c, "spillover", False):
+            self._c_spillover.inc()
+        if c.failed:
+            if c.failure == "deadline_exceeded":
+                self._c_deadline.labels(tenant=c.tenant).inc()
+            else:
+                self._c_quarantined.inc()
+
+    def _replay_registry(self) -> None:
+        """Coordinator-registry replay at resume: the restored
+        completed/shed records re-publish through the same
+        ``_publish_retirement`` helper ``_complete`` uses, so a
+        resumed run's SLO evaluator and federated exposition read the
+        identical cumulative state (latency_s re-observes the
+        recorded wall values — the seconds histogram is the one
+        nondeterministic surface, as everywhere)."""
+        for c in self.completed:
+            self._publish_retirement(c)
+        for s in self.shed:
+            self._c_shed.labels(tenant=s.tenant, reason=s.reason).inc()
+
     def _reconcile_workers(
             self, states: Optional[Dict[int, dict]] = None) -> None:
         """Adopt worker-reported completions the coordinator does not
@@ -1468,6 +1700,11 @@ class ClusterStreamEngine:
         for w in self._live():
             st = (states[w.process_id] if states is not None
                   else w.hello)
+            if st.get("metrics") is not None:
+                # federation catches up on whatever the lost replies
+                # dropped (cumulative dumps: delta-safe)
+                self._federation.ingest_dump(str(w.process_id),
+                                             st["metrics"])
             if st.get("corrupt"):
                 self.telemetry.event(
                     "worker_snapshot_corrupt",

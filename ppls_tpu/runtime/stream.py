@@ -542,7 +542,8 @@ class StreamEngine:
                  default_deadline_phases: Optional[int] = None,
                  on_shed=None,
                  spillover: bool = False,
-                 spillover_limit: int = 4):
+                 spillover_limit: int = 4,
+                 slo_config=None):
         from ppls_tpu.models.integrands import get_family, get_family_ds
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -782,6 +783,21 @@ class StreamEngine:
             "requests retired, by tenant", ("tenant",))
         self._h_class_lat = tel.class_latency_histogram()
         self._h_tenant_lat = tel.tenant_latency_histogram()
+        # round 19: SLO burn-rate alerting — a phase-boundary
+        # evaluator over the registry histograms/counters the
+        # boundaries above already publish (no new device fetches;
+        # GL06 boundary-hook-only holds for its emit sites too)
+        self._slo = None
+        if slo_config is not None:
+            from ppls_tpu.obs.slo import SloEvaluator
+            self._slo = SloEvaluator(slo_config, tel)
+        # round 19: per-rid DISTRIBUTED TRACE state — one detached
+        # request span per rid (opened at submit ack, closed at the
+        # terminal disposition) and the token-bucket wait counter the
+        # admit event reports. Host bookkeeping only; spans re-open on
+        # resume so a continued timeline keeps its rid linkage.
+        self._rid_spans: dict = {}
+        self._token_waits: dict = {}
         # round 14: seeded fault injection (runtime/faults.py) — hooks
         # fire at the boundaries this engine already owns; None = no
         # plan armed, zero overhead
@@ -887,6 +903,13 @@ class StreamEngine:
             submit_phase=self.phase, submit_t=time.perf_counter(),
             tenant=tenant, priority=priority,
             deadline_phases=deadline_phases)
+        # round 19: the rid's causal trace starts at the ack — a
+        # detached span that outlives phase spans and closes at the
+        # terminal disposition (retire / shed), every hop an explicit
+        # child event
+        self._rid_spans[rid] = self.telemetry.request_span(
+            rid, tenant=tenant, priority=priority,
+            submit_phase=req.submit_phase)
         if self.queue_limit is not None \
                 and len(self._pending) >= self.queue_limit:
             # deterministic shed policy: the victim is the lowest-
@@ -914,8 +937,9 @@ class StreamEngine:
                      and req.deadline_phases is None)
         if spillable and len(self._spill_queue) < self._spill_cap:
             self._spill_queue.append(req)
-            self.telemetry.event(
-                "spillover_enqueued", rid=req.rid, tenant=req.tenant,
+            self.telemetry.request_event(
+                self._rid_spans.get(req.rid), "spillover_enqueued",
+                rid=req.rid, tenant=req.tenant,
                 phase=self.phase, submit_phase=req.submit_phase)
             return
         self._shed(req,
@@ -934,10 +958,17 @@ class StreamEngine:
             phase=self.phase, submit_phase=req.submit_phase)
         self.shed.append(rec)
         self._c_shed.labels(tenant=req.tenant, reason=reason).inc()
-        self.telemetry.event(
-            "request_shed", rid=req.rid, tenant=req.tenant,
+        self._token_waits.pop(req.rid, None)   # terminal: no leak
+        span = self._rid_spans.pop(req.rid, None)
+        self.telemetry.request_event(
+            span, "request_shed", rid=req.rid, tenant=req.tenant,
             priority=req.priority, reason=reason, phase=self.phase,
             submit_phase=req.submit_phase)
+        if span is not None:
+            # shed is a terminal disposition: the rid's trace closes
+            # with the refusal on it
+            span.close(disposition="shed", reason=reason,
+                       phase=self.phase)
         if self.on_shed is not None:
             self.on_shed(rec)
         return rec
@@ -1142,6 +1173,17 @@ class StreamEngine:
                         # bucket starts full
                         self._tokens[req.tenant] = q["burst"]
                     if self._tokens[req.tenant] < 1.0:
+                        # round 19: the token-bucket wait is a hop on
+                        # the rid's causal trace — counted (the admit
+                        # event reports the total) and emitted per
+                        # waited phase, both deterministic functions
+                        # of the schedule
+                        self._token_waits[req.rid] = \
+                            self._token_waits.get(req.rid, 0) + 1
+                        self.telemetry.request_event(
+                            self._rid_spans.get(req.rid),
+                            "token_wait", rid=req.rid,
+                            tenant=req.tenant, phase=self.phase)
                         continue
                     self._tokens[req.tenant] -= 1.0
                 chosen.append(req)
@@ -1196,12 +1238,20 @@ class StreamEngine:
                 slot=slot, admit_phase=self.phase)
             self._fam_first[slot] = self.phase
             admitted.append(req)
-            self.telemetry.event(
+            # round 19: the admit event is a request-span child and
+            # carries the QUEUE-WAIT decomposition — total phases
+            # queued, of which token-bucket waits (both exact
+            # schedule functions; analyze_request sums them back to
+            # the retire latency bit-for-bit)
+            self.telemetry.request_event(
+                self._rid_spans.get(req.rid),
                 "admit", rid=req.rid, slot=slot, phase=self.phase,
                 theta=(list(row) if self._theta_block > 1
                        else req.theta),
                 bounds=list(req.bounds),
                 submit_phase=req.submit_phase,
+                queue_wait_phases=self.phase - req.submit_phase,
+                token_wait_phases=self._token_waits.pop(req.rid, 0),
                 tenant=req.tenant, priority=req.priority)
         if n_new:
             self._c_admitted.inc(n_new)
@@ -1422,13 +1472,16 @@ class StreamEngine:
         # schedule-determined: bit-stable across rerun and resume
         # (failed retirements carry area=None — the non-finite payload
         # would not be strict JSON)
-        self.telemetry.event(
-            "retire", rid=c.rid, slot=slot,
+        span = self._rid_spans.pop(c.rid, None)
+        self.telemetry.request_event(
+            span, "retire", rid=c.rid, slot=slot,
             area=(c.area if ok else None),
             **({"areas": c.areas}
                if c.areas is not None and ok else {}),
             failed=c.failed,
             **({"failure": c.failure} if c.failure else {}),
+            **({"spillover": True}
+               if getattr(c, "spillover", False) else {}),
             submit_phase=c.submit_phase,
             admit_phase=c.admit_phase,
             retire_phase=c.retire_phase,
@@ -1437,6 +1490,14 @@ class StreamEngine:
             last_credited_phase=c.last_credited_phase,
             latency_s=round(c.latency_s, 6),
             tenant=c.tenant, priority=c.priority)
+        if span is not None:
+            # retirement closes the rid's trace — the span summary is
+            # the deterministic latency record
+            span.close(
+                disposition=("failed" if c.failed else "retired"),
+                **({"failure": c.failure} if c.failure else {}),
+                retire_phase=c.retire_phase,
+                latency_phases=c.latency_phases)
 
     def _cancel_slots(self, kill: np.ndarray) -> None:
         """Compact the cancelled slots' live rows out of the device
@@ -1504,9 +1565,9 @@ class StreamEngine:
                 if not self.quarantine:
                     raise
                 failed = True
-                self.telemetry.event("quarantine", rid=req.rid,
-                                     phase=self.phase,
-                                     spillover=True)
+                self.telemetry.request_event(
+                    self._rid_spans.get(req.rid), "quarantine",
+                    rid=req.rid, phase=self.phase, spillover=True)
                 self._c_quarantined.inc()
             batched = isinstance(req.theta, (tuple, list))
             c = CompletedRequest(
@@ -1556,6 +1617,8 @@ class StreamEngine:
             self.completed.extend(spilled)
             self.phase += 1
             self._publish_gauges()
+            if self._slo is not None:
+                self._slo.evaluate_slo(self.phase)
             span.close(idle=not spilled, retired=len(spilled))
             # the idle branch still honors the snapshot cadence and
             # the phase-close fault boundary: a drained-tail spillover
@@ -1594,6 +1657,17 @@ class StreamEngine:
         row = stats.astype(np.int64)
         self._phase_rows.append(row)
         vals = self._publish_phase_row(row)
+        if tel.tracer.enabled:
+            # round 19: per-rid phase residency — one request-span
+            # child event per resident request, linking this phase's
+            # span by id so the causal trace names every compute
+            # phase the rid was live in. Slots bound the fan-out.
+            for slot in sorted(self._slot_req):
+                req = self._slot_req[slot]
+                tel.request_event(
+                    self._rid_spans.get(req.rid), "request_phase",
+                    rid=req.rid, slot=slot, phase=self.phase,
+                    phase_span=span.sid)
         retired = []
         now = time.perf_counter()
         for slot in sorted(self._slot_req):
@@ -1626,8 +1700,9 @@ class StreamEngine:
                 # concurrent request retires through the branch below
                 # untouched. The failed record keeps the request's
                 # latency accounting so SLO math sees the failure.
-                tel.event("quarantine", rid=req.rid, slot=slot,
-                          phase=self.phase)
+                tel.request_event(self._rid_spans.get(req.rid),
+                                  "quarantine", rid=req.rid,
+                                  slot=slot, phase=self.phase)
                 self._c_quarantined.inc()
             c = CompletedRequest(
                 rid=req.rid, theta=req.theta, bounds=req.bounds,
@@ -1671,9 +1746,10 @@ class StreamEngine:
                 last_credited_phase=int(fam_last[slot]),
                 failed=True, tenant=req.tenant,
                 priority=req.priority, failure="deadline_exceeded")
-            tel.event("deadline_exceeded", rid=req.rid, slot=slot,
-                      phase=self.phase, deadline_phase=dp,
-                      tenant=req.tenant)
+            tel.request_event(self._rid_spans.get(req.rid),
+                              "deadline_exceeded", rid=req.rid,
+                              slot=slot, phase=self.phase,
+                              deadline_phase=dp, tenant=req.tenant)
             self._c_deadline.labels(tenant=req.tenant).inc()
             retired.append(c)
             self._free.append(slot)
@@ -1688,6 +1764,11 @@ class StreamEngine:
         self.completed.extend(retired)
         self.phase += 1
         self._publish_gauges(step_wall_s=time.perf_counter() - t_step0)
+        if self._slo is not None:
+            # round 19: the burn-rate evaluator runs on the registry
+            # state this boundary just published — the one device
+            # fetch retirement already paid covers it
+            self._slo.evaluate_slo(self.phase)
         # the phase span closes carrying the phase's device-counter
         # delta row — the timeline IS the per-phase stats trail
         span.close(retired=len(retired), **vals)
@@ -1792,6 +1873,15 @@ class StreamEngine:
                                 rows, STREAM_STAT_FIELDS),
                             shed=list(self.shed))
 
+    def slo_health(self) -> dict:
+        """The /health verdict (round 19): the SLO evaluator's
+        current burning set, or a green default when no SLO config is
+        armed — one shape for the serve CLI's health endpoint on both
+        the single-process and cluster paths."""
+        if self._slo is None:
+            return {"ok": True, "burning": [], "phase": self.phase}
+        return self._slo.health()
+
     def spillover_summary(self) -> dict:
         """Graceful-degradation accounting, the CLUSTER-shape twin
         (``ClusterStreamEngine.spillover_summary``): record counts
@@ -1884,6 +1974,13 @@ class StreamEngine:
             "spill_tasks_total": int(
                 self._spill.tasks_total if self._spill else 0),
             "tokens": dict(self._tokens),
+            # round 19: the per-rid token-wait counters ride too — a
+            # resumed admission must report the SAME token_wait_phases
+            # on its admit event (the bit-for-bit trace contract) and
+            # analyze_request must not misattribute the pre-kill waits
+            # to backlog
+            "token_waits": {str(k): int(v)
+                            for k, v in self._token_waits.items()},
             "client_state": dict(self.client_state),
         }
         if self._theta_block > 1 and self._fill is not None:
@@ -2035,6 +2132,8 @@ class StreamEngine:
             for d in totals.get("shed", [])]
         eng._tokens = {str(k): float(v)
                        for k, v in totals.get("tokens", {}).items()}
+        eng._token_waits = {int(k): int(v) for k, v in
+                            totals.get("token_waits", {}).items()}
         eng.client_state = dict(totals.get("client_state", {}))
         for slot_s, d in totals["resident"].items():
             slot = int(slot_s)
@@ -2061,6 +2160,21 @@ class StreamEngine:
                                     np.asarray(totals["fam_last"],
                                                dtype=np.int32))
         eng._replay_registry()
+        if eng._slo is not None:
+            # the burn windows re-base at the resume point: the
+            # replayed cumulative counters must not read as one
+            # giant window (spurious all-time burn alerts)
+            eng._slo.seed_base(eng.phase)
+        # round 19: restored LIVE rids re-open their request spans in
+        # the appended segment, so every later hop (phase residency,
+        # retirement) keeps its rid linkage — the per-rid timeline's
+        # deterministic events replay bit-for-bit across the
+        # kill-and-resume, same contract as the phase rows
+        for req in (list(eng._pending) + list(eng._slot_req.values())
+                    + list(eng._spill_queue)):
+            eng._rid_spans[req.rid] = eng.telemetry.request_span(
+                req.rid, tenant=req.tenant, priority=req.priority,
+                submit_phase=req.submit_phase)
         eng.telemetry.event(
             "resume", phase=eng.phase, count=eng._count,
             pending=len(eng._pending), resident=len(eng._slot_req),

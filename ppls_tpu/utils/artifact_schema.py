@@ -137,9 +137,18 @@ def validate_artifact_text(text: str, *, where: str = "artifact",
 
 EVENT_KINDS = ("meta", "span_open", "span_close", "event")
 
+# the per-rid trace event vocabulary (round 19): every one of these
+# must link to an OPEN request span for its rid when the rid-linkage
+# check is armed
+RID_TRACE_EVENTS = ("admit", "request_dealt", "token_wait",
+                    "request_phase", "spillover_enqueued",
+                    "request_redeal", "quarantine",
+                    "deadline_exceeded", "retire", "request_shed")
+
 
 def validate_events_text(text: str, *, where: str = "events",
-                         require_balanced: bool = True) -> List[str]:
+                         require_balanced: bool = True,
+                         check_rid_linkage: bool = False) -> List[str]:
     """Validate a telemetry event log (``obs.spans`` JSONL timeline).
 
     Per line: a JSON object with ``ev`` in :data:`EVENT_KINDS`; every
@@ -152,12 +161,26 @@ def validate_events_text(text: str, *, where: str = "events",
     (when present) is an object. ``require_balanced=False`` tolerates
     unclosed spans — the shape a killed run leaves behind.
 
+    ``check_rid_linkage=True`` (round 19) additionally enforces the
+    REQUEST-TRACE contract on timelines that carry it: every
+    rid-bearing trace event (:data:`RID_TRACE_EVENTS`) must link to a
+    ``request`` span OPEN for that rid in its segment (resumed
+    segments re-open live rids' spans, so this holds across
+    kill-and-resume), and a terminal event (retire / request_shed)
+    must be followed by that rid's span close within the segment —
+    zero orphan spans, zero orphan hops. Timelines predating the
+    request-trace tier fail this check; leave it off for them.
+
     Returns a list of problem strings (empty = clean).
     """
     problems: List[str] = []
     open_spans: set = set()
     last_t = None
     found = 0
+    # rid-linkage state (reset per segment, like span ids)
+    req_sids: dict = {}          # open request-span id -> rid
+    rid_open: set = set()        # rids with an open request span
+    rid_terminal_open: set = set()   # terminal seen, span still open
     for i, line in enumerate(text.splitlines(), 1):
         line = line.strip()
         if not line:
@@ -187,6 +210,14 @@ def validate_events_text(text: str, *, where: str = "events",
                     f"{where}:{i}: {len(open_spans)} span(s) left "
                     f"open at segment boundary: {sorted(open_spans)}")
             open_spans.clear()
+            if check_rid_linkage and rid_terminal_open:
+                problems.append(
+                    f"{where}:{i}: request span(s) for retired/shed "
+                    f"rid(s) {sorted(rid_terminal_open)[:8]} never "
+                    f"closed in their segment")
+            req_sids.clear()
+            rid_open.clear()
+            rid_terminal_open.clear()
             if rec.get("schema") != "ppls-events-v1":
                 problems.append(f"{where}:{i}: meta without "
                                 f"schema=ppls-events-v1")
@@ -218,6 +249,14 @@ def validate_events_text(text: str, *, where: str = "events",
             if sid in open_spans:
                 problems.append(f"{where}:{i}: span id {sid} reopened")
             open_spans.add(sid)
+            if check_rid_linkage and rec.get("name") == "request":
+                rid = (attrs or {}).get("rid")
+                if not isinstance(rid, int):
+                    problems.append(f"{where}:{i}: request span "
+                                    f"without int 'rid'")
+                else:
+                    req_sids[sid] = rid
+                    rid_open.add(rid)
         elif ev == "span_close":
             sid = rec.get("id")
             if sid not in open_spans:
@@ -225,14 +264,36 @@ def validate_events_text(text: str, *, where: str = "events",
                                 f"unopened id {sid!r}")
             else:
                 open_spans.discard(sid)
+            if check_rid_linkage and sid in req_sids:
+                rid = req_sids.pop(sid)
+                rid_open.discard(rid)
+                rid_terminal_open.discard(rid)
         elif ev == "event":
             if not isinstance(rec.get("name"), str) or not rec["name"]:
                 problems.append(f"{where}:{i}: event without 'name'")
+            elif check_rid_linkage \
+                    and rec["name"] in RID_TRACE_EVENTS:
+                rid = (attrs or {}).get("rid")
+                if not isinstance(rid, int):
+                    problems.append(
+                        f"{where}:{i}: trace event "
+                        f"{rec['name']!r} without int 'rid'")
+                elif rid not in rid_open:
+                    problems.append(
+                        f"{where}:{i}: orphan trace event "
+                        f"{rec['name']!r} — rid {rid} has no open "
+                        f"request span in this segment")
+                elif rec["name"] in ("retire", "request_shed"):
+                    rid_terminal_open.add(rid)
     if not found:
         problems.append(f"{where}: no event records found")
     elif require_balanced and open_spans:
         problems.append(f"{where}: {len(open_spans)} span(s) never "
                         f"closed: {sorted(open_spans)}")
+    if check_rid_linkage and rid_terminal_open:
+        problems.append(
+            f"{where}: request span(s) for retired/shed rid(s) "
+            f"{sorted(rid_terminal_open)[:8]} never closed")
     return problems
 
 

@@ -630,7 +630,7 @@ def test_serve_cli_cluster_sigterm_graceful_restart(base6, tmp_path,
     from ppls_tpu import __main__ as cli
 
     ck = str(tmp_path / "sig.ckpt")
-    argv, _ev = _serve_cluster_args(
+    argv, ev1 = _serve_cluster_args(
         tmp_path, "sig",
         ["--checkpoint", ck, "--checkpoint-every", "1",
          "--fault-plan",
@@ -642,7 +642,7 @@ def test_serve_cli_cluster_sigterm_graceful_restart(base6, tmp_path,
     assert s1["summary"] and s1.get("terminated") == "SIGTERM"
     assert os.path.exists(ck), "graceful shutdown must keep the " \
                                "snapshot (it IS the restart state)"
-    argv, _ev = _serve_cluster_args(tmp_path, "sig2",
+    argv, ev2 = _serve_cluster_args(tmp_path, "sig2",
                                     ["--checkpoint", ck])
     assert cli.main(argv) == 0
     lines2 = [json.loads(ln) for ln in
@@ -656,6 +656,25 @@ def test_serve_cli_cluster_sigterm_graceful_restart(base6, tmp_path,
     assert sorted(got) == list(range(6))
     assert np.array_equal(
         np.array([got[r] for r in sorted(got)]), base6.areas)
+    # round 19 (trace linkage under chaos): BOTH lineage segments
+    # satisfy the rid-linkage contract — zero orphan spans — and the
+    # union of the two timelines carries the restart trail plus one
+    # retire per acknowledged rid
+    from ppls_tpu.utils.artifact_schema import validate_events_text
+    for p in (ev1, ev2):
+        assert validate_events_text(open(p).read(),
+                                    check_rid_linkage=True) == [], p
+    names1, retires = set(), {}
+    for p in (ev1, ev2):
+        for ln in open(p):
+            r = json.loads(ln)
+            if r.get("ev") == "event":
+                names1.add(r["name"])
+                if r["name"] == "retire":
+                    retires[r["attrs"]["rid"]] = r["attrs"]
+    assert "graceful_shutdown" in names1      # the restart trail...
+    assert "cluster_resume" in names1         # ...on the timelines
+    assert sorted(retires) == list(range(6))
 
 
 def test_serve_cli_cluster_watchdog_hang_rebuilds_engine(
@@ -790,16 +809,74 @@ def test_serve_cli_cluster_refuses_tenant_quotas(tmp_path):
         cli.main(argv)
 
 
-def test_serve_cli_cluster_refuses_metrics_port(tmp_path):
-    """Review fix (round 18): --metrics-port with --processes used to
-    be silently ignored (no listener, no metrics_port in the summary)
-    — a scrape-based harness would collect nothing for the whole run.
-    Unsupported cluster flags refuse loudly."""
-    from ppls_tpu import __main__ as cli
+def test_serve_cli_cluster_metrics_port_serves_federated(tmp_path):
+    """Round 19: the --metrics-port+--processes refusal is LIFTED —
+    the cluster serve exposes ONE federated /metrics surface (worker
+    registries under process labels + the coordinator's own) whose
+    cluster totals reconcile exactly with the summary, scraped LIVE
+    over HTTP (PPLS_SERVE_METRICS_HOLD keeps the listener up past
+    the summary line so the final sample is race-free)."""
+    import re
+    import subprocess
+    import sys as _sys
+    import time
+    import urllib.request
     argv, _ev = _serve_cluster_args(tmp_path, "mport",
                                     ["--metrics-port", "0"])
-    with pytest.raises(SystemExit, match="metrics-port"):
-        cli.main(argv)
+    out_p = tmp_path / "mport.out"
+    err_p = tmp_path / "mport.err"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PPLS_SERVE_METRICS_HOLD="10")
+    with open(out_p, "w") as fo, open(err_p, "w") as fe:
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "ppls_tpu"] + argv,
+            stdout=fo, stderr=fe, env=env)
+        try:
+            url = None
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and url is None:
+                m = re.search(r"metrics on (http://\S+)",
+                              open(err_p).read())
+                if m:
+                    url = m.group(1)
+                elif proc.poll() is not None:
+                    raise AssertionError(
+                        f"serve exited rc={proc.returncode} before "
+                        f"announcing metrics: {open(err_p).read()}")
+                else:
+                    time.sleep(0.2)
+            # scrape DURING the run until the summary lands, then one
+            # final post-drain sample inside the hold window
+            summary = None
+            expo = ""
+            while time.monotonic() < deadline and summary is None:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    expo = r.read().decode()
+                for ln in open(out_p).read().splitlines():
+                    if ln.strip().startswith("{"):
+                        rec = json.loads(ln)
+                        if rec.get("summary"):
+                            summary = rec
+                time.sleep(0.1)
+            assert summary is not None, "no summary within budget"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                expo = r.read().decode()
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert summary["metrics_url"] == url
+    # the reconciliation invariant on the final scrape: coordinator-
+    # merged retired counter == sum over worker processes (+0
+    # spillover here) == summary.completed
+    vals = {}
+    for ln in expo.splitlines():
+        m = re.match(r'ppls_stream_retired_total\{process="([^"]+)"\}'
+                     r' (\S+)', ln)
+        if m:
+            vals[m.group(1)] = float(m.group(2))
+    workers = sum(v for k, v in vals.items() if k != "coordinator")
+    assert vals.get("coordinator") == summary["completed"] == 6
+    assert workers == summary["completed"]
 
 
 def test_serve_cli_cluster_refuses_bad_process_counts(tmp_path):

@@ -500,6 +500,53 @@ def test_gl06_per_chip_span_boundary_hook_clean(tmp_path):
     assert [v for v in run_lint(pkg) if v.code == "GL06"] == []
 
 
+GL06_ROUND19_EMITS = """
+    import functools
+    import jax
+    from pkg.obs.telemetry import Telemetry
+
+    def trace_request(tel, slo, fed, rid, dump):
+        # the round-19 emit surface: request-trace helpers, the SLO
+        # burn evaluator, and the federation merge — boundary-hook
+        # only, like every other telemetry publish
+        span = tel.request_span(rid, tenant="a")
+        tel.request_event(span, "admit", rid=rid)
+        slo.evaluate_slo(rid)
+        fed.ingest_dump("0", dump)
+
+    @functools.partial(jax.jit, static_argnames=())
+    def cycle(x, tel, slo, fed):
+        trace_request(tel, slo, fed, x, {})   # traced path: flagged
+        return x
+
+    def boundary_hook(tel, slo, fed, rid, dump):
+        trace_request(tel, slo, fed, rid, dump)
+"""
+
+
+def test_gl06_flags_round19_emit_sites_in_traced_path(tmp_path):
+    """Round-19 fixture: the NEW emit sites — request_span /
+    request_event (trace context), evaluate_slo (the burn evaluator),
+    ingest_dump (the federation merge) — are on the GL06 API surface:
+    reachable from a jitted root, each is a violation."""
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": GL06_ROUND19_EMITS})
+    got = sorted(v.symbol for v in run_lint(pkg) if v.code == "GL06")
+    assert "trace_request:request_span" in got, got
+    assert "trace_request:request_event" in got, got
+    assert "trace_request:evaluate_slo" in got, got
+    assert "trace_request:ingest_dump" in got, got
+
+
+def test_gl06_round19_emit_sites_boundary_hook_clean(tmp_path):
+    # the fixed twin: unreachable from the jit root, same emits stay
+    # silent — the baseline holds at 0 new entries
+    fixed = GL06_ROUND19_EMITS.replace(
+        "trace_request(tel, slo, fed, x, {})   # traced path: flagged",
+        "pass")
+    pkg = _mkpkg(tmp_path, {"parallel/hot.py": fixed})
+    assert [v for v in run_lint(pkg) if v.code == "GL06"] == []
+
+
 def test_gl06_real_package_clean():
     # the package-level acceptance: all telemetry publishes live in
     # boundary hooks (zero new baseline entries for GL06)

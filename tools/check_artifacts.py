@@ -15,7 +15,10 @@ Usage:
     some-bench | python tools/check_artifacts.py -   # validate stdin
     python tools/check_artifacts.py --events EVENTS.jsonl [...]
         # validate event logs (--unbalanced-ok tolerates the unclosed
-        # spans a killed run leaves behind)
+        # spans a killed run leaves behind; --rid-linkage additionally
+        # enforces the round-19 request-trace contract — every
+        # rid-bearing trace event linked to an open request span,
+        # terminal events closing their span, zero orphans)
     python tools/check_artifacts.py --serve SERVE_STDOUT.jsonl [...]
         # round 16: validate a serve stdout ledger — every line a
         # retire/shed/rejection/summary record, with the rid-deduped
@@ -51,6 +54,13 @@ def main(argv) -> int:
     if "--unbalanced-ok" in args:
         args.remove("--unbalanced-ok")
         balanced = False
+    # round 19: --rid-linkage arms the request-trace contract on
+    # --events files (every rid-bearing trace event links to an open
+    # request span; terminal events close their span — zero orphans)
+    rid_linkage = False
+    if "--rid-linkage" in args:
+        args.remove("--rid-linkage")
+        rid_linkage = True
     event_paths = []
     while "--events" in args:
         i = args.index("--events")
@@ -84,7 +94,8 @@ def main(argv) -> int:
         with open(p) as fh:
             problems += validate_events_text(
                 fh.read(), where=os.path.basename(p),
-                require_balanced=balanced)
+                require_balanced=balanced,
+                check_rid_linkage=rid_linkage)
     # round 16: serve stdout ledgers (retire/shed/rejection/summary
     # accounting invariants) — the chaos-under-load CI step's third
     # artifact document type

@@ -52,7 +52,15 @@
 #       the identical dyadic workload at {1, 2, 4} processes under a
 #       wall budget; per-request areas must be BIT-IDENTICAL across
 #       the sweep and every ledger validates via check_artifacts
-#       --serve
+#       --serve. Round 19 adds the FEDERATED METRICS leg: a
+#       --processes 2 run with --metrics-port 0 scraped LIVE, the
+#       final post-summary sample (PPLS_SERVE_METRICS_HOLD window)
+#       asserting the reconciliation invariant (coordinator-merged
+#       retired == sum over worker processes + spillover ==
+#       summary.completed). The chaos timelines of 5b/5c additionally
+#       pass the round-19 rid-linkage check (--rid-linkage) and 5c's
+#       timeline must decompose exactly through
+#       tools/analyze_request.py --check
 #   6. bench observatory: tools/bench_history.py --check over the
 #      committed round artifacts + the quick-proxy regression gate
 #      (device-counted proxies vs tools/bench_quick_ref.json; round
@@ -254,8 +262,11 @@ else
     echo "ci: chaos stage 1 serve FAILED"
     chaos_fail=1
 fi
+# round 19: the chaos-drain timeline must also satisfy the
+# rid-linkage contract (every trace event linked to an open request
+# span; terminal events close their span — zero orphans)
 python tools/check_artifacts.py --events "$CH_DIR/s1.jsonl" \
-    --unbalanced-ok || chaos_fail=1
+    --unbalanced-ok --rid-linkage || chaos_fail=1
 # stage 2: snapshot corruption + phase-boundary crash — the resume
 # must refuse the damaged file (CheckpointCorruptError) and self-heal
 # by starting fresh
@@ -284,7 +295,7 @@ else
     chaos_fail=1
 fi
 python tools/check_artifacts.py --events "$CH_DIR/s2.jsonl" \
-    --unbalanced-ok || chaos_fail=1
+    --unbalanced-ok --rid-linkage || chaos_fail=1
 rm -rf "$CH_DIR"
 if [ "$chaos_fail" -ne 0 ]; then
     echo "ci: seeded chaos drain FAILED"
@@ -335,7 +346,13 @@ else
     ov_fail=1
 fi
 python tools/check_artifacts.py --serve "$OV_DIR/ov.out" \
-    --events "$OV_DIR/ov.jsonl" --unbalanced-ok || ov_fail=1
+    --events "$OV_DIR/ov.jsonl" --unbalanced-ok --rid-linkage \
+    || ov_fail=1
+# round 19: the offline critical-path analyzer must decompose the
+# chaos-under-load timeline with components summing exactly to every
+# recorded retire latency
+JAX_PLATFORMS=cpu python tools/analyze_request.py "$OV_DIR/ov.jsonl" \
+    --check > /dev/null || ov_fail=1
 rm -rf "$OV_DIR"
 if [ "$ov_fail" -ne 0 ]; then
     echo "ci: chaos under load FAILED"
@@ -387,6 +404,69 @@ assert all(v == vals[0] for v in vals[1:]), \
     "process-count sweep areas diverged"
 print("ci: process sweep OK (6 areas bit-identical across "
       "{1,2,4} processes)")
+PYEOF
+# round 19: FEDERATED METRICS scraped LIVE during a --processes 2 run
+# — every in-flight sample must parse, and the final (post-summary,
+# inside the PPLS_SERVE_METRICS_HOLD window) sample must satisfy the
+# reconciliation invariant: coordinator-merged retired counter ==
+# sum over worker processes + spillover == summary.completed
+python - "$MP_DIR" <<'PYEOF' || mp_fail=1
+import json, os, re, subprocess, sys, time, urllib.request
+d = sys.argv[1]
+out_p, err_p = os.path.join(d, "fed.out"), os.path.join(d, "fed.err")
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PPLS_SERVE_METRICS_HOLD="15")
+cmd = [sys.executable, "-m", "ppls_tpu", "serve",
+       "--processes", "2", "--f64-rounds", "2",
+       "--family", "quad_scaled",
+       "--theta", "1.0,1.25,1.5,2.0,0.75,3.0",
+       "--arrival-rate", "2", "--seed", "0", "--eps", "1e-9",
+       "-a", "0.0", "-b", "1.0", "--slots", "4",
+       "--chunk", "1024", "--capacity", "65536",
+       "--lanes", "256", "--refill-slots", "2",
+       "--metrics-port", "0"]
+with open(out_p, "w") as fo, open(err_p, "w") as fe:
+    proc = subprocess.Popen(cmd, stdout=fo, stderr=fe, env=env)
+try:
+    url, deadline = None, time.monotonic() + 240
+    while url is None and time.monotonic() < deadline:
+        m = re.search(r"metrics on (http://\S+)", open(err_p).read())
+        if m:
+            url = m.group(1)
+        elif proc.poll() is not None:
+            raise SystemExit(f"serve died rc={proc.returncode}: "
+                             + open(err_p).read()[-500:])
+        else:
+            time.sleep(0.2)
+    samples, summary = 0, None
+    while summary is None and time.monotonic() < deadline:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            r.read()                       # every live sample parses
+        samples += 1
+        for ln in open(out_p).read().splitlines():
+            if ln.strip().startswith("{"):
+                rec = json.loads(ln)
+                if rec.get("summary"):
+                    summary = rec
+        time.sleep(0.2)
+    assert summary is not None, "no summary within budget"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        expo = r.read().decode()
+finally:
+    proc.kill(); proc.wait(timeout=30)
+vals = {}
+for ln in expo.splitlines():
+    m = re.match(r'ppls_stream_retired_total\{process="([^"]+)"\} '
+                 r'(\S+)', ln)
+    if m:
+        vals[m.group(1)] = float(m.group(2))
+workers = sum(v for k, v in vals.items() if k != "coordinator")
+spill = summary.get("spillover", {}).get("spillover_completed", 0)
+assert vals.get("coordinator") == summary["completed"], (vals, summary)
+assert workers + spill == summary["completed"], (vals, spill, summary)
+print(f"ci: federated /metrics OK ({samples} live scrapes; "
+      f"coordinator {vals['coordinator']:.0f} == workers "
+      f"{workers:.0f} + spillover {spill})")
 PYEOF
 rm -rf "$MP_DIR"
 if [ "$mp_fail" -ne 0 ]; then

@@ -84,11 +84,17 @@ def rule_gl03(modules: List[LintModule]) -> Iterator[Violation]:
 # obs.spans method names). `.set` is deliberately ABSENT: jax's
 # `x.at[i].set(v)` shares the attribute name, and gauges are only
 # reachable through the obs-imported handles the name check below
-# already covers.
+# already covers. Round 19 extends the surface to the new emit
+# sites: the request-trace helpers (request_span / request_event /
+# span_detached), the SLO burn evaluator (evaluate_slo), and the
+# federation merge (ingest_dump) — all boundary-hook-only like the
+# rest.
 _GL06_API = {"inc", "set_max", "observe", "event", "span",
              "publish_run", "publish_phase", "publish_compile_cache",
              "publish_compile", "publish_chip_balance", "record_phase",
-             "stream_counter", "stream_gauge", "emit_event"}
+             "stream_counter", "stream_gauge", "emit_event",
+             "request_span", "request_event", "span_detached",
+             "evaluate_slo", "ingest_dump"}
 
 
 def _imports_obs(mod: LintModule) -> bool:
