@@ -1443,6 +1443,116 @@ def main_quick():
     return 0
 
 
+def bench_tune(quick: bool = False, budget=None, out=None,
+               write: bool = True, families=None) -> dict:
+    """Round-20 closed-loop autotuning leg (``python bench.py tune``):
+    run the staged coordinate-descent sweep (runtime/tune.py) over the
+    canonical workloads — attribution picks each next knob via the
+    shared dominant-bucket->knob map, acceptance is the Pareto
+    tuned-beats-default contract on the quick device-counted proxies
+    (lane_efficiency + kernel_steps), recompiles per trial are counted
+    into provenance — and write the resulting entries into the tuning
+    table (``--out``; the committed tools/tuning_table.json by
+    default). The emitted record carries the per-family
+    baseline/tuned proxies and the post-write resolution tier, and
+    validates against the bench envelope like every other leg."""
+    from ppls_tpu.runtime import tune
+
+    budget = int(budget) if budget else (5 if quick else 16)
+    workloads = [w for w in tune.TUNE_WORKLOADS
+                 if families is None or w[0] in families]
+    if not workloads:
+        raise ValueError(f"no tune workloads selected from "
+                         f"{families!r}")
+    path = out if out else tune.DEFAULT_TABLE_PATH
+    table = tune.load_tuning_table(path)  # merge into an existing file
+    fams = {}
+    improved = 0
+    gains = []
+    for fam, eps, bounds in workloads:
+        entry = tune.tune_workload(fam, eps, bounds, budget=budget)
+        table = tune.update_table(table, entry)
+        prov = entry["provenance"]
+        if prov["improved"]:
+            improved += 1
+        base_eff = entry["baseline"]["lane_efficiency"]
+        gains.append(entry["tuned"]["lane_efficiency"] - base_eff)
+        fams[fam] = {
+            "eps": float(eps),
+            "improved": bool(prov["improved"]),
+            "trials": int(prov["trials"]),
+            "recompiles": int(prov["recompiles"]),
+            "baseline": entry["baseline"],
+            "tuned": entry["tuned"],
+            "knobs": entry["knobs"],
+            "key": tune.entry_key(entry),
+        }
+    if write:
+        tune.write_table(path, table)
+        # post-write resolution check: every swept workload must now
+        # resolve through its own entry (tier 'exact'); a 'default'
+        # here means the table round-trip is broken, not just stale
+        for fam, eps, bounds in workloads:
+            sizing = tune.TUNE_SIZING
+            sig = tune.workload_signature(
+                fam, eps, "trapezoid", theta_block=1, mesh_shape=1,
+                scout=sizing["scout_dtype"] == "f32",
+                refill_slots=sizing["refill_slots"])
+            _, _, tier = tune.resolve_cadence_tuned(
+                None, None, True, sizing["refill_slots"],
+                signature=sig, path=path)
+            fams[fam]["tier_after"] = tier
+    return {
+        "metric": "closed-loop autotuning: staged sweep on the quick "
+                  "proxies",
+        "value": float(improved),
+        "unit": "families where tuned Pareto-beats the hand default "
+                "(lane_efficiency + kernel_steps, device-counted)",
+        "vs_baseline": float(np.mean(gains)) if gains else 0.0,
+        "tuning": {
+            "budget": budget,
+            "table": str(path),
+            "written": bool(write),
+            "families": fams,
+        },
+    }
+
+
+def main_tune():
+    """Standalone mode (``python bench.py tune [--quick] [--budget N]
+    [--out PATH] [--no-write] [--families a,b]``)."""
+    from ppls_tpu.utils.artifact_schema import validate_record
+
+    def _flag(name):
+        if name in sys.argv:
+            i = sys.argv.index(name)
+            if i + 1 < len(sys.argv):
+                return sys.argv[i + 1]
+        return None
+
+    quick = "--quick" in sys.argv
+    budget = _flag("--budget")
+    out = _flag("--out")
+    fams = _flag("--families")
+    families = fams.split(",") if fams else None
+    write = "--no-write" not in sys.argv
+    try:
+        rec = bench_tune(quick=quick, budget=budget, out=out,
+                         write=write, families=families)
+    except Exception as e:  # noqa: BLE001 — one JSON line always
+        print(json.dumps(validate_record(
+            {"metric": "closed-loop autotuning: staged sweep on the "
+                       "quick proxies",
+             "value": 0.0,
+             "unit": "families where tuned Pareto-beats the hand "
+                     "default (lane_efficiency + kernel_steps, "
+                     "device-counted)",
+             "vs_baseline": 0.0, "error": str(e)})))
+        return 1
+    print(json.dumps(validate_record(rec)))
+    return 0
+
+
 def main_dd():
     """Standalone mode (``python bench.py dd``)."""
     try:
@@ -1497,6 +1607,8 @@ if __name__ == "__main__":
         sys.exit(main_theta())
     if len(sys.argv) > 1 and sys.argv[1] == "multihost":
         sys.exit(main_multihost())
+    if len(sys.argv) > 1 and sys.argv[1] == "tune":
+        sys.exit(main_tune())
     if len(sys.argv) > 1 and sys.argv[1] in ("quick", "--quick"):
         sys.exit(main_quick())
     sys.exit(main())

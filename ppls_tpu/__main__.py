@@ -499,6 +499,19 @@ def build_parser() -> argparse.ArgumentParser:
                           "checkpoint/admit boundaries, emit "
                           "fault_injected events, and the supervisor "
                           "(auto-enabled) recovers the run")
+    srv.add_argument("--adapt", action="store_true",
+                     help="round 20: online host-knob adaptation at "
+                          "phase boundaries — the engine nudges its "
+                          "admission budget and spillover limit "
+                          "within declared safe bands from the "
+                          "phase-stats row it already fetched "
+                          "(hysteresis + per-phase step clamps; "
+                          "knob_adapt events; adapted values ride the "
+                          "snapshot so kill-and-resume replays bit-"
+                          "identically). Cadence/sizing defaults come "
+                          "from the committed tuning table "
+                          "(tools/tuning_table.json; override or "
+                          "disable via PPLS_TUNING_TABLE)")
     srv.add_argument("--json", action="store_true", dest="as_json")
 
     qmc = sub.add_parser(
@@ -819,7 +832,8 @@ def _main_serve(args) -> int:
               spillover=bool(getattr(args, "spillover", False)),
               spillover_limit=int(getattr(args, "spillover_limit",
                                           4)),
-              slo_config=getattr(args, "slo_config", None))
+              slo_config=getattr(args, "slo_config", None),
+              adapt=bool(getattr(args, "adapt", False)))
     if args.lanes:
         kw["lanes"] = args.lanes
 

@@ -164,6 +164,17 @@ def get_family(name: str) -> Callable:
         ) from None
 
 
+def family_name_of(f_theta: Callable) -> Optional[str]:
+    """Reverse registry lookup (round 20): the registered name of a
+    family callable, None for ad-hoc callables. The walker's tuning-
+    table signature needs the NAME; callers that pass unregistered
+    integrands simply resolve through the hand-default tier."""
+    for name, fn in FAMILIES.items():
+        if fn is f_theta:
+            return name
+    return None
+
+
 register_family("sin_recip_scaled", lambda x, s: jnp.sin(s / x))
 register_family("sin_scaled", lambda x, s: jnp.sin(s * x))
 register_family("gauss_center", lambda x, c: jnp.exp(

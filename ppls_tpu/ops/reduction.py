@@ -10,7 +10,8 @@ rounds so results are bit-stable for a given (capacity, mesh) shape.
 
 from __future__ import annotations
 
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -61,8 +62,15 @@ def neumaier_add_host(s: float, c: float, x: float) -> Tuple[float, float]:
     return t, c
 
 
+def _env_force_exact() -> bool:
+    """PPLS_EXACT_SEGSUM truthiness (unset/0/false/off => False)."""
+    v = os.environ.get("PPLS_EXACT_SEGSUM", "").strip().lower()
+    return v not in ("", "0", "false", "off")
+
+
 def segment_sum_auto(fam: jnp.ndarray, leaf: jnp.ndarray, m: int,
-                     n: int) -> jnp.ndarray:
+                     n: int, force_exact: Optional[bool] = None
+                     ) -> jnp.ndarray:
     """Per-family sum with the cheapest adequate lowering for the family
     count (measured on v5e, chunk=2^15): a plain sum for m == 1, the
     O(m*n) f64 broadcast-mask reduce for m <= 256 (~27 us at m=128), and
@@ -74,7 +82,17 @@ def segment_sum_auto(fam: jnp.ndarray, leaf: jnp.ndarray, m: int,
     ~1 f64 ulp per reduction when m crosses a tier boundary (e.g. the
     sharded walker's m_local <= 256 vs the single-chip m=1024) — below
     every engine's stated noise floor, and callers that need the exact
-    contract call :func:`exact_segment_sum` directly."""
+    contract call :func:`exact_segment_sum` directly.
+
+    Round 20: ``force_exact`` (default: the PPLS_EXACT_SEGSUM env knob)
+    routes EVERY tier through :func:`exact_segment_sum`, making the
+    per-segment totals independent of the tier boundary — a single chip
+    and a virtual 8-device mesh then produce bit-identical shard sums
+    at the cost of the MXU path's higher small-m latency."""
+    if force_exact is None:
+        force_exact = _env_force_exact()
+    if force_exact:
+        return exact_segment_sum(fam, leaf, m, n)
     if m == 1:
         return jnp.sum(leaf)[None]
     if m <= 256:

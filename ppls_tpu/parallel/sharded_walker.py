@@ -681,11 +681,19 @@ def integrate_family_walker_dd(
                                           validate_double_buffer)
     scout = resolve_scout_dtype(scout_dtype, rule)
     validate_double_buffer(double_buffer, refill_slots)
-    exit_frac, suspend_frac = resolve_cadence(exit_frac, suspend_frac,
-                                              scout, refill_slots)
     if mesh is None:
         mesh = make_mesh(n_devices)
     n_dev = mesh.devices.size
+    # round 20: the mesh shape is part of the tuning-table signature
+    # (mesh creation moved above the cadence resolution for it) —
+    # dd resolves through the same one surface as walker and stream
+    from ppls_tpu.runtime.tune import workload_signature
+    exit_frac, suspend_frac = resolve_cadence(
+        exit_frac, suspend_frac, scout, refill_slots,
+        signature=workload_signature(
+            family, eps, rule, theta_block=int(theta_block),
+            mesh_shape=int(n_dev), scout=scout,
+            refill_slots=int(refill_slots)))
 
     theta2d, rep_theta = normalize_theta_batch(theta, theta_block)
     m = theta2d.shape[0]

@@ -367,8 +367,9 @@ def _theta_retired(s: "WalkState") -> jnp.ndarray:
 
 def resolve_cadence(exit_frac: Optional[float],
                     suspend_frac: Optional[float], scout: bool,
-                    refill_slots: int = 0):
-    """Mode-aware refill-cadence defaults (round 12).
+                    refill_slots: int = 0, *, signature=None):
+    """Mode-aware refill-cadence resolution (round 12; table-driven
+    since round 20).
 
     The r5-tuned defaults (exit 0.80 / suspend 0.5) balanced occupancy
     against BOUNDARY COST — each legacy refill event paid XLA sorts and
@@ -383,12 +384,19 @@ def resolve_cadence(exit_frac: Optional[float],
     refill: on the legacy XLA-boundary engine the higher suspension
     floor just multiplies expensive boundary cycles (measured on the
     16-mesh dry run: the legacy walk phase can stop engaging at all).
-    Callers that pass explicit fractions keep them in every mode."""
-    tight = scout and refill_slots > 0
-    if exit_frac is None:
-        exit_frac = 0.95 if tight else 0.80
-    if suspend_frac is None:
-        suspend_frac = 0.65 if tight else 0.5
+    Callers that pass explicit fractions keep them in every mode.
+
+    Round 20: this is the ONE resolution surface walker, dd, and
+    stream share, and it now consults the committed autotuning table
+    first (``runtime.tune``: exact signature -> nearest signature ->
+    the hand defaults above; the mode fingerprint is a HARD signature
+    constraint, so tight scout-mode entries can never cross onto the
+    legacy engine). ``signature`` is a ``tune.workload_signature``
+    dict or None (None skips the table entirely)."""
+    from ppls_tpu.runtime.tune import resolve_cadence_tuned
+    exit_frac, suspend_frac, _tier = resolve_cadence_tuned(
+        exit_frac, suspend_frac, scout, refill_slots,
+        signature=signature)
     return float(exit_frac), float(suspend_frac)
 
 
@@ -3386,8 +3394,18 @@ def integrate_family_walker(
             f", got {refill_slots}")
     scout = resolve_scout_dtype(scout_dtype, rule)
     validate_double_buffer(double_buffer, refill_slots)
+    # round 20: registered families resolve the cadence through the
+    # tuning table (single-chip signature); ad-hoc callables have no
+    # signature and keep the hand defaults
+    from ppls_tpu.models.integrands import family_name_of
+    from ppls_tpu.runtime.tune import workload_signature
+    _fam = family_name_of(f_theta)
+    _sig = None if _fam is None else workload_signature(
+        _fam, eps, rule, theta_block=int(theta_block), mesh_shape=1,
+        scout=scout, refill_slots=int(refill_slots))
     exit_frac, suspend_frac = resolve_cadence(exit_frac, suspend_frac,
-                                              scout, refill_slots)
+                                              scout, refill_slots,
+                                              signature=_sig)
     theta2d, rep_theta = normalize_theta_batch(theta, theta_block)
     m = theta2d.shape[0]
     theta_block = validate_theta_block(

@@ -543,7 +543,8 @@ class StreamEngine:
                  on_shed=None,
                  spillover: bool = False,
                  spillover_limit: int = 4,
-                 slo_config=None):
+                 slo_config=None,
+                 adapt: bool = False):
         from ppls_tpu.models.integrands import get_family, get_family_ds
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -573,8 +574,25 @@ class StreamEngine:
         self._scout = bool(scout)
         self._double_buffer = bool(double_buffer)
         from ppls_tpu.parallel.walker import resolve_cadence
+        from ppls_tpu.runtime.tune import (last_resolution,
+                                           workload_signature)
+        if engine == "walker-dd":
+            _sig_mesh = (mesh.devices.size if mesh is not None
+                         else int(n_devices) if n_devices
+                         else len(jax.devices()))
+        else:
+            _sig_mesh = 1
         exit_frac, suspend_frac = resolve_cadence(
-            exit_frac, suspend_frac, self._scout, refill_slots)
+            exit_frac, suspend_frac, self._scout, refill_slots,
+            signature=workload_signature(
+                family, eps, Rule(rule),
+                theta_block=int(theta_block), mesh_shape=_sig_mesh,
+                scout=self._scout, refill_slots=int(refill_slots)))
+        # round 20: remember which tier resolved the cadence (exact /
+        # nearest table entry, hand default, or explicit caller
+        # values) — published as a registry gauge below so a silent
+        # fallback is visible on /metrics
+        self._cadence_resolution = last_resolution()
         # theta_block composes with f64_rounds (the pure-f64 streaming
         # mode runs the union-refinement bag twin); scouting is the
         # only mode conflict, checked above
@@ -659,6 +677,15 @@ class StreamEngine:
                 f"rolling p{int(q * 100)} retire latency ({unit}; "
                 f"bucket-edge quantile)")
             for q in (0.5, 0.99) for unit in ("phases", "seconds")}
+        # round 20: the cadence resolution tier as a labeled gauge —
+        # the tuning table falling back to the hand tier must be
+        # VISIBLE, not silent (tentpole layer 2 contract)
+        self._g_tuning = tel.registry.gauge(
+            "ppls_tuning_resolution",
+            "cadence resolution tier for this engine (1 = the tier "
+            "that resolved)", ("tier",))
+        self._g_tuning.labels(
+            tier=self._cadence_resolution["tier"]).set(1.0)
 
         # round 16: admission control + load shedding + deadlines.
         # queue_limit bounds the PENDING queue (None = the historical
@@ -733,6 +760,38 @@ class StreamEngine:
             "ppls_stream_spillover_total",
             "requests completed on the CPU spillover backend "
             "instead of being shed")
+        # round 20 (tentpole layer 3): ONLINE adaptation of the
+        # host-side per-phase policy knobs — the admission budget
+        # (starts conservative at half the compiled admit window,
+        # opens toward the window under sustained backlog + underfed
+        # lanes, decays when the queue drains) and the spillover batch
+        # limit (grows under spill backlog, decays when it clears).
+        # Both adjust within declared safe bands with hysteresis and
+        # one-step-per-phase clamps (runtime.tune.OnlineAdapter), from
+        # the phase-stats row the boundary already fetched — zero new
+        # device fetches, and never past the compiled admit window
+        # (no recompile can result). The adapter state rides every
+        # snapshot so kill-and-resume replays the same trajectory.
+        self._adapt = None
+        self._g_adapt = {}
+        if adapt:
+            from ppls_tpu.runtime.tune import OnlineAdapter
+            defaults = {
+                "admit_budget": max(1, self._admit_window // 2),
+                "spillover_limit": self.spillover_limit,
+            }
+            bands = {
+                "admit_budget": (1, self._admit_window),
+                "spillover_limit": (1, max(1, self._spill_cap // 2)),
+            }
+            self._adapt = OnlineAdapter(defaults, bands)
+            self._g_adapt = {
+                k: tel.stream_gauge(
+                    f"adapt_{k}",
+                    f"online-adapted value of the {k} knob")
+                for k in sorted(defaults)}
+            for k, g in self._g_adapt.items():
+                g.set(float(self._adapt.values[k]))
         # round 16: a JSON-serializable scratch dict for the DRIVER'S
         # resume bookkeeping, carried by every snapshot. The serve CLI
         # stores its batch-list cursor here — rids alone cannot serve
@@ -837,6 +896,11 @@ class StreamEngine:
             ident["reduced"] = True
         if self._theta_block > 1:
             ident["theta_block"] = int(self._theta_block)
+        # round 20: online adaptation changes the admission/spillover
+        # schedule — a snapshot taken with it armed must not resume
+        # onto an engine without it (and vice versa)
+        if self._adapt is not None:
+            ident["adapt"] = True
         return ident
 
     # ------------------------------------------------------------------
@@ -1149,6 +1213,12 @@ class StreamEngine:
             cap *= self._mesh.devices.size      # per-chip capacity
         room = cap - self._count
         budget = max(0, min(len(self._free), self._admit_window, room))
+        if self._adapt is not None:
+            # round 20: the online admission budget NARROWS the
+            # compiled admit window within its safe band (the window
+            # stays in the min above — the seed-array width is a
+            # compile static the adapter must never exceed)
+            budget = min(budget, self._adapt.values["admit_budget"])
         if not budget or not self._pending:
             return []
         chosen: List[StreamRequest] = []
@@ -1551,7 +1621,9 @@ class StreamEngine:
             return []
         out = []
         n = 0
-        while self._spill_queue and n < self.spillover_limit:
+        limit = (self.spillover_limit if self._adapt is None
+                 else self._adapt.values["spillover_limit"])
+        while self._spill_queue and n < limit:
             req = self._spill_queue.pop(0)
             failed = False
             areas = None
@@ -1589,6 +1661,47 @@ class StreamEngine:
             n += 1
         return out
 
+    def _maybe_adapt(self, vals: Optional[dict]) -> None:
+        """Round 20 online adaptation at the phase boundary: derive
+        per-knob pressures from the stats row this boundary already
+        fetched (``vals``; None on idle phases) plus host queue
+        depths, fold them through the adapter (hysteresis + one-step
+        clamps + safe bands live there), emit one ``knob_adapt``
+        timeline event per applied change, refresh the gauges. Pure
+        host arithmetic — zero new device fetches — and every input
+        is a deterministic function of the schedule, so a resumed run
+        replays the identical trajectory from the snapshot state."""
+        if self._adapt is None:
+            return
+        from ppls_tpu.runtime.tune import ADAPT_WASTE_FRAC
+        a = self._adapt
+        pressures = {}
+        pending = len(self._pending)
+        lazy = 0.0
+        if vals is not None:
+            denom = max(1, int(vals.get("wsteps", 0)) * self.lanes)
+            lazy = (int(vals.get("drain_tail", 0))
+                    + int(vals.get("masked_dead", 0))) / denom
+        if pending > 0 and (vals is None
+                            or lazy >= ADAPT_WASTE_FRAC):
+            # backlog + underfed lanes (drain_tail/masked_dead share
+            # of the phase's lane-steps): open the admission budget
+            pressures["admit_budget"] = 1
+        elif pending == 0 and a.values["admit_budget"] \
+                > a.defaults["admit_budget"]:
+            pressures["admit_budget"] = -1
+        backlog = len(self._spill_queue)
+        if backlog > a.values["spillover_limit"]:
+            pressures["spillover_limit"] = 1
+        elif backlog == 0 and a.values["spillover_limit"] \
+                > a.defaults["spillover_limit"]:
+            pressures["spillover_limit"] = -1
+        for ch in a.observe(pressures):
+            self.telemetry.event("knob_adapt", phase=self.phase,
+                                 **ch)
+        for k, g in self._g_adapt.items():
+            g.set(float(a.values[k]))
+
     def step(self) -> List[CompletedRequest]:
         """One phase: admit -> cycle -> retire. Returns the requests
         retired this phase (empty when idle)."""
@@ -1615,6 +1728,11 @@ class StreamEngine:
             # arrival schedules with gaps make progress
             spilled = self._run_spillover_phase()
             self.completed.extend(spilled)
+            # round 20: idle phases still adapt (a drained-tail
+            # spillover backlog is exactly the pressure the spillover
+            # knob watches) — with no stats row, only the queue-depth
+            # pressures apply
+            self._maybe_adapt(None)
             self.phase += 1
             self._publish_gauges()
             if self._slo is not None:
@@ -1762,6 +1880,9 @@ class StreamEngine:
         self._free.sort()
         retired.extend(self._run_spillover_phase())
         self.completed.extend(retired)
+        # round 20: fold this phase's already-fetched stats row into
+        # the online adapter (the values take effect NEXT phase)
+        self._maybe_adapt(vals)
         self.phase += 1
         self._publish_gauges(step_wall_s=time.perf_counter() - t_step0)
         if self._slo is not None:
@@ -1983,6 +2104,11 @@ class StreamEngine:
                             for k, v in self._token_waits.items()},
             "client_state": dict(self.client_state),
         }
+        if self._adapt is not None:
+            # round 20: the adapted knob values + pressure streaks
+            # ride the snapshot — the resumed boundary continues the
+            # identical adaptation trajectory mid-hysteresis
+            totals["adapt"] = self._adapt.state()
         if self._theta_block > 1 and self._fill is not None:
             totals["theta_table"] = self._theta_table.tolist()
         totals.update(extra)
@@ -2135,6 +2261,19 @@ class StreamEngine:
         eng._token_waits = {int(k): int(v) for k, v in
                             totals.get("token_waits", {}).items()}
         eng.client_state = dict(totals.get("client_state", {}))
+        adapt_state = totals.get("adapt")
+        if adapt_state is not None:
+            if eng._adapt is None:
+                # unreachable through the identity check (the adapt
+                # flag is identity), but a hand-edited snapshot must
+                # still fail loudly, not silently replay un-adapted
+                raise ValueError(
+                    "snapshot carries online-adaptation state but "
+                    "adapt is not armed on this resume; pass "
+                    "adapt=True")
+            eng._adapt.restore(adapt_state)
+            for k, g in eng._g_adapt.items():
+                g.set(float(eng._adapt.values[k]))
         for slot_s, d in totals["resident"].items():
             slot = int(slot_s)
             req = _req_in(d)

@@ -1,0 +1,731 @@
+"""Closed-loop autotuning (round 20): the attribution observatory
+drives the performance knobs.
+
+The reference farmer (aquadPartA.c) has ONE implicit tuning decision —
+LIFO bag order — and wins load balance for free. Our engines instead
+carry ~8 hand-tuned statics (refill cadence, exit/suspend thresholds,
+double-buffer swap, theta_block, breed target, reshard window,
+spillover limit) whose values were picked by hand in rounds 5-13 and
+frozen into ``walker.resolve_cadence``. Rounds 5-6 built exact
+device-counted lane-waste attribution precisely so these knobs could
+be machine-driven; this module closes the loop in three layers:
+
+1. **Offline search** (:func:`tune_workload`, driven by ``bench.py
+   tune``): a staged coordinate-descent sweep seeded from the hand
+   defaults. The five-bucket waste attribution of the best
+   configuration so far picks the NEXT knob to move via
+   :data:`BUCKET_KNOB_MAP` — the same dominant-bucket -> knob map
+   ``tools/analyze_occupancy.py --attribution`` prints as its
+   recommendation (one definition, no drift). The per-trial
+   compile-once guard is deliberately relaxed (every distinct static
+   combination compiles fresh) and the recompiles are counted into
+   the entry's provenance. Results land in a committed
+   ``tools/tuning_table.json`` keyed by workload signature + device
+   kind.
+
+2. **Table-driven resolution** (:func:`resolve_cadence_tuned`,
+   consumed by ``walker.resolve_cadence`` — the one surface walker,
+   dd, and stream already share): exact-signature match -> nearest
+   signature -> hand-tuned default, with the resolution tier recorded
+   (:func:`last_resolution`) so a silent fallback is visible on the
+   bench record and the registry gauge.
+
+3. **Online adaptation** (:class:`OnlineAdapter`, driven by
+   ``StreamEngine`` at phase boundaries): the knobs that are host-side
+   per-phase policy (admission budget, spillover batch limit) adjust
+   within declared safe bands using the phase-stats row the boundary
+   already fetched — zero extra device fetches, hysteresis + one-step-
+   per-phase clamps so the trajectory is deterministic given the
+   schedule, and the adapter state rides the snapshot so kill-and-
+   resume replays bit-identically.
+
+This module stays importable WITHOUT jax (like ``obs``): the
+resolution half is pure host JSON, and the sweep half lazy-imports the
+engines. ``analyze_occupancy --from-events`` depends on that.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# the shared dominant-bucket -> knob map
+# ---------------------------------------------------------------------------
+
+# THE map (tentpole contract): which knob the tuner moves when a waste
+# bucket dominates, and what the attribution printers recommend. One
+# definition — the sweep's coordinate picker and analyze_occupancy's
+# "recommended knob" line both read it, so they cannot drift.
+#   refill_stall  -> the bank deal: more slots / double-buffer swap
+#   masked_dead   -> the exit/suspend cadence thresholds
+#   theta_overwalk-> the theta batch width
+#   drain_tail    -> the breed target (roots_per_lane is its lever in
+#                    walker_sizing) / the dd reshard window
+BUCKET_KNOB_MAP: Dict[str, Tuple[str, ...]] = {
+    "refill_stall": ("refill_slots", "double_buffer"),
+    "masked_dead": ("exit_frac", "suspend_frac"),
+    "theta_overwalk": ("theta_block",),
+    "drain_tail": ("roots_per_lane", "reshard_window"),
+}
+
+# human hint per bucket, printed next to the knob names
+BUCKET_KNOB_HINTS: Dict[str, str] = {
+    "refill_stall": "raise the in-kernel bank deal (refill_slots) or "
+                    "enable the double-buffer swap cadence",
+    "masked_dead": "tighten the exit/suspend cadence thresholds",
+    "theta_overwalk": "shrink theta_block (union-refinement overwalk "
+                      "outruns the batch win)",
+    "drain_tail": "raise the breed target (roots_per_lane sets it via "
+                  "walker_sizing) or shrink the dd reshard window",
+}
+
+
+def recommend_knob(attribution: Optional[dict]) -> Optional[dict]:
+    """The tuner's recommendation for an attribution record built by
+    ``obs.telemetry.build_attribution``: which knob(s) to move for the
+    dominant waste bucket, from :data:`BUCKET_KNOB_MAP`. Returns None
+    when there is nothing to attack (fully eval-active)."""
+    if not isinstance(attribution, dict):
+        return None
+    dom = attribution.get("dominant_waste")
+    if dom is None or dom == "eval_active" or dom not in BUCKET_KNOB_MAP:
+        return None
+    return {
+        "bucket": dom,
+        "knobs": list(BUCKET_KNOB_MAP[dom]),
+        "hint": BUCKET_KNOB_HINTS[dom],
+    }
+
+
+# ---------------------------------------------------------------------------
+# workload signatures + the committed table
+# ---------------------------------------------------------------------------
+
+TABLE_SCHEMA = "ppls-tuning-table-v1"
+ENTRY_SCHEMA = "ppls-tuning-entry-v1"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_TABLE_PATH = os.path.join(_REPO, "tools", "tuning_table.json")
+
+# cadence safety bands: a committed table is DATA, and data can be
+# wrong — values outside these bands (or a suspend >= exit pair) are
+# discarded at resolution time and the hand default used instead, so a
+# corrupt table can degrade to round-19 behavior but never wedge an
+# engine (the legacy-mode hazard resolve_cadence documents).
+CADENCE_SAFE_BANDS = {
+    "exit_frac": (0.50, 0.99),
+    "suspend_frac": (0.30, 0.95),
+}
+
+
+def hand_cadence_defaults(scout: bool, refill_slots: int
+                          ) -> Tuple[float, float]:
+    """The committed hand-tuned fallback tier: the round-5/round-12
+    ``resolve_cadence`` values (the ONE definition —
+    ``walker.resolve_cadence`` delegates here)."""
+    tight = bool(scout) and int(refill_slots) > 0
+    return (0.95 if tight else 0.80), (0.65 if tight else 0.50)
+
+
+def eps_band(eps: float) -> int:
+    """Decimal-exponent band of the tolerance: 1e-7 -> -7."""
+    return int(round(math.log10(float(eps))))
+
+
+def theta_band(theta_block: int) -> int:
+    """theta_block band edge (1 / 32 / 256 / 4096): cadence economics
+    shift with the union-refinement group width, not its exact value."""
+    t = int(theta_block)
+    for edge in (1, 32, 256):
+        if t <= edge:
+            return edge
+    return 4096
+
+
+def mode_string(scout: bool, refill_slots: int) -> str:
+    """The mode fingerprint: scouting and in-kernel refill change the
+    refill-cadence ECONOMICS (resolve_cadence docstring), so a tuned
+    entry must never cross modes — 'scout-ikr' values applied to the
+    legacy XLA-boundary engine can stop the walk phase engaging."""
+    return ("scout" if scout else "f64") + \
+        ("-ikr" if int(refill_slots) > 0 else "-xla")
+
+
+def workload_signature(family: str, eps: float, rule,
+                       theta_block: int = 1, mesh_shape: int = 1, *,
+                       scout: bool = False,
+                       refill_slots: int = 0) -> dict:
+    """The tuning-table key material: family, eps band, rule,
+    theta_block band, mesh shape, plus the mode fingerprint."""
+    rule_name = getattr(rule, "name", None) or str(rule)
+    return {
+        "family": str(family),
+        "eps_band": eps_band(eps),
+        "rule": str(rule_name).lower(),
+        "theta_band": theta_band(theta_block),
+        "mesh_shape": int(mesh_shape),
+        "mode": mode_string(scout, refill_slots),
+    }
+
+
+_SIG_FIELDS = ("family", "eps_band", "rule", "theta_band",
+               "mesh_shape", "mode")
+
+
+def signature_key(sig: dict, device: str) -> str:
+    """Canonical string key of one (signature, device_kind) cell."""
+    parts = [f"{k}={sig[k]}" for k in _SIG_FIELDS]
+    parts.append(f"device={device}")
+    return "|".join(parts)
+
+
+def device_kind() -> str:
+    """Coarse accelerator fingerprint ('cpu', 'tpu-v5e', ...). Tuned
+    constants are device-generation-specific — the standing TPU
+    blocker means every committed cpu entry re-tunes under real
+    Mosaic lowering, by machinery instead of by hand."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        kind = getattr(d, "device_kind", "") or jax.default_backend()
+        return str(kind).lower().replace(" ", "-")
+    except Exception:                                  # pragma: no cover
+        return "unknown"
+
+
+_TABLE_CACHE: Dict[str, tuple] = {}
+
+
+def tuning_table_path() -> Optional[str]:
+    """The table location: ``PPLS_TUNING_TABLE`` overrides (a path, or
+    0/off to disable table-driven resolution entirely), else the
+    committed ``tools/tuning_table.json``."""
+    env = os.environ.get("PPLS_TUNING_TABLE")
+    if env is not None:
+        if env.strip().lower() in ("", "0", "off", "none"):
+            return None
+        return env
+    return DEFAULT_TABLE_PATH
+
+
+def load_tuning_table(path: Optional[str] = None) -> Optional[dict]:
+    """Load (and mtime-cache) the tuning table; None when disabled,
+    missing, or malformed — a broken table must degrade to hand
+    defaults, never crash an engine constructor."""
+    if path is None:
+        path = tuning_table_path()
+    if path is None:
+        return None
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    cached = _TABLE_CACHE.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    try:
+        with open(path, encoding="utf-8") as fh:
+            table = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(table, dict) \
+            or table.get("schema") != TABLE_SCHEMA \
+            or not isinstance(table.get("entries"), dict):
+        return None
+    _TABLE_CACHE[path] = (mtime, table)
+    return table
+
+
+def clear_table_cache() -> None:
+    """Test hook: drop the mtime cache (monkeypatched paths)."""
+    _TABLE_CACHE.clear()
+
+
+def nearest_entry(entries: Dict[str, dict], sig: dict,
+                  device: str) -> Optional[Tuple[str, dict]]:
+    """The NEAREST-signature tier. Hard constraints first — device
+    kind, rule, mode fingerprint, mesh shape, and theta band must
+    match exactly (tuned values never cross an economics boundary) —
+    then rank the survivors: family match (weight 4) beats eps-band
+    proximity (weight 3 - |band distance|, floored at 0); candidates
+    scoring 0 (nothing in common) fall through to the hand tier.
+    Ties break on smaller eps distance, then lexicographic key, so
+    the ordering is total and testable."""
+    best: Optional[Tuple[int, int, str, dict]] = None
+    for key in sorted(entries):
+        ent = entries[key]
+        s = ent.get("signature")
+        if not isinstance(s, dict):
+            continue
+        if ent.get("device_kind") != device:
+            continue
+        if (s.get("rule") != sig["rule"]
+                or s.get("mode") != sig["mode"]
+                or s.get("mesh_shape") != sig["mesh_shape"]
+                or s.get("theta_band") != sig["theta_band"]):
+            continue
+        try:
+            d = abs(int(s.get("eps_band")) - int(sig["eps_band"]))
+        except (TypeError, ValueError):
+            continue
+        score = (4 if s.get("family") == sig["family"] else 0) \
+            + max(0, 3 - d)
+        if score <= 0:
+            continue
+        cand = (score, -d, key, ent)
+        if best is None or (cand[0], cand[1]) > (best[0], best[1]):
+            best = cand
+        # equal (score, distance): the earlier (lexicographically
+        # smaller) key already holds — sorted() iteration order
+    if best is None:
+        return None
+    return best[2], best[3]
+
+
+def resolve_knobs(sig: Optional[dict], names: Tuple[str, ...],
+                  path: Optional[str] = None
+                  ) -> Tuple[Dict[str, object], str, Optional[str]]:
+    """Three-tier lookup for ``names``: (values, tier, entry_key) with
+    tier in {'exact', 'nearest', 'default'}. 'default' returns no
+    values — the caller owns the hand fallback."""
+    if sig is None:
+        return {}, "default", None
+    table = load_tuning_table(path)
+    if table is None:
+        return {}, "default", None
+    entries = table["entries"]
+    device = device_kind()
+    key = signature_key(sig, device)
+    ent = entries.get(key)
+    tier = "exact"
+    if not isinstance(ent, dict):
+        near = nearest_entry(entries, sig, device)
+        if near is None:
+            return {}, "default", None
+        key, ent = near
+        tier = "nearest"
+    knobs = ent.get("knobs")
+    if not isinstance(knobs, dict):
+        return {}, "default", None
+    vals = {k: knobs[k] for k in names if k in knobs}
+    if not vals:
+        return {}, "default", None
+    return vals, tier, key
+
+
+def _cadence_pair_sane(exit_frac, suspend_frac) -> bool:
+    for name, v in (("exit_frac", exit_frac),
+                    ("suspend_frac", suspend_frac)):
+        lo, hi = CADENCE_SAFE_BANDS[name]
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not math.isfinite(v) or not (lo <= v <= hi):
+            return False
+    return suspend_frac < exit_frac
+
+
+_LAST_RESOLUTION = {"tier": "default", "key": None,
+                    "exit_frac": None, "suspend_frac": None,
+                    "signature": None}
+
+
+def last_resolution() -> dict:
+    """The most recent cadence resolution (tier + entry key + values):
+    the bench record and the engines' registry gauge read it so a
+    silent fallback to the hand tier stays visible."""
+    return dict(_LAST_RESOLUTION)
+
+
+def resolve_cadence_tuned(exit_frac: Optional[float],
+                          suspend_frac: Optional[float],
+                          scout: bool, refill_slots: int = 0, *,
+                          signature: Optional[dict] = None,
+                          path: Optional[str] = None
+                          ) -> Tuple[float, float, str]:
+    """The ONE cadence-resolution surface (walker, dd, and stream all
+    reach it through ``walker.resolve_cadence``): explicit values win
+    unconditionally ('explicit' tier); otherwise the tuning table
+    (exact -> nearest signature), sanity-banded, with the hand-tuned
+    round-12 defaults as the committed fallback tier. Returns
+    ``(exit_frac, suspend_frac, tier)`` and records the resolution for
+    :func:`last_resolution`."""
+    de, ds = hand_cadence_defaults(scout, refill_slots)
+    tier, key = "explicit", None
+    if exit_frac is None or suspend_frac is None:
+        vals, tier, key = resolve_knobs(
+            signature, ("exit_frac", "suspend_frac"), path)
+        te, ts = vals.get("exit_frac"), vals.get("suspend_frac")
+        if tier != "default" and not _cadence_pair_sane(te, ts):
+            # out-of-band table data: visible degrade to the hand tier
+            te = ts = None
+            tier, key = "default", None
+        if exit_frac is None:
+            exit_frac = te if te is not None else de
+        if suspend_frac is None:
+            suspend_frac = ts if ts is not None else ds
+        if not _cadence_pair_sane(exit_frac, suspend_frac) \
+                and tier in ("exact", "nearest"):
+            # a sane table pair can still clash with ONE explicit
+            # caller value — the pair contract (suspend < exit) wins
+            exit_frac = de if te is not None else exit_frac
+            suspend_frac = ds if ts is not None else suspend_frac
+            tier, key = "default", None
+    exit_frac, suspend_frac = float(exit_frac), float(suspend_frac)
+    _LAST_RESOLUTION.update(
+        tier=tier, key=key, exit_frac=exit_frac,
+        suspend_frac=suspend_frac, signature=signature)
+    return exit_frac, suspend_frac, tier
+
+
+# ---------------------------------------------------------------------------
+# offline search: staged coordinate descent on the quick proxies
+# ---------------------------------------------------------------------------
+
+# the quick sweep's trial context: flagship mode (scout + in-kernel
+# refill + double-buffer) at the interpret-proxy sizing — small enough
+# that a budgeted CI sweep finishes, big enough that the attribution
+# buckets are populated. roots_per_lane is deliberately above the
+# bench-quick sizing so the breed-target lever has room to move.
+TUNE_SIZING = dict(capacity=1 << 16, lanes=256, roots_per_lane=8,
+                   refill_slots=4, seg_iters=32, min_active_frac=0.05,
+                   scout_dtype="f32", double_buffer=True)
+TUNE_M = 8
+
+# the canonical tune workloads (family, eps, bounds): tolerances
+# chosen so the walk phase genuinely engages at the quick sizing
+# (sin_scaled converges in pure breed rounds above ~1e-8 — nothing to
+# tune there)
+TUNE_WORKLOADS = (
+    ("sin_recip_scaled", 1e-7, (1e-2, 1.0)),
+    ("sin_scaled", 1e-9, (0.0, 1.0)),
+    ("cosh4_scaled", 1e-8, (0.0, 1.0)),
+)
+
+# value domains of the sweepable knobs (theta_block and the dd
+# reshard_window appear in BUCKET_KNOB_MAP for the recommendation
+# surface but are not swept by the quick trial context — theta band 1
+# workloads and the single-chip mesh cannot measure them).
+KNOB_DOMAINS: Dict[str, Tuple] = {
+    "exit_frac": (0.80, 0.90, 0.95, 0.98),
+    "suspend_frac": (0.50, 0.65, 0.80),
+    "refill_slots": (2, 4, 8),
+    "double_buffer": (True, False),
+    "roots_per_lane": (4, 8, 12),
+}
+
+# stable fallback order once the dominant bucket's own knobs are
+# exhausted: the sweep keeps spending budget instead of stalling
+_SWEEP_ORDER = ("exit_frac", "suspend_frac", "refill_slots",
+                "double_buffer", "roots_per_lane")
+
+
+def valid_knob_combo(knobs: dict) -> bool:
+    """The engines' own static-combination constraints (walker
+    validates these loudly; the sweep must not burn budget on
+    combinations that cannot construct)."""
+    if knobs["refill_slots"] > knobs["roots_per_lane"]:
+        return False
+    if knobs["double_buffer"] and (
+            knobs["refill_slots"] < 2 or knobs["refill_slots"] % 2):
+        return False
+    if knobs["suspend_frac"] >= knobs["exit_frac"]:
+        return False
+    return True
+
+
+def pareto_improves(cand: dict, base: dict) -> bool:
+    """'Beats the hand default' contract (one definition — the sweep's
+    accept rule and bench_history's gate both use it): lane_efficiency
+    must not drop, kernel_steps must not grow, and at least one must
+    strictly improve. The reconciliation invariant must hold."""
+    if not cand.get("reconciles", False):
+        return False
+    ce, be = float(cand["lane_efficiency"]), float(base["lane_efficiency"])
+    cs, bs = int(cand["kernel_steps"]), int(base["kernel_steps"])
+    return ce >= be and cs <= bs and (ce > be or cs < bs)
+
+
+def measure_trial(family: str, eps: float, bounds, sizing: dict,
+                  knobs: dict) -> dict:
+    """One sweep trial: run the walker with the candidate knob values
+    (cadence passed EXPLICITLY so the loaded table cannot contaminate
+    the sweep) and return the device-counted quick proxies. The
+    compile-once guard is deliberately relaxed here — each distinct
+    static combination compiles fresh — and the pjit cache growth is
+    returned as the trial's recompile count."""
+    import numpy as np
+
+    from ppls_tpu.models.integrands import get_family, get_family_ds
+    from ppls_tpu.parallel.walker import (_run_cycles,
+                                          integrate_family_walker)
+
+    kw = dict(sizing)
+    kw.pop("refill_slots", None)
+    kw.pop("double_buffer", None)
+    kw.pop("roots_per_lane", None)
+    theta = 1.0 + np.arange(TUNE_M) / float(TUNE_M)
+    cache0 = int(_run_cycles._cache_size())
+    r = integrate_family_walker(
+        get_family(family), get_family_ds(family), theta, bounds,
+        float(eps),
+        exit_frac=float(knobs["exit_frac"]),
+        suspend_frac=float(knobs["suspend_frac"]),
+        refill_slots=int(knobs["refill_slots"]),
+        double_buffer=bool(knobs["double_buffer"]),
+        roots_per_lane=int(knobs["roots_per_lane"]),
+        **kw)
+    attr = r.attribution() or {}
+    return {
+        "tasks": int(r.metrics.tasks),
+        "cycles": int(r.cycles),
+        "kernel_steps": int(r.kernel_steps),
+        "lane_efficiency": round(float(r.lane_efficiency), 6),
+        "dominant_waste": attr.get("dominant_waste"),
+        "reconciles": bool(attr.get("reconciles", False)),
+        "recompiles": int(_run_cycles._cache_size()) - cache0,
+    }
+
+
+def _knob_key(knobs: dict) -> tuple:
+    return tuple(sorted((k, knobs[k]) for k in knobs))
+
+
+def _next_candidate(best_knobs: dict, best_proxies: dict,
+                    tried: set) -> Optional[Tuple[str, object]]:
+    """The staged coordinate picker: the dominant waste bucket of the
+    best configuration so far names the next knob through
+    :data:`BUCKET_KNOB_MAP`; its untried domain values go first, then
+    the remaining sweepable knobs in stable order."""
+    dom = best_proxies.get("dominant_waste")
+    order: List[str] = []
+    for k in BUCKET_KNOB_MAP.get(dom, ()):
+        if k in KNOB_DOMAINS:
+            order.append(k)
+    for k in _SWEEP_ORDER:
+        if k not in order:
+            order.append(k)
+    for knob in order:
+        for v in KNOB_DOMAINS[knob]:
+            cand = dict(best_knobs)
+            cand[knob] = v
+            if not valid_knob_combo(cand):
+                continue
+            kk = _knob_key(cand)
+            if kk in tried:
+                continue
+            return knob, v
+    return None
+
+
+def tune_workload(family: str, eps: float, bounds, *,
+                  rule: str = "trapezoid",
+                  sizing: Optional[dict] = None,
+                  budget: int = 8, seed: int = 0,
+                  measure: Optional[Callable[[dict], dict]] = None,
+                  device: Optional[str] = None) -> dict:
+    """The staged sweep for one workload signature: coordinate descent
+    seeded from the hand defaults, attribution-picked knob order,
+    Pareto acceptance (:func:`pareto_improves`), ``budget`` trials
+    including the baseline. Deterministic given (seed, signature,
+    measurement): no randomness is consumed, the seed is provenance —
+    byte-identical re-runs are a test contract.
+
+    ``measure`` injects the trial runner (tests stub it); the default
+    is :func:`measure_trial` on the real walker."""
+    sizing = dict(TUNE_SIZING if sizing is None else sizing)
+    scout = sizing.get("scout_dtype") == "f32"
+    de, ds = hand_cadence_defaults(scout, sizing.get("refill_slots", 0))
+    base_knobs = {
+        "exit_frac": de, "suspend_frac": ds,
+        "refill_slots": int(sizing.get("refill_slots", 4)),
+        "double_buffer": bool(sizing.get("double_buffer", True)),
+        "roots_per_lane": int(sizing.get("roots_per_lane", 8)),
+    }
+    if measure is None:
+        def measure(knobs):
+            return measure_trial(family, eps, bounds, sizing, knobs)
+    sig = workload_signature(
+        family, eps, rule, theta_block=1, mesh_shape=1, scout=scout,
+        refill_slots=base_knobs["refill_slots"])
+    dev = device if device is not None else device_kind()
+
+    base_p = measure(base_knobs)
+    trials = [{"knobs": dict(base_knobs), "proxies": base_p,
+               "accepted": True, "moved": None}]
+    tried = {_knob_key(base_knobs)}
+    best_knobs, best_p = dict(base_knobs), base_p
+    recompiles = int(base_p.get("recompiles", 0))
+    while len(trials) < max(1, int(budget)):
+        nxt = _next_candidate(best_knobs, best_p, tried)
+        if nxt is None:
+            break
+        knob, value = nxt
+        cand = dict(best_knobs)
+        cand[knob] = value
+        tried.add(_knob_key(cand))
+        p = measure(cand)
+        recompiles += int(p.get("recompiles", 0))
+        accepted = pareto_improves(p, best_p)
+        trials.append({"knobs": cand, "proxies": p,
+                       "accepted": accepted,
+                       "moved": {"knob": knob, "value": value,
+                                 "bucket": best_p.get(
+                                     "dominant_waste")}})
+        if accepted:
+            best_knobs, best_p = cand, p
+
+    def _prox(p):
+        return {"tasks": int(p["tasks"]),
+                "kernel_steps": int(p["kernel_steps"]),
+                "lane_efficiency": float(p["lane_efficiency"])}
+
+    entry = {
+        "schema": ENTRY_SCHEMA,
+        "signature": sig,
+        "device_kind": dev,
+        "knobs": {k: best_knobs[k] for k in sorted(best_knobs)},
+        "baseline": _prox(base_p),
+        "tuned": _prox(best_p),
+        "provenance": {
+            "trials": len(trials),
+            "recompiles": recompiles,
+            "reconciles": bool(best_p.get("reconciles", False)
+                               and base_p.get("reconciles", False)),
+            "seed": int(seed),
+            "budget": int(budget),
+            "improved": pareto_improves(best_p, base_p),
+            "eps": float(eps),
+            "bounds": [float(bounds[0]), float(bounds[1])],
+            "sizing": {k: sizing[k] for k in sorted(sizing)},
+            "path": [
+                {"moved": t["moved"], "accepted": t["accepted"],
+                 "kernel_steps": int(t["proxies"]["kernel_steps"]),
+                 "lane_efficiency": float(
+                     t["proxies"]["lane_efficiency"])}
+                for t in trials[1:]],
+        },
+    }
+    return entry
+
+
+def entry_key(entry: dict) -> str:
+    return signature_key(entry["signature"], entry["device_kind"])
+
+
+def update_table(table: Optional[dict], entry: dict) -> dict:
+    """Insert/replace one entry; creates the table envelope when
+    needed. Returns the (mutated) table."""
+    if not isinstance(table, dict) or table.get("schema") != TABLE_SCHEMA:
+        table = {"schema": TABLE_SCHEMA, "entries": {}}
+    table.setdefault("entries", {})[entry_key(entry)] = entry
+    return table
+
+
+def write_table(path: str, table: dict) -> None:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(table, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    _TABLE_CACHE.pop(path, None)
+
+
+# ---------------------------------------------------------------------------
+# online adaptation (stream phase boundaries)
+# ---------------------------------------------------------------------------
+
+# hysteresis: a knob moves only after this many CONSECUTIVE phases of
+# same-direction pressure, and by at most one step per phase — the
+# trajectory is a pure function of the phase-row/queue schedule, so a
+# resumed run replays it bit-identically from the snapshot state.
+ADAPT_HYSTERESIS = 2
+
+# drain_tail + masked_dead lane-step share above which a backlogged
+# phase reads as "lanes underfed" (admission pressure up)
+ADAPT_WASTE_FRAC = 0.10
+
+
+def online_safe_bands(defaults: Dict[str, int]) -> Dict[str, tuple]:
+    """Declared safe bands for the online knobs, relative to the
+    engine's configured values: the admission budget may trickle down
+    to 1 but never exceed the COMPILED admit window (the seed-array
+    width is a static — exceeding it would recompile); the spillover
+    batch limit stays within the spill queue's 8x sizing."""
+    bands = {}
+    if "admit_budget" in defaults:
+        bands["admit_budget"] = (1, max(1, int(defaults["admit_budget"])))
+    if "spillover_limit" in defaults:
+        d = max(1, int(defaults["spillover_limit"]))
+        bands["spillover_limit"] = (1, 4 * d)
+    return bands
+
+
+class OnlineAdapter:
+    """Deterministic per-phase knob adapter (tentpole layer 3).
+
+    Pure host arithmetic over values the phase boundary already holds:
+    per-knob signed pressure streaks, :data:`ADAPT_HYSTERESIS` phases
+    of agreement before a move, one step per phase, hard-clamped to
+    the declared safe band. ``state()``/``restore()`` ride the stream
+    snapshot so kill-and-resume replays the identical trajectory."""
+
+    def __init__(self, defaults: Dict[str, int],
+                 bands: Optional[Dict[str, tuple]] = None):
+        self.defaults = {k: int(v) for k, v in defaults.items()}
+        self.bands = {k: (int(lo), int(hi)) for k, (lo, hi) in
+                      (bands if bands is not None
+                       else online_safe_bands(defaults)).items()}
+        for k, v in self.defaults.items():
+            lo, hi = self.bands[k]
+            if not lo <= v <= hi:
+                raise ValueError(
+                    f"online knob {k}: default {v} outside its safe "
+                    f"band [{lo}, {hi}]")
+        self.values = dict(self.defaults)
+        self.streaks = {k: 0 for k in self.defaults}
+
+    def observe(self, pressures: Dict[str, int]) -> List[dict]:
+        """Fold one phase's signed pressures (-1/0/+1 per knob) into
+        the streaks; returns the applied changes (possibly empty),
+        each ``{"knob", "from", "to"}``."""
+        changes = []
+        for k in sorted(self.values):
+            p = int(pressures.get(k, 0))
+            if p == 0:
+                self.streaks[k] = 0
+                continue
+            s = self.streaks[k]
+            s = s + p if s * p >= 0 else p   # direction flip resets
+            if abs(s) >= ADAPT_HYSTERESIS:
+                lo, hi = self.bands[k]
+                old = self.values[k]
+                new = min(hi, max(lo, old + (1 if s > 0 else -1)))
+                self.streaks[k] = 0
+                if new != old:
+                    self.values[k] = new
+                    changes.append({"knob": k, "from": old, "to": new})
+            else:
+                self.streaks[k] = s
+        return changes
+
+    def state(self) -> dict:
+        return {"values": dict(self.values),
+                "streaks": dict(self.streaks)}
+
+    def restore(self, state: dict) -> None:
+        vals = state.get("values", {})
+        streaks = state.get("streaks", {})
+        for k in self.values:
+            if k in vals:
+                lo, hi = self.bands[k]
+                v = int(vals[k])
+                if not lo <= v <= hi:
+                    raise ValueError(
+                        f"snapshot adapt state: {k}={v} outside the "
+                        f"declared safe band [{lo}, {hi}]")
+                self.values[k] = v
+            if k in streaks:
+                self.streaks[k] = int(streaks[k])

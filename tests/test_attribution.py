@@ -164,3 +164,11 @@ def test_analyze_occupancy_from_events_prints_attribution(tmp_path):
     assert "lane-waste attribution" in r.stdout
     assert "dominant waste bucket:" in r.stdout
     assert "-> OK" in r.stdout        # offline reconciliation holds
+    # round 20: the printer recommends the dominant bucket's knob from
+    # the SAME map the tuner sweeps (tune.BUCKET_KNOB_MAP) — one
+    # definition, asserted end to end through the CLI
+    from ppls_tpu.runtime.tune import BUCKET_KNOB_MAP
+    dom = r.stdout.split("dominant waste bucket:")[1].split()[0]
+    if dom in BUCKET_KNOB_MAP:
+        assert "recommended knob: " \
+            + ", ".join(BUCKET_KNOB_MAP[dom]) in r.stdout

@@ -10,6 +10,7 @@ from ppls_tpu.ops.reduction import (
     kahan_add,
     kahan_init,
     kahan_sum,
+    segment_sum_auto,
 )
 
 
@@ -75,6 +76,75 @@ def test_exact_segment_sum_beats_f32_matmul():
     oh = (fam[:, None] == np.arange(m)[None, :]).astype(np.float32)
     f32_err = np.abs(leaf.astype(np.float32) @ oh - ref).max()
     assert f32_err > 1e-12  # the naive path really is that bad
+
+
+def _dyadic_leaves(rng, n):
+    """Leaf values on a coarse dyadic grid: every partial sum is
+    exactly representable in f64, so any two EXACT lowerings of the
+    same segmented sum must agree to the bit."""
+    return (rng.integers(-(1 << 20), 1 << 20, n) * 2.0 ** -24)
+
+
+def test_segment_sum_auto_force_exact_routes_small_m():
+    """Round 20: force_exact sends the m == 1 and m <= 256 tiers
+    through the error-free digit-plane path instead of the plain XLA
+    reduce — segment_sum_auto becomes exact_segment_sum verbatim."""
+    rng = np.random.default_rng(11)
+    n = 1 << 10
+    for m in (1, 64, 256):
+        fam = rng.integers(0, m, n).astype(np.int32)
+        leaf = rng.uniform(-1, 1, n) * 10.0 ** rng.uniform(-9, -3, n)
+        forced = np.asarray(segment_sum_auto(
+            jnp.asarray(fam), jnp.asarray(leaf), m, n,
+            force_exact=True))
+        direct = np.asarray(exact_segment_sum(
+            jnp.asarray(fam), jnp.asarray(leaf), m, n))
+        assert np.array_equal(forced, direct), m
+
+
+def test_segment_sum_auto_force_exact_mesh_bit_equality():
+    """The tier-boundary regression force_exact exists for: the
+    sharded walker reduces m_local <= 256 per shard (mask tier) while
+    the single chip reduces m = 1024 (digit-plane tier), so the two
+    layouts can differ by ~1 ulp. With force_exact both layouts run
+    the exact lowering, and on exactly-representable sums a single
+    chip and a virtual 8-mesh agree TO THE BIT, shard by shard."""
+    rng = np.random.default_rng(23)
+    n, m, shards = 1 << 12, 1024, 8
+    m_local = m // shards
+    fam = rng.integers(0, m, n).astype(np.int32)
+    leaf = _dyadic_leaves(rng, n)
+    whole = np.asarray(segment_sum_auto(
+        jnp.asarray(fam), jnp.asarray(leaf), m, n, force_exact=True))
+    for d in range(shards):
+        pick = (fam // m_local) == d
+        lf, lv = fam[pick] % m_local, leaf[pick]
+        local = np.asarray(segment_sum_auto(
+            jnp.asarray(lf), jnp.asarray(lv), m_local, len(lv),
+            force_exact=True))
+        assert np.array_equal(local,
+                              whole[d * m_local:(d + 1) * m_local]), d
+    # and the forced path is still RIGHT, not merely consistent
+    assert np.array_equal(whole, _ground_truth(fam, leaf, m))
+
+
+def test_segment_sum_auto_env_knob(monkeypatch):
+    rng = np.random.default_rng(5)
+    n, m = 512, 128
+    fam = rng.integers(0, m, n).astype(np.int32)
+    leaf = rng.uniform(-1, 1, n) * 1e-6
+    exact = np.asarray(exact_segment_sum(
+        jnp.asarray(fam), jnp.asarray(leaf), m, n))
+    monkeypatch.setenv("PPLS_EXACT_SEGSUM", "1")
+    via_env = np.asarray(segment_sum_auto(
+        jnp.asarray(fam), jnp.asarray(leaf), m, n))
+    assert np.array_equal(via_env, exact)
+    # 0/off spellings keep the default tier routing
+    for off in ("0", "off", "false"):
+        monkeypatch.setenv("PPLS_EXACT_SEGSUM", off)
+        default = np.asarray(segment_sum_auto(
+            jnp.asarray(fam), jnp.asarray(leaf), m, n))
+        assert np.abs(default - exact).max() < 1e-18
 
 
 def test_kahan_accumulates_small_terms():

@@ -1,0 +1,436 @@
+"""Closed-loop autotuning (runtime/tune.py, round 20).
+
+Acceptance surface of the autotuning tentpole:
+
+* the dominant-bucket -> knob map is ONE definition: per-bucket
+  recommendation fixtures here, and the analyze_occupancy printer test
+  (test_attribution.py) asserts the same line reaches the CLI;
+* TABLE DETERMINISM: the same (seed, signature, measurements) yields a
+  byte-identical table entry — the committed table is reproducible;
+* nearest-signature resolution is a total, testable order: hard
+  constraints (device/rule/mode/mesh/theta band) are never crossed,
+  family match outranks eps proximity, ties break lexicographically;
+* the cadence resolution tiers (explicit > exact > nearest > hand
+  default) with loud degradation on insane table data;
+* online adaptation is deterministic and snapshot-safe: hysteresis +
+  one-step clamps at the unit level, and a killed-and-resumed adapting
+  stream replays BIT-IDENTICALLY (areas and adapter state);
+* compile-once holds OUTSIDE tune trials: a served engine with the
+  tuned table loaded pins ppls_recompiles_total at 0.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ppls_tpu.runtime import tune
+from ppls_tpu.runtime.tune import (BUCKET_KNOB_MAP, CADENCE_SAFE_BANDS,
+                                   OnlineAdapter, clear_table_cache,
+                                   hand_cadence_defaults, nearest_entry,
+                                   pareto_improves, recommend_knob,
+                                   resolve_cadence_tuned, signature_key,
+                                   tune_workload, update_table,
+                                   workload_signature, write_table)
+
+# ---------------------------------------------------------------------------
+# the shared bucket -> knob map (satellite 2's fixture half)
+# ---------------------------------------------------------------------------
+
+
+def _attr(dom):
+    return {"dominant_waste": dom, "lane_cycles": 1000,
+            "reconciles": True}
+
+
+@pytest.mark.parametrize("bucket,first_knob", [
+    ("refill_stall", "refill_slots"),
+    ("masked_dead", "exit_frac"),
+    ("theta_overwalk", "theta_block"),
+    ("drain_tail", "roots_per_lane"),
+])
+def test_recommend_knob_per_bucket(bucket, first_knob):
+    rec = recommend_knob(_attr(bucket))
+    assert rec is not None
+    assert rec["bucket"] == bucket
+    assert rec["knobs"] == list(BUCKET_KNOB_MAP[bucket])
+    assert rec["knobs"][0] == first_knob
+    assert rec["hint"]
+
+
+def test_recommend_knob_nothing_to_attack():
+    # fully eval-active (or missing attribution): no recommendation
+    assert recommend_knob(_attr("eval_active")) is None
+    assert recommend_knob(None) is None
+    assert recommend_knob({}) is None
+
+
+# ---------------------------------------------------------------------------
+# signatures + resolution tiers
+# ---------------------------------------------------------------------------
+
+SIG = workload_signature("sin_recip_scaled", 1e-7, "trapezoid",
+                         scout=True, refill_slots=4)
+
+
+def test_signature_key_shape():
+    assert SIG == {"family": "sin_recip_scaled", "eps_band": -7,
+                   "rule": "trapezoid", "theta_band": 1,
+                   "mesh_shape": 1, "mode": "scout-ikr"}
+    key = signature_key(SIG, "cpu")
+    assert key == ("family=sin_recip_scaled|eps_band=-7|rule=trapezoid"
+                   "|theta_band=1|mesh_shape=1|mode=scout-ikr"
+                   "|device=cpu")
+
+
+def _entry(sig, device="cpu", exit_frac=0.90, suspend_frac=0.65):
+    return {"schema": tune.ENTRY_SCHEMA, "signature": sig,
+            "device_kind": device,
+            "knobs": {"exit_frac": exit_frac,
+                      "suspend_frac": suspend_frac},
+            "baseline": {"tasks": 10, "kernel_steps": 10,
+                         "lane_efficiency": 0.5},
+            "tuned": {"tasks": 10, "kernel_steps": 8,
+                      "lane_efficiency": 0.6},
+            "provenance": {"trials": 2, "recompiles": 1,
+                           "reconciles": True, "seed": 0, "budget": 2,
+                           "improved": True, "eps": 1e-7,
+                           "bounds": [0.0, 1.0], "sizing": {},
+                           "path": [{"moved": None, "accepted": True,
+                                     "kernel_steps": 8,
+                                     "lane_efficiency": 0.6}]}}
+
+
+def _table(*entries):
+    t = None
+    for e in entries:
+        t = update_table(t, e)
+    return t
+
+
+def _sig(family="sin_recip_scaled", eps=1e-7, rule="trapezoid",
+         theta_block=1, mesh_shape=1, scout=True, refill_slots=4):
+    return workload_signature(family, eps, rule, theta_block,
+                              mesh_shape, scout=scout,
+                              refill_slots=refill_slots)
+
+
+def test_nearest_entry_hard_constraints_never_cross():
+    # same family, wrong mode / mesh / theta band / rule / device:
+    # NEVER eligible, whatever the score would be
+    others = [
+        _sig(scout=False),                      # mode f64-ikr
+        _sig(refill_slots=0),                   # mode scout-xla
+        _sig(mesh_shape=8),
+        _sig(theta_block=64),
+        _sig(rule="simpson"),
+    ]
+    entries = _table(*[_entry(s) for s in others])["entries"]
+    assert nearest_entry(entries, _sig(), "cpu") is None
+    ent = _table(_entry(_sig()))["entries"]
+    assert nearest_entry(ent, _sig(), "tpu-v5e") is None
+
+
+def test_nearest_entry_family_beats_eps_proximity():
+    same_fam_far = _entry(_sig(eps=1e-9))       # family match, d=2
+    other_fam_close = _entry(_sig(family="sin_scaled"))  # d=0, no fam
+    entries = _table(same_fam_far, other_fam_close)["entries"]
+    key, ent = nearest_entry(entries, _sig(), "cpu")
+    assert ent["signature"]["family"] == "sin_recip_scaled"
+    # among same-family candidates, smaller eps distance wins
+    closer = _entry(_sig(eps=1e-8))
+    entries = _table(same_fam_far, closer)["entries"]
+    key, ent = nearest_entry(entries, _sig(), "cpu")
+    assert ent["signature"]["eps_band"] == -8
+
+
+def test_nearest_entry_score_floor_and_tie_break():
+    # nothing in common (different family, eps 4+ bands away): score 0
+    # falls through to the hand tier
+    far = _entry(_sig(family="sin_scaled", eps=1e-12))
+    assert nearest_entry(_table(far)["entries"], _sig(), "cpu") is None
+    # exact (score, distance) tie: lexicographically smaller key wins
+    a = _entry(_sig(family="cosh4_scaled"))
+    b = _entry(_sig(family="sin_scaled"))
+    entries = _table(a, b)["entries"]
+    key, ent = nearest_entry(entries, _sig(family="quad_scaled"),
+                             "cpu")
+    assert ent["signature"]["family"] == "cosh4_scaled"
+    assert key == min(entries)
+
+
+@pytest.fixture
+def table_env(tmp_path, monkeypatch):
+    """Point PPLS_TUNING_TABLE at a writable temp table."""
+    path = str(tmp_path / "table.json")
+    monkeypatch.setenv("PPLS_TUNING_TABLE", path)
+    clear_table_cache()
+    yield path
+    clear_table_cache()
+
+
+def test_resolve_cadence_tiers(table_env):
+    de, ds = hand_cadence_defaults(True, 4)
+    # no table on disk: hand default
+    assert resolve_cadence_tuned(None, None, True, 4,
+                                 signature=_sig()) == (de, ds,
+                                                       "default")
+    # explicit values always win, table or not
+    assert resolve_cadence_tuned(0.77, 0.55, True, 4,
+                                 signature=_sig()) \
+        == (0.77, 0.55, "explicit")
+    write_table(table_env, _table(_entry(_sig(), tune.device_kind())))
+    e, s, tier = resolve_cadence_tuned(None, None, True, 4,
+                                       signature=_sig())
+    assert (e, s, tier) == (0.90, 0.65, "exact")
+    # eps one band off: the nearest tier serves the same values
+    e, s, tier = resolve_cadence_tuned(None, None, True, 4,
+                                       signature=_sig(eps=1e-8))
+    assert (e, s, tier) == (0.90, 0.65, "nearest")
+    # the resolution is recorded for the gauge/bench record
+    last = tune.last_resolution()
+    assert last["tier"] == "nearest"
+    assert last["exit_frac"] == 0.90
+
+
+def test_resolve_cadence_insane_table_degrades_loudly(table_env):
+    de, ds = hand_cadence_defaults(True, 4)
+    lo, hi = CADENCE_SAFE_BANDS["exit_frac"]
+    write_table(table_env, _table(
+        _entry(_sig(), tune.device_kind(), exit_frac=hi + 0.5)))
+    e, s, tier = resolve_cadence_tuned(None, None, True, 4,
+                                       signature=_sig())
+    assert (e, s, tier) == (de, ds, "default")
+    # suspend >= exit is equally insane
+    write_table(table_env, _table(
+        _entry(_sig(), tune.device_kind(), exit_frac=0.8,
+               suspend_frac=0.8)))
+    clear_table_cache()
+    assert resolve_cadence_tuned(None, None, True, 4,
+                                 signature=_sig())[2] == "default"
+
+
+def test_table_env_off_disables(table_env, monkeypatch):
+    write_table(table_env, _table(_entry(_sig(), tune.device_kind())))
+    monkeypatch.setenv("PPLS_TUNING_TABLE", "off")
+    clear_table_cache()
+    de, ds = hand_cadence_defaults(True, 4)
+    assert resolve_cadence_tuned(None, None, True, 4,
+                                 signature=_sig()) == (de, ds,
+                                                       "default")
+
+
+# ---------------------------------------------------------------------------
+# sweep determinism (satellite 3a)
+# ---------------------------------------------------------------------------
+
+
+def _stub_measure():
+    """Deterministic fake trial runner: masked_dead dominates until
+    exit_frac tightens to 0.98, then nothing improves further."""
+    def measure(knobs):
+        good = knobs["exit_frac"] >= 0.98
+        return {"tasks": 100, "cycles": 50,
+                "kernel_steps": 40 if good else 50,
+                "lane_efficiency": 0.8 if good else 0.6,
+                "dominant_waste": ("drain_tail" if good
+                                   else "masked_dead"),
+                "reconciles": True, "recompiles": 1}
+    return measure
+
+
+def test_tune_workload_byte_identical_rerun():
+    kw = dict(budget=6, seed=3, measure=_stub_measure(), device="cpu")
+    e1 = tune_workload("sin_recip_scaled", 1e-7, (1e-2, 1.0), **kw)
+    e2 = tune_workload("sin_recip_scaled", 1e-7, (1e-2, 1.0), **kw)
+    assert json.dumps(e1, sort_keys=True) == json.dumps(e2,
+                                                        sort_keys=True)
+    # the sweep found the stubbed optimum, via the bucket's own knob
+    assert e1["knobs"]["exit_frac"] == 0.98
+    assert e1["provenance"]["improved"] is True
+    assert e1["provenance"]["trials"] == 6
+    assert e1["provenance"]["recompiles"] == 6
+    moved = [t["moved"]["knob"] for t in e1["provenance"]["path"]]
+    # masked_dead dominated the baseline: cadence knobs tried first
+    assert moved[0] in BUCKET_KNOB_MAP["masked_dead"]
+    # provenance records which bucket picked each move
+    assert e1["provenance"]["path"][0]["moved"]["bucket"] \
+        == "masked_dead"
+
+
+def test_tune_workload_no_improvement_keeps_baseline():
+    def flat(knobs):
+        return {"tasks": 100, "cycles": 50, "kernel_steps": 50,
+                "lane_efficiency": 0.6, "dominant_waste": "drain_tail",
+                "reconciles": True, "recompiles": 1}
+    e = tune_workload("sin_recip_scaled", 1e-7, (1e-2, 1.0),
+                      budget=4, measure=flat, device="cpu")
+    assert e["provenance"]["improved"] is False
+    assert e["knobs"]["exit_frac"] \
+        == hand_cadence_defaults(True, 4)[0]
+    assert e["tuned"] == e["baseline"]
+
+
+def test_pareto_contract():
+    base = {"lane_efficiency": 0.6, "kernel_steps": 50,
+            "reconciles": True}
+    better = dict(base, lane_efficiency=0.7)
+    assert pareto_improves(better, base)
+    # reconciliation is mandatory
+    assert not pareto_improves(dict(better, reconciles=False), base)
+    # a trade (faster but less efficient) is NOT an improvement
+    assert not pareto_improves(
+        dict(base, lane_efficiency=0.5, kernel_steps=40), base)
+    # equality on both axes is not an improvement either
+    assert not pareto_improves(dict(base), base)
+
+
+# ---------------------------------------------------------------------------
+# online adaptation units
+# ---------------------------------------------------------------------------
+
+
+def test_online_adapter_hysteresis_and_clamps():
+    a = OnlineAdapter({"admit_budget": 4},
+                      {"admit_budget": (1, 8)})
+    # one phase of pressure: hysteresis holds the value
+    assert a.observe({"admit_budget": 1}) == []
+    assert a.values["admit_budget"] == 4
+    # second consecutive phase: one step, streak resets
+    assert a.observe({"admit_budget": 1}) \
+        == [{"knob": "admit_budget", "from": 4, "to": 5}]
+    # direction flip resets the streak
+    assert a.observe({"admit_budget": -1}) == []
+    assert a.observe({"admit_budget": 1}) == []
+    assert a.observe({"admit_budget": 1})[0]["to"] == 6
+    # band clamp: never leaves [1, 8] however long the pressure
+    for _ in range(20):
+        a.observe({"admit_budget": 1})
+    assert a.values["admit_budget"] == 8
+    for _ in range(40):
+        a.observe({"admit_budget": -1})
+    assert a.values["admit_budget"] == 1
+
+
+def test_online_adapter_state_roundtrip_and_band_check():
+    a = OnlineAdapter({"admit_budget": 4}, {"admit_budget": (1, 8)})
+    a.observe({"admit_budget": 1})
+    st = a.state()
+    b = OnlineAdapter({"admit_budget": 4}, {"admit_budget": (1, 8)})
+    b.restore(st)
+    assert b.state() == st
+    with pytest.raises(ValueError, match="safe band"):
+        b.restore({"values": {"admit_budget": 99}})
+    with pytest.raises(ValueError, match="safe band"):
+        OnlineAdapter({"admit_budget": 16}, {"admit_budget": (1, 8)})
+
+
+# ---------------------------------------------------------------------------
+# the adapting stream: determinism + kill-and-resume (satellite 3c)
+# ---------------------------------------------------------------------------
+
+_STREAM_KW = dict(slots=2, chunk=1 << 10, capacity=1 << 16, lanes=256,
+                  roots_per_lane=2, refill_slots=2, seg_iters=32,
+                  min_active_frac=0.05, adapt=True)
+_EPS = 1e-7
+_REQS = [(float(t), (1e-2, 1.0))
+         for t in 1.0 + np.arange(8) / 8.0]
+
+
+def _drive(eng, reqs, arr, k=0, hist=None):
+    while not eng.idle or k < len(reqs):
+        while k < len(reqs) and arr[k] <= eng.phase:
+            eng.submit(*reqs[k])
+            k += 1
+        eng.step()
+        if hist is not None and eng._adapt is not None:
+            hist.append(dict(eng._adapt.values))
+    return eng.result()
+
+
+def test_stream_adaptation_fires_and_is_deterministic():
+    from ppls_tpu.runtime.stream import StreamEngine
+    arr = [0] * len(_REQS)            # burst: sustained backlog
+    e1 = StreamEngine("sin_recip_scaled", _EPS, **_STREAM_KW)
+    h1 = []
+    r1 = _drive(e1, _REQS, arr, hist=h1)
+    assert len(r1.completed) == len(_REQS)
+    # the sustained backlog actually moved a knob at some boundary
+    assert e1._adapt is not None
+    assert any(h != h1[0] for h in h1), h1
+    # re-run: identical trajectory (pure function of the schedule)
+    e2 = StreamEngine("sin_recip_scaled", _EPS, **_STREAM_KW)
+    h2 = []
+    r2 = _drive(e2, _REQS, arr, hist=h2)
+    assert np.array_equal(r1.areas, r2.areas)
+    assert h1 == h2
+    assert e1._adapt.state() == e2._adapt.state()
+
+
+def test_stream_adapt_kill_and_resume_bit_identity(tmp_path):
+    from ppls_tpu.runtime.stream import StreamEngine
+    arr = [0, 0, 0, 0, 1, 2, 3, 5]
+    base_eng = StreamEngine("sin_recip_scaled", _EPS, **_STREAM_KW)
+    base = _drive(base_eng, _REQS, arr)
+    path = str(tmp_path / "adapt.ckpt")
+    eng = StreamEngine("sin_recip_scaled", _EPS, checkpoint_path=path,
+                       checkpoint_every=1, **_STREAM_KW)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(_REQS, arrival_phase=arr, _crash_after_phases=3)
+    # the kill landed mid-adaptation: the snapshot carries live state
+    eng2 = StreamEngine.resume(path, "sin_recip_scaled", _EPS,
+                               checkpoint_every=1, **_STREAM_KW)
+    assert eng2.phase == 3
+    assert eng2._adapt.state() == eng._adapt.state()
+    res = _drive(eng2, _REQS, arr, k=eng2.next_rid)
+    assert np.array_equal(res.areas, base.areas)       # bit-for-bit
+    assert res.phases == base.phases
+    assert eng2._adapt.state() == base_eng._adapt.state()
+
+
+def test_stream_adapt_resume_requires_armed_adapter(tmp_path):
+    from ppls_tpu.runtime.stream import StreamEngine
+    path = str(tmp_path / "adapt2.ckpt")
+    eng = StreamEngine("sin_recip_scaled", _EPS, checkpoint_path=path,
+                       checkpoint_every=1, **_STREAM_KW)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        eng.run(_REQS, _crash_after_phases=2)
+    kw = dict(_STREAM_KW, adapt=False)
+    with pytest.raises(ValueError):
+        StreamEngine.resume(path, "sin_recip_scaled", _EPS,
+                            checkpoint_every=1, **kw)
+
+
+# ---------------------------------------------------------------------------
+# compile-once holds outside tune trials (satellite 3d)
+# ---------------------------------------------------------------------------
+
+
+def test_served_path_zero_recompiles_with_table_loaded(tmp_path,
+                                                       monkeypatch):
+    """The relaxation is scoped to tune trials: an engine resolving
+    its cadence from a loaded table serves with ppls_recompiles_total
+    pinned at 0 (and the resolution tier visible on the registry)."""
+    from ppls_tpu.obs import Telemetry
+    from ppls_tpu.runtime.stream import StreamEngine
+    sig = _sig(refill_slots=2)
+    path = str(tmp_path / "served.json")
+    write_table(path, _table(_entry(sig, tune.device_kind())))
+    monkeypatch.setenv("PPLS_TUNING_TABLE", path)
+    clear_table_cache()
+    try:
+        tel = Telemetry()
+        kw = dict(_STREAM_KW, adapt=False, scout_dtype="f32",
+                  telemetry=tel)
+        eng = StreamEngine("sin_recip_scaled", _EPS, **kw)
+        assert eng._cadence_resolution["tier"] == "exact"
+        assert eng._cycle_kw["exit_frac"] == 0.90
+        assert eng._cycle_kw["suspend_frac"] == 0.65
+        r = eng.run(_REQS[:4])
+        assert len(r.completed) == 4
+        reg = tel.registry
+        assert reg.value("ppls_recompiles_total",
+                         engine="walker-stream", default=0.0) == 0.0
+        assert reg.value("ppls_tuning_resolution", tier="exact") == 1.0
+    finally:
+        clear_table_cache()

@@ -217,6 +217,16 @@ def print_attribution(buckets: dict, wsteps: int, lanes: int) -> None:
               f"this one first")
     else:
         print("  dominant waste bucket: none (fully eval-active)")
+    # round 20: the recommendation comes from the TUNER'S shared
+    # dominant-bucket -> knob map (runtime.tune.BUCKET_KNOB_MAP — the
+    # same map the bench.py tune sweep uses to pick its next knob; one
+    # definition, no drift). tune stays importable without jax, so the
+    # --from-events path gets the line too.
+    from ppls_tpu.runtime.tune import recommend_knob
+    rec = recommend_knob(a)
+    if rec is not None:
+        print(f"  recommended knob: {', '.join(rec['knobs'])} — "
+              f"{rec['hint']}")
 
 
 if "--from-events" in sys.argv:

@@ -791,6 +791,89 @@ def gate_record(cur: dict, ref: dict,
     return fails
 
 
+def gate_tuning_record(table) -> List[str]:
+    """Round-20 tuned-vs-default floor over the COMMITTED tuning table
+    (tools/tuning_table.json, written by ``bench.py tune``): at least
+    two workload families must carry entries whose tuned configuration
+    Pareto-beats the hand default on the quick device-counted proxies
+    (lane_efficiency no worse AND kernel_steps no worse, one strictly
+    better — the same ``tune.pareto_improves`` definition the sweep's
+    acceptance uses), every entry's attribution must reconcile, and
+    every committed cadence value must sit inside the declared safe
+    bands (a committed table that the resolution tier would discard
+    as insane is a broken commit, not a tuning choice). Returns []
+    when no table is committed (pre-round-20 refs)."""
+    if table is None:
+        return []
+    from ppls_tpu.runtime.tune import (CADENCE_SAFE_BANDS,
+                                       pareto_improves)
+    entries = table.get("entries") if isinstance(table, dict) else None
+    if not isinstance(entries, dict) or not entries:
+        return ["tuning table committed but carries no entries"]
+    fails: List[str] = []
+    improved_families = set()
+    for key in sorted(entries):
+        e = entries[key]
+        base = e.get("baseline") or {}
+        tuned = e.get("tuned") or {}
+        prov = e.get("provenance") or {}
+        knobs = e.get("knobs") or {}
+        fam = (e.get("signature") or {}).get("family", key)
+        for blk, name in ((base, "baseline"), (tuned, "tuned")):
+            for k in ("tasks", "kernel_steps", "lane_efficiency"):
+                v = blk.get(k)
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool) or v < 0:
+                    fails.append(f"tuning {key}: {name}.{k} missing "
+                                 f"or non-numeric")
+        if prov.get("reconciles") is not True:
+            fails.append(f"tuning {key}: lane-waste attribution did "
+                         f"not reconcile during the sweep")
+        if int(prov.get("trials", 0)) < 1:
+            fails.append(f"tuning {key}: no trials recorded")
+        for k, (lo, hi) in CADENCE_SAFE_BANDS.items():
+            v = knobs.get(k)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not lo <= v <= hi:
+                fails.append(f"tuning {key}: knob {k}={v!r} outside "
+                             f"the safe band [{lo}, {hi}]")
+        if isinstance(knobs.get("exit_frac"), float) \
+                and isinstance(knobs.get("suspend_frac"), float) \
+                and knobs["suspend_frac"] >= knobs["exit_frac"]:
+            fails.append(f"tuning {key}: suspend_frac >= exit_frac")
+        cand = dict(tuned, reconciles=prov.get("reconciles") is True)
+        try:
+            beats = pareto_improves(cand, base)
+        except (KeyError, TypeError, ValueError):
+            beats = False
+        if bool(prov.get("improved")) != beats:
+            fails.append(
+                f"tuning {key}: provenance says improved="
+                f"{prov.get('improved')} but the recorded proxies say "
+                f"{beats} — stale or hand-edited entry")
+        if beats:
+            improved_families.add(fam)
+    if len(improved_families) < 2:
+        fails.append(
+            f"tuning table: tuned beats the hand default on only "
+            f"{len(improved_families)} famil"
+            f"{'y' if len(improved_families) == 1 else 'ies'} "
+            f"({sorted(improved_families)}); the round-20 floor is 2 "
+            f"— re-run `python bench.py tune` and commit the table")
+    return fails
+
+
+def load_tuning_table_for_gate():
+    """The committed tuning table for ``--gate-run`` (None when no
+    table is committed — the gate skips, pre-round-20 pattern)."""
+    from ppls_tpu.runtime.tune import DEFAULT_TABLE_PATH
+    try:
+        with open(DEFAULT_TABLE_PATH, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def main(argv: List[str]) -> int:
     args = list(argv[1:])
 
@@ -873,7 +956,8 @@ def main(argv: List[str]) -> int:
                             eff_tolerance=eff_tol) \
             + gate_theta_record(cur, ref) \
             + gate_stream_record(cur, ref) \
-            + gate_multihost_record(cur, ref)
+            + gate_multihost_record(cur, ref) \
+            + gate_tuning_record(load_tuning_table_for_gate())
         for msg in fails:
             print(f"bench_history: GATE {msg}", file=sys.stderr)
         verdict = "TRIPPED" if fails else "passed"

@@ -29,6 +29,12 @@ Usage:
         # json` ledger (one record per violation, counts reconciled,
         # grandfathered records carry reasons) — the machine-readable
         # lint output ci.sh's deep-lint step emits for annotations
+    python tools/check_artifacts.py --tuning TABLE.json [...]
+        # round 20: validate a `bench.py tune` tuning table (entry
+        # keys round-trip from their signatures; knobs, baseline/
+        # tuned proxies, and sweep provenance all present) — the
+        # performance floor itself lives in bench_history's
+        # gate_tuning_record
 """
 
 from __future__ import annotations
@@ -45,6 +51,7 @@ from ppls_tpu.utils.artifact_schema import (  # noqa: E402
     validate_events_text,
     validate_graftlint_text,
     validate_serve_output_text,
+    validate_tuning_table_text,
 )
 
 
@@ -88,6 +95,18 @@ def main(argv) -> int:
             return 2
         lint_paths.append(args[i + 1])
         del args[i:i + 2]
+    # round 20: tuning tables (bench.py tune) — signature/provenance
+    # shape checks; the performance floor lives in bench_history's
+    # gate_tuning_record
+    tuning_paths = []
+    while "--tuning" in args:
+        i = args.index("--tuning")
+        if i + 1 >= len(args):
+            print("check_artifacts: --tuning requires a FILE",
+                  file=sys.stderr)
+            return 2
+        tuning_paths.append(args[i + 1])
+        del args[i:i + 2]
     paths = args
     problems = []
     for p in event_paths:
@@ -108,7 +127,11 @@ def main(argv) -> int:
         with open(p) as fh:
             problems += validate_graftlint_text(
                 fh.read(), where=os.path.basename(p))
-    event_paths = event_paths + serve_paths + lint_paths
+    for p in tuning_paths:
+        with open(p) as fh:
+            problems += validate_tuning_table_text(
+                fh.read(), where=os.path.basename(p))
+    event_paths = event_paths + serve_paths + lint_paths + tuning_paths
     if event_paths and not paths:
         for msg in problems:
             print(f"check_artifacts: {msg}", file=sys.stderr)

@@ -506,6 +506,31 @@ else
     FAILURES=$((FAILURES + 1))
 fi
 
+# --- 6b2. closed-loop autotuning leg (round 20) ---
+# `bench.py tune --quick` runs the budgeted staged sweep into a THROW-
+# AWAY table (the committed tools/tuning_table.json is re-recorded
+# only via the documented full-budget `python bench.py tune` +
+# `--update-ref` flow, never silently by CI); the record validates
+# through the bench envelope and BOTH tables — the fresh sweep output
+# and the committed one — pass check_artifacts --tuning. The
+# committed table's performance floor (tuned Pareto-beats the hand
+# default on >= 2 families) is held by step 6's --gate-run via
+# bench_history's gate_tuning_record.
+step "bench tune --quick sweep + tuning-table schema check"
+TUNE_TABLE="$(mktemp /tmp/ppls_ci_tune.XXXXXX.json)"
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py tune \
+        --quick --out "$TUNE_TABLE" \
+        | python tools/check_artifacts.py - \
+        && python tools/check_artifacts.py \
+            --tuning "$TUNE_TABLE" \
+            --tuning tools/tuning_table.json; then
+    echo "ci: bench tune artifact + tuning tables OK"
+else
+    echo "ci: bench tune leg FAILED"
+    FAILURES=$((FAILURES + 1))
+fi
+rm -f "$TUNE_TABLE"
+
 # --- 6c. multi-host resilience leg: record must schema-validate ---
 # `bench.py multihost` (round 18) kills one host of a real 2-process
 # cluster under overload and records redeal wall + spillover-engaged
