@@ -1395,12 +1395,39 @@ def main_multihost():
 
 def main_stream():
     """Standalone mode (``python bench.py stream [--quick]
-    [--tenants]``). ``--tenants`` runs ONLY the round-16 multi-tenant
-    overload leg (mixed tenants + priorities, bounded queue, chaos
-    injected) and prints its standalone record — the fast spelling of
-    the dispatcher-tier bench target."""
+    [--tenants] [--hetero]``). ``--tenants`` runs ONLY the round-16
+    multi-tenant overload leg (mixed tenants + priorities, bounded
+    queue, chaos injected) and prints its standalone record — the fast
+    spelling of the dispatcher-tier bench target. ``--hetero`` runs
+    ONLY the round-21 heterogeneous-shape dispatcher leg (>= 3
+    distinct engine keys through the EngineDispatcher pool, zero
+    recompiles end-to-end, work-conserving schedule vs the serialized
+    one-engine-at-a-time baseline on the schedule-counted interpret
+    proxies)."""
     from ppls_tpu.utils.artifact_schema import validate_record
     quick = True if "--quick" in sys.argv else None
+    if "--hetero" in sys.argv:
+        from tools.bench_history import run_hetero_dispatch_proxies
+        try:
+            hd = run_hetero_dispatch_proxies()
+        except Exception as e:  # noqa: BLE001 — one JSON line always
+            print(json.dumps(validate_record(
+                {"metric": "heterogeneous dispatch proxies",
+                 "value": 0.0, "unit": "requests/s",
+                 "vs_baseline": 0.0, "error": str(e)})))
+            return 1
+        rec = dict(hd, value=float(hd["requests_per_sec"]),
+                   unit="requests/s (mixed-shape engine pool, "
+                        "recompiles pinned 0)",
+                   # the acceptance ratio: pool turns vs summed
+                   # serialized phases (work-conserving must be > 1)
+                   vs_baseline=float(hd["turns_speedup_vs_serialized"]))
+        print(json.dumps(validate_record(rec)))
+        ok = (hd["recompiles"] == 0 and hd["accounting_ok"]
+              and hd["engines_reconcile"]
+              and hd["n_engine_keys"] >= 3
+              and hd["turns_speedup_vs_serialized"] > 1.0)
+        return 0 if ok else 1
     if "--tenants" in sys.argv:
         from tools.bench_history import run_stream_slo_proxies
         try:

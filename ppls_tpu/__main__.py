@@ -512,6 +512,28 @@ def build_parser() -> argparse.ArgumentParser:
                           "from the committed tuning table "
                           "(tools/tuning_table.json; override or "
                           "disable via PPLS_TUNING_TABLE)")
+    srv.add_argument("--dispatch", action="store_true",
+                     help="round 21: heterogeneous-shape dispatcher — "
+                          "a bounded pool of engines keyed by "
+                          "canonicalized (eps band, rule, theta "
+                          "bucket) compile statics behind one serving "
+                          "surface (runtime/dispatch.py). Requests "
+                          "may then carry per-request 'eps'/'rule' "
+                          "routing keys (JSONL and POST /submit); "
+                          "--eps/--rule become the POOL DEFAULTS for "
+                          "requests that omit them, --theta-block is "
+                          "ignored (batches bucket to powers of two "
+                          "automatically), and the summary gains the "
+                          "per-engine decomposition plus the pool "
+                          "recompile count (pinned 0 on mixed-shape "
+                          "traffic — the tier's whole invariant)")
+    srv.add_argument("--max-engines", type=int, default=4,
+                     dest="max_engines", metavar="N",
+                     help="--dispatch pool cap: at most N live "
+                          "engines; an over-cap key parks the LRU "
+                          "victim through a checkpoint and resumes "
+                          "it bit-identically when its shape returns "
+                          "(default 4)")
     srv.add_argument("--json", action="store_true", dest="as_json")
 
     qmc = sub.add_parser(
@@ -724,7 +746,17 @@ def _main_serve(args) -> int:
     # (the never-crash ingest contract); the same parser backs the
     # --ingest-port HTTP path.
     from ppls_tpu.runtime.ingest import parse_request_record
+    dispatch = bool(getattr(args, "dispatch", False))
     T = int(getattr(args, "theta_block", 1))
+    if dispatch:
+        # the pool buckets theta batches itself; the parse-time cap is
+        # the dispatcher's lattice cap and records may carry the
+        # per-request eps/rule routing keys (synthetic generation
+        # still chunks by --theta-block)
+        from ppls_tpu.runtime.dispatch import MAX_THETA_BUCKET
+        Tcap = MAX_THETA_BUCKET
+    else:
+        Tcap = T
     if args.requests:
         fh = sys.stdin if args.requests == "-" else open(args.requests)
         try:
@@ -735,7 +767,8 @@ def _main_serve(args) -> int:
                     continue
                 try:
                     rec = parse_request_record(json.loads(line),
-                                               theta_block=T)
+                                               theta_block=Tcap,
+                                               dispatch=dispatch)
                 except (json.JSONDecodeError, ValueError) as e:
                     print(json.dumps({
                         "rejected": True, "line": lineno,
@@ -798,6 +831,11 @@ def _main_serve(args) -> int:
         # round 18: the multi-process cluster serve path (coordinator
         # + N worker processes). The ingest tier composes with the
         # single-process engine only, for now.
+        if dispatch:
+            raise SystemExit(
+                "--dispatch is not supported with --processes (the "
+                "pool is the single-process multi-ENGINE tier, the "
+                "cluster is the multi-PROCESS tier); pick one")
         if args.processes < 1:
             # a sweep script parameterized over process counts must
             # get a refusal for P<1, not a silently different engine
@@ -816,6 +854,12 @@ def _main_serve(args) -> int:
                 "per-tenant token buckets); drop the flag or run "
                 "single-process")
         return _main_serve_cluster(args, reqs, arrivals)
+
+    if dispatch and getattr(args, "spillover", False):
+        raise SystemExit(
+            "--spillover is not supported with --dispatch (queue "
+            "overflow is the POOL's shed policy; the CPU spillover "
+            "executor is per-engine); drop one of the flags")
 
     kw = dict(rule=Rule(args.rule), slots=args.slots, chunk=args.chunk,
               capacity=args.capacity, refill_slots=args.refill_slots,
@@ -912,12 +956,57 @@ def _main_serve(args) -> int:
                   "family": args.family, "eps": args.eps,
                   "rule": args.rule, "slots": args.slots,
                   "lanes": args.lanes or 0, "seed": args.seed,
-                  "requests": len(reqs), "resumed": resuming},
+                  "requests": len(reqs), "resumed": resuming,
+                  **({"dispatch": True,
+                      "max_engines": args.max_engines}
+                     if dispatch else {})},
             append=resuming,
             events_max_bytes=(
                 int(args.events_max_mb * (1 << 20))
                 if getattr(args, "events_max_mb", None) else None))
         holder["tel"] = tel
+        if dispatch:
+            # round 21: the heterogeneous pool replaces the single
+            # engine behind the SAME serve surface — submit/step/
+            # snapshot/result all alias, per-request eps/rule route
+            from ppls_tpu.runtime.dispatch import EngineDispatcher
+            engine_kw = dict(
+                chunk=args.chunk, capacity=args.capacity,
+                refill_slots=args.refill_slots,
+                scout_dtype=args.scout_dtype,
+                double_buffer=args.double_buffer,
+                reduced_integrands=args.reduced_integrands,
+                engine=args.engine,
+                f64_rounds=int(getattr(args, "f64_rounds", 0)),
+                n_devices=state["n_devices"],
+                adapt=bool(getattr(args, "adapt", False)))
+            if args.lanes:
+                engine_kw["lanes"] = args.lanes
+            dkw = dict(
+                slots=args.slots, max_engines=args.max_engines,
+                default_eps=args.eps, default_rule=Rule(args.rule),
+                queue_limit=args.queue_limit,
+                tenant_quotas=args.tenant_quotas,
+                default_deadline_phases=args.deadline_phases,
+                checkpoint_every=args.checkpoint_every,
+                telemetry=tel,
+                slo_config=getattr(args, "slo_config", None),
+                fault_injector=injector, quarantine=quarantine,
+                on_shed=_print_shed, engine_kw=engine_kw)
+            if resuming:
+                try:
+                    return EngineDispatcher.resume(
+                        args.checkpoint, args.family, **dkw)
+                except CheckpointCorruptError as e:
+                    print(f"serve: {e}; starting fresh",
+                          file=sys.stderr, flush=True)
+                    tel.event("checkpoint_corrupt",
+                              path=args.checkpoint,
+                              detail=str(e)[:200])
+                    if os.path.exists(args.checkpoint):
+                        os.unlink(args.checkpoint)
+            return EngineDispatcher(
+                args.family, checkpoint_path=args.checkpoint, **dkw)
         ekw = dict(kw, n_devices=state["n_devices"],
                    quarantine=quarantine, fault_injector=injector,
                    telemetry=tel, on_shed=_print_shed)
@@ -1000,7 +1089,8 @@ def _main_serve(args) -> int:
         from ppls_tpu.runtime.ingest import IngestServer
 
         def ingest_submit(d):
-            rec = parse_request_record(d, theta_block=T)
+            rec = parse_request_record(d, theta_block=Tcap,
+                                       dispatch=dispatch)
             rec.pop("arrival_phase", None)     # live ingest is "now"
             h = holder["handle"]          # the CURRENT attempt's
             with h.lock():
@@ -1041,7 +1131,9 @@ def _main_serve(args) -> int:
         eng = make_engine()
         handle.publish(eng)
         span = eng.telemetry.span("run", mode="serve",
-                                  engine=f"{args.engine}-stream",
+                                  engine=("dispatch-pool" if dispatch
+                                          else f"{args.engine}"
+                                               f"-stream"),
                                   requests=len(reqs))
         # resumed engines skip the batch-list prefix they already
         # submitted before the crash. The cursor rides the snapshot's
@@ -1056,6 +1148,22 @@ def _main_serve(args) -> int:
         # next_rid prefix.
         k = int(eng.client_state.setdefault("batch_cursor",
                                             eng.next_rid))
+        # Replay retire records the snapshot captured but whose prints
+        # never happened: the checkpoint cut lands INSIDE step(),
+        # before the retired list is returned to this loop, so a crash
+        # on the close edge of the same phase restores an engine whose
+        # `completed` list already holds retirements this ledger never
+        # printed. The printed cursor rides client_state next to
+        # batch_cursor; because a cut always precedes its own phase's
+        # prints, replay is AT-LEAST-ONCE — check_artifacts --serve
+        # dedupes retire rids by contract for exactly this reason.
+        done = int(eng.client_state.setdefault("printed_cursor", 0))
+        if done < len(eng.completed):
+            with io_lock:
+                for c in eng.completed[done:]:
+                    print(json.dumps(_serve_completed_record(c)),
+                          flush=True)
+        eng.client_state["printed_cursor"] = len(eng.completed)
         ingest_on = ingest_srv is not None
         while (k < len(reqs) or not eng.idle or ingest_on) \
                 and not stop.requested:
@@ -1084,6 +1192,9 @@ def _main_serve(args) -> int:
                 for c in retired:
                     print(json.dumps(_serve_completed_record(c)),
                           flush=True)
+            # only this thread mutates the cursor; the NEXT step()'s
+            # cut (taken under the engine lock) persists it
+            eng.client_state["printed_cursor"] = len(eng.completed)
             if idle_wait:
                 time.sleep(0.02)
         if stop.requested:
@@ -1167,6 +1278,14 @@ def _main_serve(args) -> int:
         # summary, so consumers written against one path read the
         # other
         summary["spillover"] = eng.spillover_summary()
+        if dispatch:
+            # the pool tier's headline numbers: recompiles is THE
+            # invariant (0 on mixed-shape traffic), engines is the
+            # per-key decomposition the hetero bench gate reconciles
+            summary["dispatch"] = True
+            summary["max_engines"] = args.max_engines
+            summary["recompiles"] = eng.recompiles()
+            summary["engines"] = eng.engines_summary()
         if holder.get("stopped"):
             summary["terminated"] = holder["stopped"]
         failed = sum(1 for c in res.completed if c.failed)

@@ -153,11 +153,22 @@ class SloEvaluator:
     """Phase-boundary burn-rate evaluator over an engine registry
     (see module docstring). One instance per engine/coordinator;
     ``evaluate_slo(phase)`` at every phase close; ``health()`` for
-    the /health verdict."""
+    the /health verdict.
 
-    def __init__(self, config: dict, telemetry):
+    ``scope`` (round 21) names the accounting tier the evaluator
+    watches: ``"engine"`` (the default — one StreamEngine's registry)
+    or ``"pool"`` (the heterogeneous dispatcher's pool-scope registry,
+    where "phase" means dispatcher TURN and the counters/histograms
+    aggregate the whole engine pool). The math is identical — the
+    dispatcher publishes the same metric names at pool scope — but the
+    scope rides every burn event and the health verdict so an alert
+    names the tier it fired at."""
+
+    def __init__(self, config: dict, telemetry,
+                 scope: str = "engine"):
         self.config = parse_slo_config(config)
         self.telemetry = telemetry
+        self.scope = str(scope)
         self.windows = self.config["windows"]
         self.thresholds = self.config["burn_thresholds"]
         # per-slo ring of (phase, bad_cum, total_cum) samples; bounded
@@ -311,6 +322,7 @@ class SloEvaluator:
                              for w in ("fast", "slow"))
             if is_burning:
                 desc = dict(labels, phase=int(phase),
+                            scope=self.scope,
                             fast_burn=round(rates["fast"], 6),
                             slow_burn=round(rates["slow"], 6))
                 burning.append(desc)
@@ -327,4 +339,5 @@ class SloEvaluator:
         burning SLO descriptors attached."""
         burning = getattr(self, "_last_burning", [])
         return {"ok": not burning, "burning": burning,
-                "phase": getattr(self, "_last_phase", -1)}
+                "phase": getattr(self, "_last_phase", -1),
+                "scope": self.scope}

@@ -277,6 +277,36 @@ class Telemetry:
             "request latency submit->retire in phases, by tenant",
             buckets=PHASE_BUCKETS, labelnames=("tenant",))
 
+    # round-21 heterogeneous-dispatch surface: engine-labeled pool
+    # metrics, registered here for the same reason as above — the
+    # dispatcher, the serve summary, bench.py stream --hetero, and
+    # analyze_occupancy must all read identical names
+
+    def dispatch_engines_gauge(self):
+        return self.registry.gauge(
+            "ppls_dispatch_engines",
+            "pooled stream engines by state (live / parked)",
+            ("state",))
+
+    def dispatch_phase_counter(self):
+        return self.registry.counter(
+            "ppls_dispatch_phases_total",
+            "engine phases run by the work-conserving dispatcher "
+            "schedule, by engine key", ("engine",))
+
+    def dispatch_routed_counter(self):
+        return self.registry.counter(
+            "ppls_dispatch_routed_total",
+            "requests dealt from the pool backlog to an engine, by "
+            "engine key", ("engine",))
+
+    def dispatch_latency_histogram(self):
+        return self.registry.histogram(
+            "ppls_dispatch_retire_latency_turns",
+            "pool-scope request latency submit->retire in dispatcher "
+            "turns, by engine key", buckets=PHASE_BUCKETS,
+            labelnames=("engine",))
+
 
 _default_lock = threading.Lock()
 _default: Optional[Telemetry] = None

@@ -244,6 +244,25 @@ def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
     _chaos_verify_on_write(path)
 
 
+def peek_checkpoint_identity(path: str) -> dict:
+    """Read ONLY the stored identity of a snapshot (round 21): the
+    dispatcher's pool manifest embeds its engine-key set in the
+    identity, which the resume path must learn BEFORE it can build
+    the full expected identity to load against. Integrity is still
+    enforced by the subsequent :func:`load_family_checkpoint` — this
+    peek commits to nothing."""
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any container damage
+        raise CheckpointCorruptError(
+            path, f"unreadable container ({type(e).__name__}: {e})"
+        ) from e
+    return dict(meta.get("identity") or {})
+
+
 def load_family_checkpoint(path: str, identity: dict, *,
                            mesh_resize: bool = False,
                            cluster_resize: bool = False):

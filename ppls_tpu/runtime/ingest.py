@@ -95,7 +95,8 @@ class EngineHandle:
             return self._eng
 
 
-def parse_request_record(d: dict, theta_block: int = 1) -> dict:
+def parse_request_record(d: dict, theta_block: int = 1,
+                         dispatch: bool = False) -> dict:
     """Validate + normalize one ingest/JSONL request record into the
     ``StreamEngine.submit`` kwargs shape. Raises ``ValueError`` with a
     precise message on every malformed shape — the caller turns that
@@ -106,11 +107,23 @@ def parse_request_record(d: dict, theta_block: int = 1) -> dict:
     ``tenant`` (str), ``priority`` (int), ``deadline_phases``
     (int >= 1), ``arrival_phase`` (int >= 0, list-driven mode only).
     Domain checks beyond shape (integrand ds-domain, queue policy)
-    stay with the engine."""
+    stay with the engine.
+
+    ``dispatch=True`` (round 21, the heterogeneous pool) additionally
+    accepts the per-request ROUTING KEYS: ``eps`` (positive finite
+    number inside the dispatchable band range) and ``rule`` (a
+    :class:`~ppls_tpu.config.Rule` member name) — validated through
+    the dispatcher's canonicalizer, so an out-of-band eps, an unknown
+    rule, an over-cap theta batch, or a theta batch on a non-TRAPEZOID
+    rule all yield the same per-line rejection record here instead of
+    a crash later. On a single-engine serve (the default) those keys
+    stay UNKNOWN and reject exactly as before."""
     if not isinstance(d, dict):
         raise ValueError("request record must be a JSON object")
     unknown = set(d) - {"theta", "bounds", "tenant", "priority",
                         "deadline_phases", "arrival_phase"}
+    if dispatch:
+        unknown -= {"eps", "rule"}
     if unknown:
         raise ValueError(f"unknown request keys: {sorted(unknown)}")
     if "theta" not in d or "bounds" not in d:
@@ -156,6 +169,27 @@ def parse_request_record(d: dict, theta_block: int = 1) -> dict:
         if not isinstance(ap, int) or isinstance(ap, bool) or ap < 0:
             raise ValueError("'arrival_phase' must be an integer >= 0")
         out["arrival_phase"] = ap
+    if dispatch:
+        eps = d.get("eps")
+        rule = d.get("rule")
+        if eps is not None and (not isinstance(eps, (int, float))
+                                or isinstance(eps, bool)):
+            raise ValueError("'eps' must be a number")
+        if rule is not None and not isinstance(rule, str):
+            raise ValueError("'rule' must be a string")
+        # full routing-key validation through the canonicalizer (band
+        # range, rule membership, bucket cap, batch-rule cross checks)
+        # — absent keys validate against placeholder defaults so a
+        # bad theta batch still rejects here; the dispatcher's own
+        # defaults apply at submit
+        from ppls_tpu.runtime.dispatch import canonical_key
+        canonical_key(1e-6 if eps is None else eps,
+                      "trapezoid" if rule is None else rule,
+                      out["theta"])
+        if eps is not None:
+            out["eps"] = float(eps)
+        if rule is not None:
+            out["rule"] = str(rule).strip().lower()
     return out
 
 

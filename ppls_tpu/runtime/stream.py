@@ -1053,6 +1053,15 @@ class StreamEngine:
         return len(self._slot_req)
 
     @property
+    def free_capacity(self) -> int:
+        """Slot headroom not already spoken for by queued admissions —
+        the round-21 dispatcher's routing gate: it deals a request to
+        this engine only when a seat is (or will next phase be) free,
+        so pool-scope admission control composes with the per-engine
+        slot occupancy instead of hiding load in the pending queue."""
+        return max(0, self.slots - self.resident - self.pending)
+
+    @property
     def idle(self) -> bool:
         """Nothing queued, resident, live on device, or awaiting the
         spillover backend."""

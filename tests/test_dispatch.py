@@ -1,0 +1,256 @@
+"""Heterogeneous-shape dispatcher (runtime/dispatch.py, round 21).
+
+Acceptance surface of the multi-engine serving tier:
+
+* canonicalization: requests quantize onto the engine-key lattice
+  (eps decimal band, rule, pow2 theta bucket) with every malformed
+  shape rejected BEFORE pool state is consumed;
+* ZERO RECOMPILES: a mixed-shape stream (two eps bands, a simpson
+  request, a theta-block batch) drains with ``ppls_recompiles_total``
+  == 0 — every shape change is a pool route, never a recompile — and
+  the per-engine decomposition reconciles with the pool ledger;
+* park/unpark bit-identity: an LRU-capped pool (``max_engines`` below
+  the live key count) produces per-request areas BIT-IDENTICAL to the
+  uncapped pool — parking is a checkpoint/resume round-trip, not an
+  approximation;
+* kill-and-resume: a mid-stream crash resumes from the coordinated
+  cut (per-engine files + manifest-last) and the continued run is
+  bit-identical to the undisturbed one, with the event timelines
+  passing the rid-linkage contract;
+* refusal: a manifest from a different pool configuration, or a cut
+  blended with another pool's engine snapshot, refuses to resume.
+"""
+
+import glob
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from ppls_tpu.config import Rule
+from ppls_tpu.runtime.dispatch import (MAX_THETA_BUCKET,
+                                       EngineDispatcher, EngineKey,
+                                       canonical_key)
+
+BOUNDS = (1e-2, 1.0)
+# interpret-friendly engine sizing (the stream test config)
+EKW = dict(chunk=1 << 10, capacity=1 << 16, lanes=256,
+           roots_per_lane=2, refill_slots=2, seg_iters=32,
+           min_active_frac=0.05)
+DKW = dict(slots=8, max_engines=4, default_eps=1e-6,
+           engine_kw=EKW)
+
+# the mixed-shape workload: four engine keys across eight requests
+MIXED = [
+    (1.0, BOUNDS, {}),
+    (1.05, BOUNDS, {"eps": 1e-7}),
+    (1.1, BOUNDS, {"rule": "simpson"}),
+    ((1.15, 1.2), BOUNDS, {}),
+    (1.25, BOUNDS, {}),
+    (1.3, BOUNDS, {"eps": 1e-7}),
+    (1.35, BOUNDS, {"rule": "simpson"}),
+    ((1.4, 1.45), BOUNDS, {}),
+]
+ARR = [0, 0, 0, 1, 1, 2, 2, 3]
+MIXED_KEYS = {"e-6:trapezoid:t1", "e-7:trapezoid:t1",
+              "e-6:simpson:t1", "e-6:trapezoid:t2"}
+
+
+def _drive_to_drain(disp, reqs, arr):
+    """Resume driver: submit the unconsumed arrival-schedule suffix
+    (grids are submission-ordered, so next_rid is the cursor) and
+    step to idle — the same loop shape the serve CLI runs."""
+    k = disp.next_rid
+    while not disp.idle or k < len(reqs):
+        while k < len(reqs) and arr[k] <= disp.phase:
+            r = reqs[k]
+            disp.submit(r[0], r[1], **(r[2] if len(r) > 2 else {}))
+            k += 1
+        disp.step()
+    return disp.result()
+
+
+def test_canonical_key_lattice():
+    k = canonical_key(1e-7, "trapezoid", 1.0)
+    assert k == EngineKey(-7, "trapezoid", 1)
+    assert str(k) == "e-7:trapezoid:t1"
+    assert EngineKey.parse(str(k)) == k
+    assert k.eps == 1e-7
+    # eps quantizes to the nearest decimal band
+    assert canonical_key(2e-7, "trapezoid", 1.0).eps_band == -7
+    assert canonical_key(9e-7, "trapezoid", 1.0).eps_band == -6
+    # theta batches bucket to the next power of two
+    assert canonical_key(1e-6, "trapezoid", (1.0, 1.1)).theta_block \
+        == 2
+    assert canonical_key(1e-6, "trapezoid",
+                         (1.0, 1.1, 1.2)).theta_block == 4
+    assert canonical_key(
+        1e-6, "trapezoid",
+        tuple(1.0 + i / 64 for i in range(MAX_THETA_BUCKET))
+    ).theta_block == MAX_THETA_BUCKET
+    # rule accepts Rule members and sloppy strings alike
+    assert canonical_key(1e-6, Rule.SIMPSON, 1.0).rule == "simpson"
+    assert canonical_key(1e-6, " Simpson ", 1.0).rule == "simpson"
+
+
+@pytest.mark.parametrize("eps,rule,theta,match", [
+    (0.0, "trapezoid", 1.0, "finite and > 0"),
+    (float("nan"), "trapezoid", 1.0, "finite and > 0"),
+    ("x", "trapezoid", 1.0, "must be a number"),
+    (1e-20, "trapezoid", 1.0, "outside the dispatchable range"),
+    (1.0, "trapezoid", 1.0, "outside the dispatchable range"),
+    (1e-6, "simpsonish", 1.0, "unknown rule"),
+    (1e-6, "trapezoid", (), "empty theta batch"),
+    (1e-6, "trapezoid",
+     tuple(range(MAX_THETA_BUCKET + 1)), "bucket cap"),
+    (1e-6, "simpson", (1.0, 1.1), "TRAPEZOID"),
+])
+def test_canonical_key_rejects(eps, rule, theta, match):
+    with pytest.raises(ValueError, match=match):
+        canonical_key(eps, rule, theta)
+
+
+def test_dispatch_mixed_shapes_zero_recompiles():
+    disp = EngineDispatcher("sin_recip_scaled", **DKW)
+    res = disp.run(MIXED, arrival_phase=ARR)
+    assert len(res.completed) == len(MIXED)
+    assert np.all(np.isfinite(res.areas))
+    # THE invariant this tier exists for: mixed shapes, zero recompiles
+    assert disp.recompiles() == 0
+    summary = disp.engines_summary()
+    assert set(summary) == MIXED_KEYS
+    assert all(e["state"] == "live" for e in summary.values())
+    # per-engine decomposition reconciles with the pool ledger
+    assert sum(e["completed"] for e in summary.values()) == len(MIXED)
+    assert sum(int(e["phases"]) for e in summary.values()) >= 4
+    # pool determinism: the identical workload replays bit-identically
+    res2 = EngineDispatcher("sin_recip_scaled", **DKW).run(
+        MIXED, arrival_phase=ARR)
+    assert np.array_equal(res.areas, res2.areas)
+
+
+def test_dispatch_park_unpark_determinism_and_parity():
+    base = EngineDispatcher("sin_recip_scaled", **DKW).run(
+        MIXED, arrival_phase=ARR)
+    capped = EngineDispatcher("sin_recip_scaled",
+                              **dict(DKW, max_engines=2))
+    res = capped.run(MIXED, arrival_phase=ARR)
+    # the cap forced real LRU parks (4 keys through 2 slots)
+    parks = sum(child.value for _, child in capped._c_park.items())
+    assert parks >= 2, "max_engines=2 never parked an engine"
+    assert capped.recompiles() == 0
+    assert len(res.completed) == len(MIXED)
+    # parking changes WHEN requests reach their engine, so the
+    # adaptive walk may legitimately stop at a different eps-valid
+    # grid — parity with the uncapped pool is at tolerance scale,
+    # while the capped schedule itself replays BIT-IDENTICALLY
+    # (park/unpark is a deterministic checkpoint round-trip; the
+    # bit-level park-file fidelity pin is the capped kill-and-resume
+    # test below)
+    assert np.max(np.abs(res.areas - base.areas)) < 5e-5
+    res2 = EngineDispatcher("sin_recip_scaled",
+                            **dict(DKW, max_engines=2)).run(
+        MIXED, arrival_phase=ARR)
+    assert np.array_equal(res.areas, res2.areas)
+    summary = capped.engines_summary()
+    assert set(summary) == MIXED_KEYS
+    states = {e["state"] for e in summary.values()}
+    assert "parked" in states, states
+
+
+def test_dispatch_kill_and_resume_bit_identical(tmp_path):
+    """Capped pool (max_engines=2, so the coordinated cut carries
+    PARKED engines too): crash mid-stream, resume from the manifest,
+    and the continued mixed run — park files, unparks and all — is
+    bit-identical to the undisturbed one. Every timeline passes the
+    rid-linkage contract."""
+    from ppls_tpu.obs import Telemetry
+    from ppls_tpu.utils.artifact_schema import validate_events_text
+
+    kw = dict(DKW, max_engines=2)
+    base_ev = str(tmp_path / "base.jsonl")
+    tel = Telemetry(events_path=base_ev)
+    base = EngineDispatcher("sin_recip_scaled", telemetry=tel,
+                            **kw).run(MIXED, arrival_phase=ARR)
+    tel.close()
+    # clean pool timeline: balanced spans AND the rid-linkage contract
+    assert validate_events_text(open(base_ev).read(),
+                                check_rid_linkage=True) == []
+
+    path = str(tmp_path / "pool.ckpt")
+    crash_ev = str(tmp_path / "crash.jsonl")
+    tel2 = Telemetry(events_path=crash_ev)
+    disp = EngineDispatcher("sin_recip_scaled", telemetry=tel2,
+                            checkpoint_path=path, checkpoint_every=1,
+                            **kw)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        disp.run(MIXED, arrival_phase=ARR, _crash_after_turns=3)
+    tel2.close()
+    assert validate_events_text(open(crash_ev).read(),
+                                require_balanced=False,
+                                check_rid_linkage=True) == []
+
+    resume_ev = str(tmp_path / "resume.jsonl")
+    tel3 = Telemetry(events_path=resume_ev)
+    disp2 = EngineDispatcher.resume(path, "sin_recip_scaled",
+                                    telemetry=tel3,
+                                    checkpoint_every=1, **kw)
+    assert disp2.phase == 3
+    assert disp2.recompiles() == 0
+    res = _drive_to_drain(disp2, MIXED, ARR)
+    tel3.close()
+    assert validate_events_text(open(resume_ev).read(),
+                                require_balanced=False,
+                                check_rid_linkage=True) == []
+    # the resumed mixed stream replays bit-identically
+    assert np.array_equal(res.areas, base.areas)
+    assert res.phases == base.phases
+    assert len(res.completed) == len(base.completed)
+    assert disp2.recompiles() == 0
+    assert set(disp2.engines_summary()) == MIXED_KEYS
+
+
+def test_dispatch_resume_refuses_other_config_and_pool(tmp_path):
+    # a cheap single-key workload: config/blend refusal needs files,
+    # not heterogeneity
+    reqs = [(1.0 + i / 8, BOUNDS) for i in range(3)]
+
+    a_dir = tmp_path / "a"
+    a_dir.mkdir()
+    a_path = str(a_dir / "pool.ckpt")
+    disp_a = EngineDispatcher("sin_recip_scaled",
+                              checkpoint_path=a_path,
+                              checkpoint_every=1, **DKW)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        disp_a.run(reqs, _crash_after_turns=1)
+
+    # manifest identity pins the pool configuration
+    with pytest.raises(ValueError,
+                       match="different pool configuration"):
+        EngineDispatcher.resume(a_path, "sin_recip_scaled",
+                                **dict(DKW, slots=4))
+
+    # a second pool with the IDENTICAL configuration: its per-engine
+    # snapshot must still refuse to blend into pool A's manifest
+    # (pool ids differ even when every config knob matches)
+    b_dir = tmp_path / "b"
+    b_dir.mkdir()
+    b_path = str(b_dir / "pool.ckpt")
+    disp_b = EngineDispatcher("sin_recip_scaled",
+                              checkpoint_path=b_path,
+                              checkpoint_every=1, **DKW)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        disp_b.run(reqs, _crash_after_turns=1)
+    a_cuts = sorted(glob.glob(os.path.join(str(a_dir),
+                                           "pool.ckpt.c*")))
+    b_cuts = sorted(glob.glob(os.path.join(str(b_dir),
+                                           "pool.ckpt.c*")))
+    assert a_cuts and b_cuts
+    assert [os.path.basename(p) for p in a_cuts] \
+        == [os.path.basename(p) for p in b_cuts]
+    for src, dst in zip(b_cuts, a_cuts):
+        shutil.copyfile(src, dst)
+    with pytest.raises(ValueError, match="refusing to blend"):
+        EngineDispatcher.resume(a_path, "sin_recip_scaled",
+                                checkpoint_every=1, **DKW)

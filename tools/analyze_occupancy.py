@@ -39,6 +39,14 @@ invariant checked and the dominant waste bucket named (the number the
 ceiling-hunt work is judged against). Offline too: ``--from-events``
 prints the same decomposition from the waste tail columns the phase
 spans now carry.
+
+Round 21: ``--from-events`` on a ``serve --dispatch`` timeline
+additionally prints the PER-ENGINE decomposition — every pool engine's
+phase spans and retire events carry the ``engine=<keystr>`` label and
+the pool emits ``engine_spinup``/``engine_park`` lifecycle events, so
+phases/tasks/lane-efficiency/retire-latency split per engine key
+offline, with the per-engine retire total reconciled against the
+rid-deduped retire count.
 """
 
 import json
@@ -69,9 +77,12 @@ def main_from_events(path: str, lanes: int = 0) -> int:
     phase_rows = []          # span_close attrs of "phase" spans
     phase_walls = []         # close.t - open.t per phase span
     open_phase = {}          # id -> (open t)
+    open_engine = {}         # id -> engine label from the OPEN attrs
     names = {}               # id -> span name
     retires = []
     sheds = []               # request_shed events (round 16)
+    spinups = []             # engine_spinup events (round 21 pool)
+    parks = []               # engine_park events (round 21 pool)
     checkpoints = 0
     segments = 0
     for line in text.splitlines():
@@ -91,14 +102,23 @@ def main_from_events(path: str, lanes: int = 0) -> int:
             # span ids restart per segment (resume-append): drop the
             # previous segment's bookkeeping so ids don't collide
             open_phase.clear()
+            open_engine.clear()
             names.clear()
         elif ev == "span_open" and isinstance(rec.get("id"), int):
             names[rec["id"]] = rec.get("name")
             if rec.get("name") == "phase":
                 open_phase[rec["id"]] = rec.get("t", 0.0)
+                # the pool's engine label rides the OPEN attrs (the
+                # close carries the device-counter deltas); remember
+                # it so the per-engine decomposition can key the row
+                eng = (rec.get("attrs") or {}).get("engine")
+                if eng:
+                    open_engine[rec["id"]] = str(eng)
         elif ev == "span_close":
             if names.get(rec.get("id")) == "phase":
-                attrs = rec.get("attrs") or {}
+                attrs = dict(rec.get("attrs") or {})
+                attrs.setdefault("engine",
+                                 open_engine.pop(rec.get("id"), None))
                 if not attrs.get("idle"):
                     phase_rows.append(attrs)
                 t0 = open_phase.pop(rec["id"], None)
@@ -108,6 +128,10 @@ def main_from_events(path: str, lanes: int = 0) -> int:
             retires.append(rec.get("attrs") or {})
         elif ev == "event" and rec.get("name") == "request_shed":
             sheds.append(rec.get("attrs") or {})
+        elif ev == "event" and rec.get("name") == "engine_spinup":
+            spinups.append(rec.get("attrs") or {})
+        elif ev == "event" and rec.get("name") == "engine_park":
+            parks.append(rec.get("attrs") or {})
         elif ev == "event" and rec.get("name") == "checkpoint":
             checkpoints += 1
 
@@ -152,6 +176,56 @@ def main_from_events(path: str, lanes: int = 0) -> int:
                           for b in WASTE_BUCKETS):
         buckets = {b: tot(b) for b in WASTE_BUCKETS}
         print_attribution(buckets, tot("wsteps"), lanes)
+    # round-21 per-engine decomposition (heterogeneous dispatch pool):
+    # every phase span and retire event a pool engine emits carries
+    # the engine=<keystr> label, and the pool emits engine_spinup /
+    # engine_park lifecycle events — so an offline timeline decomposes
+    # per engine with no pool imports, the same way the summary's
+    # `engines` block does online
+    eng_labels = {str(r["engine"]) for r in phase_rows
+                  if r.get("engine")}
+    if spinups or parks or len(eng_labels) > 1:
+        print("=== per-engine decomposition (dispatch pool) ===")
+
+        def _row():
+            return {"phases": 0, "tasks": 0, "wtasks": 0, "wsteps": 0,
+                    "retired": 0, "spinups": 0, "unparks": 0,
+                    "parks": 0, "hist": Histogram(PHASE_BUCKETS)}
+
+        per = {}
+        for r in phase_rows:
+            row = per.setdefault(str(r.get("engine", "?")), _row())
+            row["phases"] += 1
+            for k in ("tasks", "wtasks", "wsteps"):
+                row[k] += int(r.get(k, 0))
+        # rid-dedup before attributing: a resumed timeline replays
+        # post-snapshot retire events (same rule as the SLO block)
+        for r in {x.get("rid"): x for x in retires}.values():
+            row = per.setdefault(str(r.get("engine", "?")), _row())
+            row["retired"] += 1
+            row["hist"].observe(int(r.get("latency_phases", 0)))
+        for s in spinups:
+            row = per.setdefault(str(s.get("engine", "?")), _row())
+            row["unparks" if s.get("resumed") else "spinups"] += 1
+        for s in parks:
+            per.setdefault(str(s.get("engine", "?")),
+                           _row())["parks"] += 1
+        for e, row in sorted(per.items()):
+            eff = (f" lane_eff={row['wtasks'] / (row['wsteps'] * lanes):.4f}"
+                   if lanes and row["wsteps"] else "")
+            life = (f" spinups={row['spinups']} parks={row['parks']} "
+                    f"unparks={row['unparks']}")
+            h = row["hist"]
+            lat = (f" retire p50={h.quantile(0.5)} "
+                   f"p99={h.quantile(0.99)}" if h.count else "")
+            print(f"  {e}: phases={row['phases']} "
+                  f"tasks={row['tasks']} retired={row['retired']}"
+                  f"{eff}{lat}{life}")
+        n_ret = len({x.get("rid") for x in retires})
+        n_per = sum(r["retired"] for r in per.values())
+        print(f"  reconciliation: {n_per} per-engine retires vs "
+              f"{n_ret} distinct retire rids -> "
+              f"{'OK' if n_per == n_ret else 'FAIL'}")
     # round-16 multi-tenant SLO decomposition: per-class tail latency
     # + per-tenant retired/failed/shed accounting, offline from the
     # same retire/request_shed events serve emitted — identical

@@ -148,6 +148,43 @@ MULTIHOST_FAULTS = ({"kind": "host_loss", "at": 1, "chip": 1},)
 GATE_SPILL_ABS_TOL = 0.25
 GATE_REDEAL_WALL_BUDGET_S = 10.0
 
+# Round 21: the heterogeneous-shape dispatcher proxy leg (bench.py
+# stream --hetero / tools/ci.sh hetero step). A seeded open-loop
+# mixed-SHAPE stream — every request carries eps/rule/theta routing
+# keys cycling over >= 3 distinct (eps band, rule, theta bucket)
+# compile statics — through the EngineDispatcher pool
+# (runtime/dispatch.py), against the SERIALIZED one-engine-at-a-time
+# baseline: the same requests partitioned by engine key and run to
+# completion group after group. The gated numbers are all
+# schedule-counted (bit-stable in interpret mode): the pool recompile
+# count (THE invariant — 0), the completed+shed accounting, the
+# per-engine-sums-to-pool reconciliation, and the work-conserving
+# schedule's turn-count speedup + retire-latency win over serialized.
+HETERO_FAMILY = "sin_recip_scaled"
+HETERO_BOUNDS = (1e-2, 1.0)
+HETERO_K = 16
+HETERO_RATE = 4.0
+HETERO_SEED = 31
+HETERO_MAX_ENGINES = 4
+HETERO_SLOTS = 4
+HETERO_EKW = dict(chunk=1 << 10, capacity=1 << 16, lanes=256,
+                  roots_per_lane=2, refill_slots=2, seg_iters=32,
+                  min_active_frac=0.05)
+# the distinct compile statics the acceptance names, cycled over the
+# request stream (trapezoid t1 at two eps bands, a theta BATCH bucket,
+# and a simpson engine)
+HETERO_SHAPES = (
+    {"eps": 1e-6},                          # -> e-6:trapezoid:t1
+    {"eps": 1e-7},                          # -> e-7:trapezoid:t1
+    {"eps": 1e-6, "batch": 2},              # -> e-6:trapezoid:t2
+    {"eps": 1e-6, "rule": "simpson"},       # -> e-6:simpson:t1
+)
+# gate bands: the turn-count speedup over serialized must stay > 1
+# (the work-conserving claim itself) and within 25% of the reference;
+# pool p99 retire latency (turns) may grow <= 25% over it
+GATE_DISPATCH_SPEEDUP_TOL = 0.25
+GATE_DISPATCH_P99_TOL = 0.25
+
 # gate tolerances (the "stated tolerance" of the round-11 acceptance)
 GATE_STEP_TOL = 0.5      # kernel_steps / boundaries may grow <= 1.5x
 GATE_EFF_TOL = 0.15      # lane_efficiency may drop <= 15% (relative)
@@ -575,6 +612,203 @@ def run_multihost_proxies() -> dict:
         eng.close()
 
 
+def _hetero_requests():
+    """The seeded mixed-shape request stream: (theta, bounds, kwargs)
+    triples whose kwargs carry the per-request eps/rule routing keys,
+    plus the open-loop arrival schedule (pool turns)."""
+    import numpy as np
+
+    rng = np.random.default_rng(HETERO_SEED)
+    gaps = rng.exponential(1.0 / HETERO_RATE, HETERO_K)
+    arrivals = [int(p) for p in
+                np.floor(np.cumsum(gaps) - gaps[0]).astype(int)]
+    reqs = []
+    for i in range(HETERO_K):
+        shape = HETERO_SHAPES[i % len(HETERO_SHAPES)]
+        b = int(shape.get("batch", 1))
+        if b > 1:
+            theta = tuple(1.0 + (i + j / 8.0) / HETERO_K
+                          for j in range(b))
+        else:
+            theta = 1.0 + i / HETERO_K
+        kw = {"eps": shape["eps"]}
+        if "rule" in shape:
+            kw["rule"] = shape["rule"]
+        reqs.append((theta, HETERO_BOUNDS, kw))
+    return reqs, arrivals
+
+
+def run_hetero_dispatch_proxies() -> dict:
+    """The ``bench.py stream --hetero`` leg, standalone (one
+    definition for the bench record, the committed gate reference, and
+    the CI --gate-run measurement — the :func:`run_quick_proxies`
+    ownership contract).
+
+    Drives the seeded mixed-shape stream through the round-21
+    :class:`~ppls_tpu.runtime.dispatch.EngineDispatcher` (>= 3
+    distinct engine keys, zero recompiles end-to-end), then runs the
+    SERIALIZED baseline — the same requests partitioned by engine key,
+    each group's engine run to completion one after another — and
+    reports the schedule-counted comparison: pool turns vs summed
+    serialized phases, mean/p99 retire latency in turns for both. The
+    work-conserving round-robin must beat serialized on both (the
+    perf claim this tier exists for, assertable in interpret mode)."""
+    import numpy as np
+
+    from ppls_tpu.config import Rule
+    from ppls_tpu.runtime.dispatch import (EngineDispatcher, EngineKey,
+                                           canonical_key)
+    from ppls_tpu.runtime.stream import StreamEngine
+
+    reqs, arrivals = _hetero_requests()
+    keys = sorted({str(canonical_key(r[2]["eps"],
+                                     r[2].get("rule", "trapezoid"),
+                                     r[0])) for r in reqs})
+
+    disp = EngineDispatcher(HETERO_FAMILY, slots=HETERO_SLOTS,
+                            max_engines=HETERO_MAX_ENGINES,
+                            engine_kw=dict(HETERO_EKW))
+    res = disp.run(reqs, arrival_phase=arrivals)
+    lat = [int(c.retire_phase) - int(c.submit_phase)
+           for c in res.completed]
+    summary = disp.engines_summary()
+    per_engine_completed = sum(v["completed"]
+                               for v in summary.values())
+    per_engine_shed = sum(v["shed"] for v in summary.values())
+
+    # serialized one-engine-at-a-time baseline: group by engine key,
+    # run each group's engine to completion before the next starts
+    # (all of a group's requests available up front — the generous
+    # reading of serialized, so beating it is the strong claim); a
+    # request's serialized retire latency in GLOBAL phases is the
+    # phases burned by every earlier group plus its own retire phase
+    groups: dict = {}
+    for (theta, bounds, kw2) in reqs:
+        k = str(canonical_key(kw2["eps"],
+                              kw2.get("rule", "trapezoid"), theta))
+        groups.setdefault(k, []).append((theta, bounds))
+    ser_phases = 0
+    ser_lat: List[int] = []
+    for keystr in sorted(groups):
+        key = EngineKey.parse(keystr)
+        eng = StreamEngine(HETERO_FAMILY, key.eps,
+                           slots=HETERO_SLOTS, rule=Rule(key.rule),
+                           theta_block=key.theta_block, **HETERO_EKW)
+        r = eng.run(groups[keystr])
+        for c in r.completed:
+            ser_lat.append(ser_phases + int(c.retire_phase))
+        ser_phases += int(r.phases)
+
+    speedup = ser_phases / max(int(res.phases), 1)
+    return {
+        "metric": "heterogeneous dispatch proxies",
+        "family": HETERO_FAMILY,
+        "k_requests": HETERO_K,
+        "max_engines": HETERO_MAX_ENGINES,
+        "slots": HETERO_SLOTS,
+        "engine_keys": keys,
+        "n_engine_keys": len(keys),
+        "recompiles": int(disp.recompiles()),
+        "completed": len(res.completed),
+        "shed": len(res.shed),
+        "accounting_ok": (len(res.completed) + len(res.shed)
+                          == HETERO_K),
+        "engines_reconcile": (
+            per_engine_completed == len(res.completed)
+            and per_engine_shed == len(res.shed)),
+        "requests_per_sec": round(res.requests_per_sec, 3),
+        "hetero_turns": int(res.phases),
+        "serialized_phases_total": int(ser_phases),
+        "turns_speedup_vs_serialized": round(speedup, 3),
+        "mean_latency_turns": round(float(np.mean(lat)), 3),
+        "p99_latency_turns": round(
+            float(np.percentile(lat, 99)), 3),
+        "serialized_mean_latency_turns": round(
+            float(np.mean(ser_lat)), 3),
+        "serialized_p99_latency_turns": round(
+            float(np.percentile(ser_lat, 99)), 3),
+        "latency_beats_serialized": bool(
+            float(np.mean(lat)) <= float(np.mean(ser_lat))),
+        "per_engine": {
+            k: {f: v[f] for f in ("state", "phases", "completed",
+                                  "shed", "routed",
+                                  "p99_latency_turns")}
+            for k, v in summary.items()},
+        "wall_s": round(res.wall_s, 3),
+    }
+
+
+def gate_dispatch_record(cur: dict, ref: dict) -> List[str]:
+    """Round-21 heterogeneous-dispatch gate: zero recompiles on the
+    mixed-shape stream (THE invariant), the completed+shed accounting
+    and per-engine-sums-to-pool reconciliation, >= 3 distinct engine
+    keys, the work-conserving schedule's turn-count speedup over the
+    serialized baseline (> 1, within GATE_DISPATCH_SPEEDUP_TOL of the
+    reference), and pool p99 retire latency within
+    GATE_DISPATCH_P99_TOL of it. A reference WITHOUT a dispatch block
+    skips the gate (pre-round-21 refs)."""
+    rd = (ref or {}).get("dispatch")
+    if not isinstance(rd, dict):
+        return []
+    cd = (cur or {}).get("dispatch")
+    if not isinstance(cd, dict):
+        # an offline --gate FILE record without the block; the CI
+        # path uses --gate-run, which always re-measures
+        return []
+    fails: List[str] = []
+    rc = cd.get("recompiles")
+    if not isinstance(rc, int) or rc != 0:
+        fails.append(
+            f"REGRESSION dispatch: recompiles={rc!r} on the "
+            f"mixed-shape stream (the zero-recompile routing "
+            f"invariant broke — some engine re-traced its program)")
+    if cd.get("accounting_ok") is False:
+        fails.append("REGRESSION dispatch: completed + shed != "
+                     "offered requests (lost or duplicated work "
+                     "across the pool)")
+    if cd.get("engines_reconcile") is False:
+        fails.append("REGRESSION dispatch: per-engine completed/shed "
+                     "counts do not sum to the pool ledger")
+    nk = cd.get("n_engine_keys")
+    if not isinstance(nk, int) or nk < 3:
+        fails.append(
+            f"REGRESSION dispatch: only {nk!r} distinct engine keys "
+            f"driven (the acceptance floor is 3 — the workload "
+            f"stopped being heterogeneous)")
+    sp, sp_ref = cd.get("turns_speedup_vs_serialized"), rd.get(
+        "turns_speedup_vs_serialized")
+    if not isinstance(sp, (int, float)):
+        fails.append("dispatch proxy missing "
+                     "turns_speedup_vs_serialized")
+    else:
+        if sp <= 1.0:
+            fails.append(
+                f"REGRESSION dispatch: work-conserving schedule no "
+                f"longer beats the serialized one-engine-at-a-time "
+                f"baseline (turn speedup {sp:.2f}x <= 1)")
+        if isinstance(sp_ref, (int, float)) \
+                and sp < sp_ref * (1.0 - GATE_DISPATCH_SPEEDUP_TOL):
+            fails.append(
+                f"REGRESSION dispatch: turn speedup {sp:.2f}x "
+                f"dropped >{GATE_DISPATCH_SPEEDUP_TOL:.0%} below the "
+                f"reference's {sp_ref:.2f}x; re-record with "
+                f"--update-ref if intended")
+    if cd.get("latency_beats_serialized") is False:
+        fails.append("REGRESSION dispatch: mean retire latency "
+                     "(turns) no longer beats the serialized "
+                     "baseline")
+    p99, p99_ref = cd.get("p99_latency_turns"), rd.get(
+        "p99_latency_turns")
+    if isinstance(p99, (int, float)) \
+            and isinstance(p99_ref, (int, float)) \
+            and p99 > p99_ref * (1.0 + GATE_DISPATCH_P99_TOL):
+        fails.append(
+            f"REGRESSION dispatch: pool p99 retire latency "
+            f"{p99:.1f} turns grew >{GATE_DISPATCH_P99_TOL:.0%} "
+            f"over the reference's {p99_ref:.1f}")
+    return fails
+
+
 def gate_multihost_record(cur: dict, ref: dict) -> List[str]:
     """Round-18 multi-host gate: the zero-lost-acks accounting and
     the per-request bit-identity invariants must hold, spillover must
@@ -914,6 +1148,7 @@ def main(argv: List[str]) -> int:
             "solo_max_abs_err")}
         rec["stream"] = run_stream_slo_proxies()
         rec["multihost"] = run_multihost_proxies()
+        rec["dispatch"] = run_hetero_dispatch_proxies()
         with open(ref_path, "w", encoding="utf-8") as fh:
             json.dump(rec, fh, indent=1, sort_keys=True)
             fh.write("\n")
@@ -922,6 +1157,7 @@ def main(argv: List[str]) -> int:
         print(json.dumps(rec["theta"]))
         print(json.dumps(rec["stream"]))
         print(json.dumps(rec["multihost"]))
+        print(json.dumps(rec["dispatch"]))
         return 0
 
     if gate_path or do_gate_run:
@@ -952,11 +1188,18 @@ def main(argv: List[str]) -> int:
                 # re-measure so the redeal/spillover/zero-lost-acks
                 # invariants stay regression-guarded
                 cur["multihost"] = run_multihost_proxies()
+            if isinstance(ref.get("dispatch"), dict):
+                # round 21: the ref carries the heterogeneous-
+                # dispatch proxies — re-measure so the zero-recompile
+                # and work-conserving-beats-serialized invariants
+                # stay regression-guarded
+                cur["dispatch"] = run_hetero_dispatch_proxies()
         fails = gate_record(cur, ref, tolerance=tolerance,
                             eff_tolerance=eff_tol) \
             + gate_theta_record(cur, ref) \
             + gate_stream_record(cur, ref) \
             + gate_multihost_record(cur, ref) \
+            + gate_dispatch_record(cur, ref) \
             + gate_tuning_record(load_tuning_table_for_gate())
         for msg in fails:
             print(f"bench_history: GATE {msg}", file=sys.stderr)

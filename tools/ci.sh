@@ -61,11 +61,24 @@
 #       pass the round-19 rid-linkage check (--rid-linkage) and 5c's
 #       timeline must decompose exactly through
 #       tools/analyze_request.py --check
+#   5e. HETEROGENEOUS DISPATCH under chaos (round 21): a mixed-shape
+#       workload (two eps bands, a simpson request, a theta-block-2
+#       batch — 4 distinct engine keys — plus a malformed line)
+#       through `serve --dispatch --supervise` with the committed
+#       crash plan (tools/chaos_plan_dispatch.json). The summary must
+#       hold the pool invariants: recompiles == 0 across mixed shapes
+#       AND across the kill-and-resume, >= 3 engine keys live,
+#       per-engine completions reconciling, the malformed line
+#       rejected per-line; ledger + timeline validate via
+#       check_artifacts --serve / --events --rid-linkage
 #   6. bench observatory: tools/bench_history.py --check over the
 #      committed round artifacts + the quick-proxy regression gate
 #      (device-counted proxies vs tools/bench_quick_ref.json; round
 #      18 adds the multihost block — redeal wall, spillover-engaged
-#      fraction, zero-lost-acks + bit-identity invariants)
+#      fraction, zero-lost-acks + bit-identity invariants; round 21
+#      adds the dispatch block — zero recompiles on the mixed-shape
+#      pool, per-engine reconciliation, work-conserving speedup floor
+#      vs the serialized one-engine-at-a-time baseline)
 #   6c. bench.py multihost record schema check (kill-one-host under
 #       overload on the 2-process cluster; exit nonzero when
 #       spillover failed to engage or areas diverged)
@@ -474,6 +487,78 @@ if [ "$mp_fail" -ne 0 ]; then
     FAILURES=$((FAILURES + 1))
 else
     echo "ci: multi-process sweep OK"
+fi
+
+# --- 5e. HETEROGENEOUS DISPATCH under chaos (round 21) ---
+# A deterministic mixed-shape workload (4 distinct engine keys: two
+# eps bands x trapezoid, a simpson request, a theta-block-2 batch,
+# plus one malformed line that must get a per-line rejection) through
+# `serve --dispatch --supervise` with the committed crash plan
+# (tools/chaos_plan_dispatch.json kills the WHOLE pool at the close
+# edge of turn 1, right after that turn's coordinated cut). The
+# supervisor must resume the EngineDispatcher from the manifest and
+# drain; the summary must show the pool invariants — recompiles: 0
+# across mixed shapes AND across the kill-and-resume, >= 3 engine
+# keys actually spun up, per-engine completions reconciling with the
+# total — and the ledger + events timeline validate through
+# check_artifacts --serve / --events --rid-linkage.
+step "serve --dispatch heterogeneous pool under chaos (crash + resume)"
+HD_DIR="$(mktemp -d)"
+hd_fail=0
+cat > "$HD_DIR/reqs.jsonl" <<'EOF'
+{"theta": 1.0, "bounds": [1e-2, 1.0], "arrival_phase": 0}
+{"theta": 1.05, "bounds": [1e-2, 1.0], "eps": 1e-7, "arrival_phase": 0}
+{"theta": 1.1, "bounds": [1e-2, 1.0], "rule": "simpson", "arrival_phase": 0}
+{"theta": [1.15, 1.2], "bounds": [1e-2, 1.0], "arrival_phase": 1}
+{"theta": 1.25, "bounds": [1e-2, 1.0], "arrival_phase": 1}
+{"theta": 1.3, "bounds": [1e-2, 1.0], "eps": 1e-7, "arrival_phase": 2}
+{"theta": 1.35, "bounds": [1e-2, 1.0], "rule": "simpson", "arrival_phase": 2}
+{"theta": [1.4, 1.45], "bounds": [1e-2, 1.0], "arrival_phase": 3}
+{"theta": 1.5, "bounds": [1e-2, 1.0], "eps": 1e-20}
+EOF
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m ppls_tpu serve \
+        --dispatch --max-engines 4 --supervise \
+        --requests "$HD_DIR/reqs.jsonl" \
+        --eps 1e-6 -a 1e-2 -b 1.0 --slots 4 --chunk 512 \
+        --capacity 65536 --lanes 256 --refill-slots 2 \
+        --checkpoint "$HD_DIR/hd.ckpt" --checkpoint-every 1 \
+        --watchdog 120 --events "$HD_DIR/hd.jsonl" \
+        --fault-plan @tools/chaos_plan_dispatch.json \
+        > "$HD_DIR/hd.out" 2> "$HD_DIR/hd.err"; then
+    python - "$HD_DIR/hd.out" <<'PYEOF' || hd_fail=1
+import json, sys
+lines = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+s = lines[-1]
+assert s.get("summary") and s.get("supervised"), "not supervised"
+assert s.get("dispatch") is True, "summary lacks the dispatch block"
+# THE pool invariant: mixed-shape traffic + kill-and-resume, zero
+# recompiles (every shape change is a pool ROUTE, never a recompile)
+assert s["recompiles"] == 0, ("recompiles", s["recompiles"])
+assert s["completed"] == 8, s["completed"]
+keys = s["engines"]
+assert len(keys) >= 3, ("engine keys", sorted(keys))
+assert sum(e["completed"] for e in keys.values()) == 8, keys
+assert s.get("attempts", 1) >= 2, "crash did not force a resume"
+kinds = {e["kind"] for e in s["faults_injected"]}
+assert kinds == {"crash"}, kinds
+rej = [r for r in lines if r.get("rejected")]
+assert len(rej) == 1 and "eps" in rej[0]["error"], rej
+print(f"ci: hetero dispatch OK ({len(keys)} engine keys, "
+      "recompiles 0 across crash-resume, malformed line rejected)")
+PYEOF
+else
+    echo "ci: serve --dispatch chaos run FAILED"
+    hd_fail=1
+fi
+python tools/check_artifacts.py --serve "$HD_DIR/hd.out" \
+    --events "$HD_DIR/hd.jsonl" --unbalanced-ok --rid-linkage \
+    || hd_fail=1
+rm -rf "$HD_DIR"
+if [ "$hd_fail" -ne 0 ]; then
+    echo "ci: heterogeneous dispatch leg FAILED"
+    FAILURES=$((FAILURES + 1))
+else
+    echo "ci: heterogeneous dispatch leg OK"
 fi
 
 # --- 6. bench observatory: trajectory check + quick-proxy gate ---
