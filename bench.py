@@ -1426,7 +1426,10 @@ def main_stream():
         ok = (hd["recompiles"] == 0 and hd["accounting_ok"]
               and hd["engines_reconcile"]
               and hd["n_engine_keys"] >= 3
-              and hd["turns_speedup_vs_serialized"] > 1.0)
+              and hd["turns_speedup_vs_serialized"] > 1.0
+              and hd.get("lease_balanced") is True
+              and float(hd.get("overlap_fraction", 0.0)) > 0.0
+              and float(hd.get("turns_speedup_vs_nolease", 0.0)) >= 1.2)
         return 0 if ok else 1
     if "--tenants" in sys.argv:
         from tools.bench_history import run_stream_slo_proxies

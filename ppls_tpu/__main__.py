@@ -534,6 +534,24 @@ def build_parser() -> argparse.ArgumentParser:
                           "victim through a checkpoint and resumes "
                           "it bit-identically when its shape returns "
                           "(default 4)")
+    srv.add_argument("--lease", action="store_true",
+                     help="round 22: slot-credit leasing across the "
+                          "--dispatch pool — engines with idle slots "
+                          "(and parked engines) donate their per-turn "
+                          "phase credit to the deepest-backlog engine "
+                          "(deterministic donor/borrower policy with "
+                          "hysteresis; the lease ledger rides the "
+                          "coordinated snapshot so kill-and-resume "
+                          "replays every grant bit-identically)")
+    srv.add_argument("--overlap-boundaries", action="store_true",
+                     dest="overlap_boundaries",
+                     help="round 22: overlapped phase boundaries — "
+                          "launch every due engine's compiled cycle "
+                          "before blocking on the first stats fetch "
+                          "(JAX async dispatch) and run checkpoint "
+                          "serialization on a background writer that "
+                          "keeps the atomic-rename commit point; "
+                          "requires --dispatch")
     srv.add_argument("--json", action="store_true", dest="as_json")
 
     qmc = sub.add_parser(
@@ -861,6 +879,17 @@ def _main_serve(args) -> int:
             "overflow is the POOL's shed policy; the CPU spillover "
             "executor is per-engine); drop one of the flags")
 
+    if not dispatch and (getattr(args, "lease", False)
+                         or getattr(args, "overlap_boundaries", False)):
+        # both knobs are pool-level boundary policy: leasing moves
+        # credits BETWEEN engines and overlap interleaves one engine's
+        # host boundary with another's device compute — neither means
+        # anything with a single engine
+        raise SystemExit(
+            "--lease/--overlap-boundaries require --dispatch (they "
+            "are cross-engine pool policies); add --dispatch or drop "
+            "the flags")
+
     kw = dict(rule=Rule(args.rule), slots=args.slots, chunk=args.chunk,
               capacity=args.capacity, refill_slots=args.refill_slots,
               scout_dtype=args.scout_dtype,
@@ -991,6 +1020,9 @@ def _main_serve(args) -> int:
                 checkpoint_every=args.checkpoint_every,
                 telemetry=tel,
                 slo_config=getattr(args, "slo_config", None),
+                lease=bool(getattr(args, "lease", False)),
+                overlap_boundaries=bool(
+                    getattr(args, "overlap_boundaries", False)),
                 fault_injector=injector, quarantine=quarantine,
                 on_shed=_print_shed, engine_kw=engine_kw)
             if resuming:
@@ -1286,6 +1318,10 @@ def _main_serve(args) -> int:
             summary["max_engines"] = args.max_engines
             summary["recompiles"] = eng.recompiles()
             summary["engines"] = eng.engines_summary()
+            # round 22: lease ledger + boundary-overlap decomposition;
+            # emitted whenever the pool runs so the chaos leg can
+            # assert donated == received across a kill-and-resume
+            summary["leases"] = eng.lease_summary()
         if holder.get("stopped"):
             summary["terminated"] = holder["stopped"]
         failed = sum(1 for c in res.completed if c.failed)

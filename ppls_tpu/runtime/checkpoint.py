@@ -22,7 +22,9 @@ import hashlib
 import json
 import os
 import tempfile
-from typing import Optional, Tuple
+import threading
+from collections import deque
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
@@ -95,6 +97,117 @@ def _chaos_verify_on_write(path: str) -> None:
     with np.load(path) as z:
         meta = json.loads(bytes(z["meta"]).decode())
         _verify_payload(path, z, meta)
+
+
+class CheckpointWriter:
+    """Single-thread background snapshot writer (round 22).
+
+    Overlapped phase boundaries move checkpoint SERIALIZATION off the
+    turn's critical path while keeping every durability contract the
+    sync path has:
+
+    * jobs run on ONE worker thread in submit (FIFO) order, so the
+      dispatcher's manifest-last commit discipline survives verbatim —
+      per-engine cut files submitted before the manifest land before
+      the manifest;
+    * each job still ends in the same mkstemp -> ``os.replace`` atomic
+      rename, so readers never observe a torn file;
+    * a failed job parks its exception and the NEXT ``submit``/
+      ``flush`` re-raises it at the call site (a checkpoint that
+      cannot be written must fail the run, not rot silently);
+    * ``flush`` drains the queue — every resume/peek path flushes the
+      module writer first, so a reader can never race a pending write.
+
+    GL11: all shared state (queue, busy flag, parked error) is guarded
+    by the one condition's lock; the worker never calls back into
+    engine code.
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._busy = False
+        self._err: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="ppls-ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._q:
+                    return
+                job = self._q.popleft()
+                self._busy = True
+            try:
+                job()
+            except BaseException as e:  # noqa: BLE001 — park & re-raise
+                with self._cv:
+                    if self._err is None:
+                        self._err = e
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _raise_pending(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError(
+                "background checkpoint write failed") from err
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Enqueue ``job`` (any callable) for FIFO execution; raises a
+        previously parked write error first."""
+        with self._cv:
+            self._raise_pending()
+            if self._closed:
+                raise RuntimeError(
+                    "CheckpointWriter is closed; cannot submit")
+            self._q.append(job)
+            self._cv.notify_all()
+
+    def flush(self) -> None:
+        """Block until every submitted job has completed; re-raise any
+        deferred write error."""
+        with self._cv:
+            while self._q or self._busy:
+                self._cv.wait()
+            self._raise_pending()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join()
+        with self._cv:
+            self._raise_pending()
+
+
+_WRITER: Optional[CheckpointWriter] = None
+_WRITER_LOCK = threading.Lock()
+
+
+def background_writer() -> CheckpointWriter:
+    """The process-wide background snapshot writer (lazily started)."""
+    global _WRITER
+    with _WRITER_LOCK:
+        if _WRITER is None:
+            _WRITER = CheckpointWriter()
+        return _WRITER
+
+
+def flush_background_writer() -> None:
+    """Drain the module writer if one was ever started (no-op
+    otherwise). Called by every snapshot READ path so resume/peek can
+    never observe a half-submitted coordinated cut."""
+    with _WRITER_LOCK:
+        w = _WRITER
+    if w is not None:
+        w.flush()
 
 
 def _config_identity(config: QuadConfig) -> dict:
@@ -212,20 +325,11 @@ def _family_identity(engine: str, fname: str, eps: float, m: int,
     }
 
 
-def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
-                           count: int, acc: np.ndarray,
-                           totals: dict) -> None:
-    """Atomically snapshot a device family run at a leg boundary.
-
-    ``bag_cols`` maps column name -> live-prefix array (host); ``totals``
-    are the accumulated integer counters (tasks, splits, ...).
-    """
-    payload = {"acc": np.asarray(acc, dtype=np.float64)}
-    payload.update({f"bag_{k}": np.asarray(v)
-                    for k, v in bag_cols.items()})
-    meta = {"identity": identity, "count": int(count), "totals": totals,
-            "format_version": CKPT_FORMAT_VERSION,
-            "checksums": _payload_checksums(payload)}
+def _write_family_container(path: str, meta_blob: bytes,
+                            payload: dict) -> None:
+    """The atomic-rename commit point shared by the sync and
+    background save paths: mkstemp in the destination directory,
+    ``np.savez`` the container, ``os.replace`` onto ``path``."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
@@ -233,8 +337,7 @@ def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
         with os.fdopen(fd, "wb") as fh:
             np.savez(
                 fh,
-                meta=np.frombuffer(json.dumps(meta).encode(),
-                                   dtype=np.uint8),
+                meta=np.frombuffer(meta_blob, dtype=np.uint8),
                 **payload,
             )
         os.replace(tmp, path)
@@ -244,6 +347,38 @@ def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
     _chaos_verify_on_write(path)
 
 
+def save_family_checkpoint(path: str, *, identity: dict, bag_cols: dict,
+                           count: int, acc: np.ndarray, totals: dict,
+                           writer: Optional[CheckpointWriter] = None,
+                           ) -> None:
+    """Atomically snapshot a device family run at a leg boundary.
+
+    ``bag_cols`` maps column name -> live-prefix array (host); ``totals``
+    are the accumulated integer counters (tasks, splits, ...).
+
+    With ``writer`` the container write runs on the background thread
+    (round 22, overlapped boundaries). The meta record — identity,
+    count, totals, checksums — is serialized EAGERLY here, so callers
+    may keep mutating their totals dict after submit; only the
+    mkstemp/savez/rename I/O is deferred. Payload arrays are host
+    numpy copies by construction (``np.asarray`` of already-fetched
+    host state), so the deferred write sees exactly the submit-time
+    bytes.
+    """
+    payload = {"acc": np.asarray(acc, dtype=np.float64)}
+    payload.update({f"bag_{k}": np.asarray(v)
+                    for k, v in bag_cols.items()})
+    meta = {"identity": identity, "count": int(count), "totals": totals,
+            "format_version": CKPT_FORMAT_VERSION,
+            "checksums": _payload_checksums(payload)}
+    meta_blob = json.dumps(meta).encode()
+    if writer is not None:
+        writer.submit(
+            lambda: _write_family_container(path, meta_blob, payload))
+        return
+    _write_family_container(path, meta_blob, payload)
+
+
 def peek_checkpoint_identity(path: str) -> dict:
     """Read ONLY the stored identity of a snapshot (round 21): the
     dispatcher's pool manifest embeds its engine-key set in the
@@ -251,6 +386,7 @@ def peek_checkpoint_identity(path: str) -> dict:
     the full expected identity to load against. Integrity is still
     enforced by the subsequent :func:`load_family_checkpoint` — this
     peek commits to nothing."""
+    flush_background_writer()
     try:
         with np.load(path) as z:
             meta = json.loads(bytes(z["meta"]).decode())
@@ -288,6 +424,7 @@ def load_family_checkpoint(path: str, identity: dict, *,
     in owns the request-granularity redeal
     (``cluster.ClusterStreamEngine.resume``).
     """
+    flush_background_writer()
     try:
         with np.load(path) as z:
             meta = json.loads(bytes(z["meta"]).decode())

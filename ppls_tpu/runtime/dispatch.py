@@ -231,6 +231,10 @@ class _EngineTelemetry(Telemetry):
         self._label = label
         self._rid_map: Dict[int, int] = {}   # engine rid -> pool grid
         self._local_entries = 0              # attributed cache entries
+        # round 22: set by the turn loop around a LEASED phase launch
+        # so the phase span records it ran on a donated credit (the
+        # occupancy tool reconciles these against the lease grants)
+        self._lease_phase = False
         # one timeline: the pool's tracer replaces the private one the
         # base constructor made (which is disabled and writes nowhere)
         self.tracer = pool.telemetry.tracer
@@ -246,6 +250,8 @@ class _EngineTelemetry(Telemetry):
 
     def span(self, name: str, **attrs):
         attrs.setdefault("engine", self._label)
+        if name == "phase" and self._lease_phase:
+            attrs.setdefault("leased", True)
         return self.tracer.span(name, **attrs)
 
     def event(self, name: str, **attrs) -> None:
@@ -321,6 +327,11 @@ class EngineDispatcher:
                  quarantine: bool = False,
                  on_shed=None,
                  interpret: Optional[bool] = None,
+                 lease: bool = False,
+                 lease_cap: int = 3,
+                 lease_patience: int = 1,
+                 overlap_boundaries: bool = False,
+                 checkpoint_background: Optional[bool] = None,
                  engine_kw: Optional[dict] = None):
         from ppls_tpu.models.integrands import get_family_ds
         self.family = family
@@ -336,6 +347,22 @@ class EngineDispatcher:
         self.park_patience = max(1, int(park_patience))
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = max(int(checkpoint_every), 1)
+        # round 22 (tentpole): slot-credit leasing + overlapped phase
+        # boundaries. Both are pure host-side BOUNDARY policy — they
+        # never touch a compile static, so compile-once (and the
+        # zero-recompile invariant) holds by construction. Neither is
+        # manifest identity: like queue_limit/quotas, a resume must be
+        # driven with the same flags for the schedule to replay.
+        self.lease = bool(lease)
+        self.lease_cap = max(1, int(lease_cap))
+        self.lease_patience = max(1, int(lease_patience))
+        self.overlap_boundaries = bool(overlap_boundaries)
+        # background checkpoint serialization rides the overlap flag
+        # by default (it IS the boundary-overlap story for the cut),
+        # but stays independently controllable
+        self.checkpoint_background = bool(
+            overlap_boundaries if checkpoint_background is None
+            else checkpoint_background)
         self.telemetry = telemetry if telemetry is not None \
             else Telemetry()
         self.fault_injector = fault_injector
@@ -374,6 +401,19 @@ class EngineDispatcher:
         self.completed: List[CompletedRequest] = []
         self.shed: List[ShedRecord] = []
         self.client_state: dict = {}
+
+        # round 22: the lease ledger — per-engine idle streaks (the
+        # donor hysteresis), cumulative donated/received credits, and
+        # the boundary/overlap tallies. All of it rides the
+        # coordinated snapshot so a resumed pool replays the identical
+        # lease decisions.
+        self._idle_streak: Dict[str, int] = {}
+        self._lease_given: Dict[str, int] = {}
+        self._lease_recv: Dict[str, int] = {}
+        self._boundaries = 0
+        self._overlapped = 0
+        self._boundary_wall = 0.0
+        self._overlap_wall = 0.0
 
         # compile attribution (module-global pjit cache; see wrapper)
         self._cache_entries_seen: Optional[int] = None
@@ -416,6 +456,22 @@ class EngineDispatcher:
             "ppls_dispatch_engine_parks_total",
             "LRU engine parks (checkpoint + evict), by engine key",
             ("engine",))
+        self._c_lease_donated = reg.counter(
+            "ppls_dispatch_lease_donated_total",
+            "phase credits donated to the lease pool, by donor "
+            "engine key", ("engine",))
+        self._c_lease_recv = reg.counter(
+            "ppls_dispatch_lease_received_total",
+            "leased phase credits received, by borrower engine key",
+            ("engine",))
+        self._c_boundary = reg.counter(
+            "ppls_dispatch_boundaries_total",
+            "engine phase boundaries the turn loop ran (host "
+            "fetch + retire bookkeeping)")
+        self._c_boundary_overlap = reg.counter(
+            "ppls_dispatch_boundaries_overlapped_total",
+            "phase boundaries whose host work ran while another "
+            "engine's launched cycle was still in flight")
         self._g_backlog = reg.gauge(
             "ppls_dispatch_backlog",
             "pool-scope shared backlog depth (unrouted requests)")
@@ -583,7 +639,8 @@ class EngineDispatcher:
         kw.update(slots=self.slots, rule=Rule(key.rule),
                   theta_block=key.theta_block,
                   interpret=self.interpret,
-                  quarantine=self.quarantine)
+                  quarantine=self.quarantine,
+                  checkpoint_background=self.checkpoint_background)
         return kw
 
     def _register_live(self, keystr: str, eng: StreamEngine) -> None:
@@ -780,10 +837,173 @@ class EngineDispatcher:
         if cands:
             self._ensure_engine(cands[0])
 
+    def _update_idle_streaks(self) -> None:
+        """Donor hysteresis state: consecutive turns each LIVE engine
+        has been drained (routing for this turn already ran, so a
+        just-fed engine resets here). Parked engines carry no streak —
+        they donate unconditionally."""
+        for keystr in self._order:
+            if self._engines[keystr].idle:
+                self._idle_streak[keystr] = \
+                    self._idle_streak.get(keystr, 0) + 1
+            else:
+                self._idle_streak[keystr] = 0
+
+    def _lease_schedule(self) -> Dict[str, int]:
+        """Deal this turn's phase credits. Base schedule: one credit
+        per live engine with work (the round-21 work-conserving turn).
+        With leasing on, engines with idle slots DONATE their turn
+        budget to the deepest-backlog engines:
+
+        * donors — every parked engine (infinitely idle, so they rank
+          first; their whole budget is the one phase they would run if
+          live), then live drained engines whose idle streak has
+          reached ``lease_patience`` (hysteresis: a one-turn gap never
+          thrashes credits), deepest streak first, key order breaking
+          ties;
+        * borrowers — live busy engines ranked by backlog depth
+          (pending + resident), key order breaking ties; credits deal
+          one at a time round-robin down that ranking, capped at
+          ``lease_cap`` extra credits per borrower per turn;
+        * undealt credits lapse (they are phase slots, not tokens).
+
+        Every input is host state the boundary already owns — the
+        policy is deterministic, and the grants it emits replay
+        bit-identically from the snapshot's lease ledger."""
+        credits = {k: (0 if self._engines[k].idle else 1)
+                   for k in self._order}
+        if not self.lease:
+            return credits
+        borrowers = sorted(
+            (k for k in self._order if not self._engines[k].idle),
+            key=lambda k: (-(self._engines[k].pending
+                             + self._engines[k].resident), k))
+        if not borrowers:
+            return credits
+        donors = sorted(self._parked) + sorted(
+            (k for k in self._order
+             if self._engines[k].idle
+             and self._idle_streak.get(k, 0) >= self.lease_patience),
+            key=lambda k: (-self._idle_streak.get(k, 0), k))
+        extra = {k: 0 for k in borrowers}
+        grants: Dict[Tuple[str, str], int] = {}
+        bi = 0
+        for donor in donors:
+            placed = False
+            for _ in range(len(borrowers)):
+                b = borrowers[bi % len(borrowers)]
+                bi += 1
+                if extra[b] < self.lease_cap:
+                    extra[b] += 1
+                    credits[b] += 1
+                    grants[(donor, b)] = grants.get((donor, b), 0) + 1
+                    placed = True
+                    break
+            if not placed:
+                break           # every borrower at cap: the rest lapse
+        for (donor, b), n in sorted(grants.items()):
+            self._lease_given[donor] = \
+                self._lease_given.get(donor, 0) + n
+            self._lease_recv[b] = self._lease_recv.get(b, 0) + n
+            self._c_lease_donated.labels(engine=donor).inc(n)
+            self._c_lease_recv.labels(engine=b).inc(n)
+            self.telemetry.event(
+                "lease_grant", turn=self.turn, donor=donor,
+                borrower=b, credits=n,
+                donor_parked=donor in self._parked)
+        return credits
+
+    def _note_phase(self, keystr: str) -> None:
+        self._last_used[keystr] = self.turn
+        self._c_phases.labels(engine=keystr).inc()
+
+    def _finish_phase(self, eng, keystr: str, token,
+                      in_flight: int) -> None:
+        """Run one engine's boundary (the PULL half) and tally it:
+        every finish is a boundary; a finish with other launched
+        cycles still in flight is an OVERLAPPED boundary — its host
+        work ran concurrently with device compute it did not wait on.
+        """
+        t0 = time.perf_counter()
+        eng.step_finish(token)
+        dt = time.perf_counter() - t0
+        self._boundaries += 1
+        self._c_boundary.inc()
+        self._boundary_wall += dt
+        if in_flight > 0:
+            self._overlapped += 1
+            self._c_boundary_overlap.inc()
+            self._overlap_wall += dt
+        self._note_phase(keystr)
+
+    def _run_turn_phases(self, credits: Dict[str, int]) -> int:
+        """Run this turn's phases per the credit schedule. Credits run
+        in ROUNDS: round r steps every engine holding more than r
+        credits, rotated by the turn index over the ELIGIBLE engines
+        only (round 22 fix: a drained/parked engine never occupies a
+        rotation slot, so it cannot burn a turn credit that a busy
+        engine would have used). An engine that drains mid-turn
+        forfeits its remaining credits — they are phase slots, not
+        carryover tokens.
+
+        With ``overlap_boundaries`` each round launches every
+        eligible engine's compiled cycle back-to-back (JAX async
+        dispatch returns before the device finishes), then runs the
+        boundaries LIFO — innermost launch first, so the tracer's
+        span nesting stays clean — with each boundary's host work
+        overlapping the still-in-flight peers' device compute."""
+        eligible = [k for k in self._order
+                    if credits.get(k, 0) > 0]
+        if not eligible:
+            return 0
+        start = self.turn % len(eligible)
+        rotated = eligible[start:] + eligible[:start]
+        stepped = 0
+        max_c = max(credits.values())
+        for r in range(max_c):
+            batch = []
+            for keystr in rotated:
+                if credits.get(keystr, 0) <= r:
+                    continue
+                eng = self._engines.get(keystr)
+                if eng is None or eng.idle:
+                    continue    # drained mid-turn: credits lapse
+                batch.append((keystr, eng))
+            if not batch:
+                break
+            if self.overlap_boundaries:
+                launched = []
+                for keystr, eng in batch:
+                    wrapper = self._wrappers[keystr]
+                    wrapper._lease_phase = r > 0
+                    try:
+                        token = eng.step_begin()
+                    finally:
+                        wrapper._lease_phase = False
+                    launched.append((keystr, eng, token))
+                for i in range(len(launched) - 1, -1, -1):
+                    keystr, eng, token = launched[i]
+                    self._finish_phase(eng, keystr, token,
+                                       in_flight=i)
+                stepped += len(launched)
+            else:
+                for keystr, eng in batch:
+                    wrapper = self._wrappers[keystr]
+                    wrapper._lease_phase = r > 0
+                    try:
+                        token = eng.step_begin()
+                    finally:
+                        wrapper._lease_phase = False
+                    self._finish_phase(eng, keystr, token,
+                                       in_flight=0)
+                    stepped += 1
+        return stepped
+
     def step(self) -> List[CompletedRequest]:
-        """One pool TURN: route, then one phase per live engine with
-        work (round-robin rotated by the turn index, drained engines
-        skipped), then collect retirements into the pool ledger."""
+        """One pool TURN: route, then run the credit schedule — one
+        phase per live engine with work, plus any leased credits
+        (round-robin rotated by the turn index over the eligible
+        engines), then collect retirements into the pool ledger."""
         t0 = time.perf_counter()
         n_dev = max(1, len(self._engines))
         if self.fault_injector is not None:
@@ -795,25 +1015,18 @@ class EngineDispatcher:
         self._shed_unmeetable()
         self._route()
         self._unpark_stranded()
-        stepped = 0
-        order = list(self._order)
-        if order:
-            start = self.turn % len(order)
-            for keystr in order[start:] + order[:start]:
-                eng = self._engines.get(keystr)
-                if eng is None or eng.idle:
-                    continue        # work-conserving: skip drained
-                eng.step()
-                stepped += 1
-                self._last_used[keystr] = self.turn
-                self._c_phases.labels(engine=keystr).inc()
+        self._update_idle_streaks()
+        credits = self._lease_schedule()
+        stepped = self._run_turn_phases(credits)
         retired = self._collect()
         self.turn += 1
         self._publish_gauges(step_wall_s=time.perf_counter() - t0)
         if self._slo is not None:
             self._slo.evaluate_slo(self.turn)
-        span.close(stepped=stepped, retired=len(retired),
-                   backlog=len(self._backlog))
+        span.close(stepped=stepped,
+                   leased=sum(max(0, c - 1)
+                              for c in credits.values()),
+                   retired=len(retired), backlog=len(self._backlog))
         if self.checkpoint_path and \
                 self.turn % self.checkpoint_every == 0:
             self.snapshot()
@@ -974,6 +1187,10 @@ class EngineDispatcher:
     def clear_snapshot(self) -> None:
         """Drop the whole coordinated cut: manifest first (no resume
         can see a half-deleted cut), then the per-engine files."""
+        if self.checkpoint_background:
+            from ppls_tpu.runtime.checkpoint import \
+                flush_background_writer
+            flush_background_writer()
         if self.checkpoint_path \
                 and os.path.exists(self.checkpoint_path):
             os.unlink(self.checkpoint_path)
@@ -1113,6 +1330,10 @@ class EngineDispatcher:
                 "routed": int(reg.value("ppls_dispatch_routed_total",
                                         engine=keystr)),
                 "p99_latency_turns": p99,
+                "lease_donated": int(
+                    self._lease_given.get(keystr, 0)),
+                "lease_received": int(
+                    self._lease_recv.get(keystr, 0)),
             }
         for keystr, info in sorted(self._parked.items()):
             p99 = self._h_engine_lat.labels(engine=keystr) \
@@ -1126,8 +1347,41 @@ class EngineDispatcher:
                 "routed": int(reg.value("ppls_dispatch_routed_total",
                                         engine=keystr)),
                 "p99_latency_turns": p99,
+                "lease_donated": int(
+                    self._lease_given.get(keystr, 0)),
+                "lease_received": int(
+                    self._lease_recv.get(keystr, 0)),
             }
         return out
+
+    def lease_summary(self) -> dict:
+        """The lease/overlap block for the serve summary and the
+        bench record: cumulative donated/received credits (which must
+        balance — every grant is one donor credit landing on one
+        borrower), the boundary tallies, and the overlap fractions
+        (count-weighted and wall-weighted)."""
+        donated = sum(self._lease_given.values())
+        received = sum(self._lease_recv.values())
+        return {
+            "enabled": bool(self.lease),
+            "overlap_boundaries": bool(self.overlap_boundaries),
+            "donated": int(donated),
+            "received": int(received),
+            "balanced": donated == received,
+            "by_donor": {k: int(v) for k, v in
+                         sorted(self._lease_given.items())},
+            "by_borrower": {k: int(v) for k, v in
+                            sorted(self._lease_recv.items())},
+            "boundaries": int(self._boundaries),
+            "overlapped": int(self._overlapped),
+            "overlap_fraction": (self._overlapped / self._boundaries
+                                 if self._boundaries else 0.0),
+            "boundary_wall_s": float(self._boundary_wall),
+            "overlap_wall_s": float(self._overlap_wall),
+            "overlap_wall_frac": (
+                self._overlap_wall / self._boundary_wall
+                if self._boundary_wall > 0 else 0.0),
+        }
 
     def slo_health(self) -> dict:
         if self._slo is None:
@@ -1225,17 +1479,50 @@ class EngineDispatcher:
             "token_waits": {str(k): int(v)
                             for k, v in self._token_waits.items()},
             "client_state": dict(self.client_state),
+            # round 22: the lease ledger rides the cut — a resumed
+            # pool replays the identical lease decisions (streaks are
+            # the hysteresis state; given/recv replay the counters)
+            "lease": {
+                "idle_streak": {k: int(v) for k, v in
+                                self._idle_streak.items()},
+                "given": {k: int(v) for k, v in
+                          self._lease_given.items()},
+                "recv": {k: int(v) for k, v in
+                         self._lease_recv.items()},
+                "boundaries": int(self._boundaries),
+                "overlapped": int(self._overlapped),
+                "boundary_wall": float(self._boundary_wall),
+                "overlap_wall": float(self._overlap_wall),
+            },
         }
+        writer = None
+        if self.checkpoint_background:
+            from ppls_tpu.runtime.checkpoint import background_writer
+            writer = background_writer()
+        # manifest-LAST discipline in background mode: the per-engine
+        # cut files above were submitted to the same single-thread
+        # FIFO writer (each engine was built with
+        # checkpoint_background), so the manifest job below cannot
+        # land before them — and the GC job after it cannot run
+        # before the manifest is durable
         save_family_checkpoint(
             self.checkpoint_path,
             identity=self._manifest_identity(engines_meta),
             bag_cols={}, count=0, acc=np.zeros((2, 1)),
-            totals=totals)
-        for p in self._cut_files - new_files:
-            try:
-                os.unlink(p)
-            except OSError:
-                pass
+            totals=totals, writer=writer)
+        stale = self._cut_files - new_files
+
+        def _gc(paths=frozenset(stale)):
+            for p in paths:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+        if writer is not None:
+            writer.submit(_gc)
+        else:
+            _gc()
         self._cut_files = new_files
         self.telemetry.event(
             "dispatch_checkpoint", turn=self.turn, cut=cut,
@@ -1243,6 +1530,10 @@ class EngineDispatcher:
             inflight=len(self._inflight),
             completed=len(self.completed))
         if self.fault_injector is not None:
+            # the injector mutates the manifest FILE — a background
+            # cut must be fully durable before the hook fires
+            if writer is not None:
+                writer.flush()
             self.fault_injector.on_checkpoint_write(
                 self.checkpoint_path)
 
@@ -1301,6 +1592,26 @@ class EngineDispatcher:
                              for k, v in totals["token_waits"]
                              .items()}
         disp.client_state = dict(totals.get("client_state", {}))
+        # round 22: lease ledger (absent in round-21 manifests — an
+        # empty ledger is exactly the pre-lease state). Cumulative
+        # counters replay like the retirement ledger below.
+        lease = totals.get("lease") or {}
+        disp._idle_streak = {k: int(v) for k, v in
+                             lease.get("idle_streak", {}).items()}
+        disp._lease_given = {k: int(v) for k, v in
+                             lease.get("given", {}).items()}
+        disp._lease_recv = {k: int(v) for k, v in
+                            lease.get("recv", {}).items()}
+        disp._boundaries = int(lease.get("boundaries", 0))
+        disp._overlapped = int(lease.get("overlapped", 0))
+        disp._boundary_wall = float(lease.get("boundary_wall", 0.0))
+        disp._overlap_wall = float(lease.get("overlap_wall", 0.0))
+        for k, v in disp._lease_given.items():
+            disp._c_lease_donated.labels(engine=k).inc(v)
+        for k, v in disp._lease_recv.items():
+            disp._c_lease_recv.labels(engine=k).inc(v)
+        disp._c_boundary.inc(disp._boundaries)
+        disp._c_boundary_overlap.inc(disp._overlapped)
 
         def _theta_in(v):
             return tuple(v) if isinstance(v, list) else v

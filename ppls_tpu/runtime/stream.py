@@ -544,7 +544,8 @@ class StreamEngine:
                  spillover: bool = False,
                  spillover_limit: int = 4,
                  slo_config=None,
-                 adapt: bool = False):
+                 adapt: bool = False,
+                 checkpoint_background: bool = False):
         from ppls_tpu.models.integrands import get_family, get_family_ds
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -864,6 +865,13 @@ class StreamEngine:
 
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = max(int(checkpoint_every), 1)
+        # round 22: route snapshot serialization through the module
+        # background writer (overlapped boundaries). Write MECHANICS
+        # only — the container bytes and the atomic-rename commit
+        # point are identical to the sync path, so this is NOT part
+        # of the snapshot identity and bit-identity across the flag
+        # holds by construction.
+        self.checkpoint_background = bool(checkpoint_background)
         if engine == "walker-dd":
             from ppls_tpu.parallel.mesh import make_mesh
             if refill_slots <= 0:
@@ -1380,8 +1388,17 @@ class StreamEngine:
     def _cycle_and_pull(self):
         """One device phase; returns (fam_live, acc, acc_c, fam_last,
         count, overflow, stats_row) as host values."""
+        return self._cycle_pull(self._cycle_launch())
+
+    def _cycle_launch(self):
+        """LAUNCH half of the phase cycle (round 22, overlapped
+        boundaries): enqueue the compiled cycle program and install
+        the device-array carry — no ``device_get``, so the call
+        returns while the device still computes. The opaque launch
+        token it returns must be handed to :meth:`_cycle_pull` on the
+        SAME engine before any other launch on this engine."""
         if self.engine == "walker-dd":
-            return self._dd_cycle_and_pull()
+            return self._dd_cycle_launch()
         d = self._dev
         tt = (jnp.asarray(self._theta_table)
               if self._theta_block > 1 else None)
@@ -1390,6 +1407,13 @@ class StreamEngine:
             jnp.asarray(self.phase, jnp.int32), tt, **self._cycle_kw)
         self._dev = dict(bag=out.bag, acc=out.acc, acc_c=out.acc_c,
                          fam_last=out.fam_last)
+        return out
+
+    def _cycle_pull(self, out):
+        """PULL half: block on the launch token's host fetch and fold
+        the counter deltas (the only ``device_get`` of the phase)."""
+        if self.engine == "walker-dd":
+            return self._dd_cycle_pull(out)
         fam_live, acc, acc_c, fam_last, count, overflow, stats = \
             jax.device_get((out.fam_live, out.acc, out.acc_c,
                             out.fam_last, out.bag.count,
@@ -1399,6 +1423,9 @@ class StreamEngine:
                 bool(overflow), np.asarray(stats))
 
     def _dd_cycle_and_pull(self):
+        return self._dd_cycle_pull(self._dd_cycle_launch())
+
+    def _dd_cycle_launch(self):
         n_dev, aw = self._dd_n_dev, self._dd_aw
         if self._dd_admit is None:
             # no admissions this phase: empty blocks, no clears
@@ -1418,18 +1445,24 @@ class StreamEngine:
                 (n_dev, self.slots, self._theta_block)),)
         out = self._dd_run(*self._dd_state, *self._dd_counters, *adm,
                            *tt_arg)
-        state = out[:4] + (out[4], out[5])
+        # the carry for the NEXT launch is device-array refs off the
+        # in-flight computation — installing it here (before any host
+        # fetch) is what lets another engine's pull overlap this one's
+        # device compute
+        self._dd_state = out[:4] + (out[4], out[5])
+        # cycles counter resets each phase call (max_cycles=1): pass
+        # zeros back in, like the leg loop does between legs
+        self._dd_counters = out[6:17] + (
+            out[17], out[18], out[19],
+            jnp.zeros(self._dd_n_dev, jnp.int32), out[21])
+        return out
+
+    def _dd_cycle_pull(self, out):
         fam_live_c = out[22]
         (count_c, acc_c2, ctr_h, waste_h, evals_h, maxd_c, ovf_c,
          fam_live) = jax.device_get(
             (out[4], out[5], out[6:17], out[17], out[18],
              out[19], out[21], fam_live_c))
-        self._dd_state = state
-        # cycles counter resets each phase call (max_cycles=1): pass
-        # zeros back in, like the leg loop does between legs
-        self._dd_counters = out[6:17] + (
-            out[17], out[18], out[19], jnp.zeros(n_dev, jnp.int32),
-            out[21])
         chip = {k: np.asarray(v, dtype=np.int64)
                 for k, v in zip(
                     ("tasks", "splits", "btasks", "wtasks", "wsplits",
@@ -1714,6 +1747,17 @@ class StreamEngine:
     def step(self) -> List[CompletedRequest]:
         """One phase: admit -> cycle -> retire. Returns the requests
         retired this phase (empty when idle)."""
+        return self.step_finish(self.step_begin())
+
+    def step_begin(self):
+        """LAUNCH half of one phase (round 22, overlapped
+        boundaries): fault-open hook, phase span, admission policy,
+        and the compiled cycle launch — everything up to (but
+        excluding) the blocking host fetch. Returns an opaque token
+        for :meth:`step_finish`; between the two calls NOTHING else
+        may drive this engine (the dispatcher's overlapped turn loop
+        owns that discipline), but OTHER engines may launch/finish
+        freely — that interleaving is the whole point."""
         tel = self.telemetry
         t_step0 = time.perf_counter()
         if self.fault_injector is not None:
@@ -1730,6 +1774,17 @@ class StreamEngine:
         self._shed_unmeetable()
         self._admit()
         if self._count == 0 and not self._slot_req:
+            return ("idle", span, t_step0, None)
+        return ("cycle", span, t_step0, self._cycle_launch())
+
+    def step_finish(self, token) -> List[CompletedRequest]:
+        """PULL half of one phase: block on the launch's host fetch,
+        then retire/account/snapshot exactly as the historical
+        monolithic ``step`` did. ``step() ==
+        step_finish(step_begin())`` bit-for-bit."""
+        kind, span, t_step0, launch = token
+        tel = self.telemetry
+        if kind == "idle":
             # nothing live on device (and nothing was admissible): an
             # idle phase costs no device work — but a queued spillover
             # batch still runs (the drained-tail engagement case) —
@@ -1759,7 +1814,7 @@ class StreamEngine:
                     self.phase - 1, n_dev=self._mesh_width())
             return spilled
         (fam_live, acc, acc_c, fam_last, count, overflow,
-         stats) = self._cycle_and_pull()
+         stats) = self._cycle_pull(launch)
         if self.engine == "walker-dd" and \
                 getattr(self, "_chip_phase_rec", None) is not None:
             # per-chip flight recorder (round 11): chip child spans +
@@ -2121,10 +2176,14 @@ class StreamEngine:
         if self._theta_block > 1 and self._fill is not None:
             totals["theta_table"] = self._theta_table.tolist()
         totals.update(extra)
+        writer = None
+        if self.checkpoint_background:
+            from ppls_tpu.runtime.checkpoint import background_writer
+            writer = background_writer()
         save_family_checkpoint(
             self.checkpoint_path, identity=self._identity(),
             bag_cols=bag_cols, count=count, acc=acc_pair,
-            totals=totals)
+            totals=totals, writer=writer)
         self.telemetry.event(
             "checkpoint", phase=self.phase, count=count,
             pending=len(self._pending), resident=len(self._slot_req),
@@ -2132,6 +2191,10 @@ class StreamEngine:
         if self.fault_injector is not None:
             # checkpoint-write fault boundary: ckpt_truncate /
             # ckpt_corrupt damage the snapshot just renamed into place
+            # — the injector mutates the FILE, so a background write
+            # must land before the hook fires
+            if writer is not None:
+                writer.flush()
             self.fault_injector.on_checkpoint_write(
                 self.checkpoint_path)
 
@@ -2553,6 +2616,10 @@ class StreamEngine:
             fam_last=jnp.asarray(fam_last, jnp.int32))
 
     def clear_snapshot(self):
+        if self.checkpoint_background:
+            from ppls_tpu.runtime.checkpoint import \
+                flush_background_writer
+            flush_background_writer()
         if self.checkpoint_path and os.path.exists(self.checkpoint_path):
             os.unlink(self.checkpoint_path)
 

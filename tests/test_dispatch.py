@@ -254,3 +254,202 @@ def test_dispatch_resume_refuses_other_config_and_pool(tmp_path):
     with pytest.raises(ValueError, match="refusing to blend"):
         EngineDispatcher.resume(a_path, "sin_recip_scaled",
                                 checkpoint_every=1, **DKW)
+
+
+# ---------------------------------------------------------------------
+# round 22: slot-credit leasing + overlapped phase boundaries
+
+
+def test_dispatch_lease_turn_counts_pinned_on_seeded_stream():
+    """The round-22 acceptance pin, on the SAME seeded mixed stream the
+    committed bench reference measures: lease/overlap OFF replays the
+    round-21 schedule exactly (9 turns / 1.5 mean retire latency — and
+    the round-22 scheduler fix that stops a drained engine from
+    burning a turn credit provably changed only intra-turn order, not
+    the schedule), and lease+overlap ON drains the identical stream in
+    6 turns at >= 1.2x better mean latency, zero recompiles both ways,
+    with a balanced ledger and at least one overlapped boundary."""
+    from tools.bench_history import (HETERO_EKW, HETERO_FAMILY,
+                                     HETERO_MAX_ENGINES, HETERO_SLOTS,
+                                     _hetero_requests)
+
+    reqs, arr = _hetero_requests()
+    d0 = EngineDispatcher(HETERO_FAMILY, slots=HETERO_SLOTS,
+                          max_engines=HETERO_MAX_ENGINES,
+                          engine_kw=dict(HETERO_EKW))
+    r0 = d0.run(reqs, arrival_phase=arr)
+    lat0 = [int(c.retire_phase) - int(c.submit_phase)
+            for c in r0.completed]
+    assert int(r0.phases) == 9, r0.phases      # committed round-21 ref
+    assert float(np.mean(lat0)) == pytest.approx(1.5)
+    assert d0.recompiles() == 0
+    ls0 = d0.lease_summary()
+    assert ls0["enabled"] is False
+    assert ls0["donated"] == ls0["received"] == 0
+
+    d1 = EngineDispatcher(HETERO_FAMILY, slots=HETERO_SLOTS,
+                          max_engines=HETERO_MAX_ENGINES,
+                          lease=True, overlap_boundaries=True,
+                          engine_kw=dict(HETERO_EKW))
+    r1 = d1.run(reqs, arrival_phase=arr)
+    lat1 = [int(c.retire_phase) - int(c.submit_phase)
+            for c in r1.completed]
+    assert len(r1.completed) == len(reqs)
+    assert np.all(np.isfinite(r1.areas))
+    assert d1.recompiles() == 0
+    # the ISSUE's >= 1.2x floor on both proxies, as exact pins (the
+    # schedule is deterministic; a change that moves these moved the
+    # lease policy and must re-justify the gate reference)
+    assert int(r1.phases) == 6, r1.phases
+    assert float(np.mean(lat0)) / float(np.mean(lat1)) >= 1.2
+    ls = d1.lease_summary()
+    assert ls["enabled"] and ls["overlap_boundaries"]
+    assert ls["donated"] == ls["received"] >= 1
+    assert ls["balanced"] is True
+    assert sum(ls["by_donor"].values()) == ls["donated"]
+    assert sum(ls["by_borrower"].values()) == ls["received"]
+    assert ls["overlapped"] >= 1
+    assert 0.0 < ls["overlap_fraction"] <= 1.0
+    assert ls["boundaries"] >= ls["overlapped"]
+
+
+def test_dispatch_overlap_matches_sync_bit_identical():
+    """Overlapped boundaries are a WALL-CLOCK optimization only: with
+    the identical lease schedule, launching every due cycle before the
+    first stats fetch must produce bit-identical areas, the same turn
+    count, and the same lease ledger as the serialized boundary — the
+    only divergence allowed is the overlap tallies themselves."""
+    d_sync = EngineDispatcher("sin_recip_scaled", lease=True, **DKW)
+    r_sync = d_sync.run(MIXED, arrival_phase=ARR)
+    d_ov = EngineDispatcher("sin_recip_scaled", lease=True,
+                            overlap_boundaries=True, **DKW)
+    r_ov = d_ov.run(MIXED, arrival_phase=ARR)
+    assert np.array_equal(r_sync.areas, r_ov.areas)    # bit-for-bit
+    assert r_sync.phases == r_ov.phases
+    assert d_sync.recompiles() == 0 and d_ov.recompiles() == 0
+    ls_s, ls_o = d_sync.lease_summary(), d_ov.lease_summary()
+    assert ls_s["by_donor"] == ls_o["by_donor"]
+    assert ls_s["by_borrower"] == ls_o["by_borrower"]
+    assert ls_s["boundaries"] == ls_o["boundaries"]
+    # sync mode never overlaps; overlap mode actually overlapped
+    assert ls_s["overlapped"] == 0
+    assert ls_o["overlapped"] >= 1
+
+
+def test_dispatch_lease_park_unpark_capped(tmp_path):
+    """Leases x the LRU cap: a PARKED engine donates its full per-turn
+    budget (donor_parked grants in the timeline), the unparked engine
+    still completes its routed work (its credits come back with it),
+    and the capped lease schedule replays bit-identically."""
+    import json as _json
+
+    from ppls_tpu.obs import Telemetry
+
+    ev = str(tmp_path / "lease.jsonl")
+    tel = Telemetry(events_path=ev)
+    kw = dict(DKW, max_engines=2)
+    capped = EngineDispatcher("sin_recip_scaled", telemetry=tel,
+                              lease=True, overlap_boundaries=True,
+                              **kw)
+    res = capped.run(MIXED, arrival_phase=ARR)
+    tel.close()
+    parks = sum(child.value for _, child in capped._c_park.items())
+    assert parks >= 2, "max_engines=2 never parked an engine"
+    assert capped.recompiles() == 0
+    assert len(res.completed) == len(MIXED)
+    ls = capped.lease_summary()
+    assert ls["donated"] == ls["received"] >= 1
+    assert ls["balanced"] is True
+    grants = [r for r in
+              (_json.loads(ln) for ln in open(ev) if ln.strip())
+              if r.get("ev") == "event"
+              and r.get("name") == "lease_grant"]
+    assert sum(g["attrs"]["credits"] for g in grants) == ls["received"]
+    # the S3 contract: parked engines' credits return to the pool —
+    # at least one grant must name a parked donor
+    parked_donors = {g["attrs"]["donor"] for g in grants
+                     if g["attrs"]["donor_parked"]}
+    assert parked_donors, [g["attrs"] for g in grants]
+    # ...and unpark restores them: every parked donor came back and
+    # finished its routed requests (donating while parked did not
+    # strand its own backlog)
+    summary = capped.engines_summary()
+    for k in parked_donors:
+        assert summary[k]["completed"] >= 1, (k, summary[k])
+    assert sum(e["completed"] for e in summary.values()) == len(MIXED)
+    # capped + leased, same workload: bit-identical replay
+    res2 = EngineDispatcher("sin_recip_scaled", lease=True,
+                            overlap_boundaries=True, **kw).run(
+        MIXED, arrival_phase=ARR)
+    assert np.array_equal(res.areas, res2.areas)
+
+
+def test_dispatch_lease_capped_kill_and_resume_bit_identical(tmp_path):
+    """The round-22 kill-and-resume acceptance: capped pool, leases in
+    flight, overlapped boundaries and the BACKGROUND checkpoint writer
+    active (overlap implies it) — crash after turn 3, resume from the
+    coordinated cut, and the continued run is bit-identical to the
+    undisturbed one INCLUDING the lease ledger: every grant replays
+    onto the same (donor, borrower) cells."""
+    kw = dict(DKW, max_engines=2, lease=True, overlap_boundaries=True)
+    base_d = EngineDispatcher("sin_recip_scaled", **kw)
+    base = base_d.run(MIXED, arrival_phase=ARR)
+    ls_base = base_d.lease_summary()
+    assert ls_base["donated"] >= 1         # leases actually in flight
+
+    path = str(tmp_path / "pool.ckpt")
+    disp = EngineDispatcher("sin_recip_scaled", checkpoint_path=path,
+                            checkpoint_every=1, **kw)
+    assert disp.checkpoint_background      # overlap => background writer
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        disp.run(MIXED, arrival_phase=ARR, _crash_after_turns=3)
+
+    disp2 = EngineDispatcher.resume(path, "sin_recip_scaled",
+                                    checkpoint_every=1, **kw)
+    assert disp2.phase == 3
+    assert disp2.recompiles() == 0
+    mid = disp2.lease_summary()
+    assert mid["donated"] == mid["received"]   # the restored ledger
+    res = _drive_to_drain(disp2, MIXED, ARR)
+    assert np.array_equal(res.areas, base.areas)       # bit-for-bit
+    assert res.phases == base.phases
+    assert len(res.completed) == len(base.completed)
+    ls = disp2.lease_summary()
+    assert ls["by_donor"] == ls_base["by_donor"]
+    assert ls["by_borrower"] == ls_base["by_borrower"]
+    assert ls["donated"] == ls_base["donated"]
+    assert ls["boundaries"] == ls_base["boundaries"]
+    assert ls["balanced"] is True
+
+
+def test_analyze_occupancy_lease_columns(tmp_path):
+    """The offline decomposition (S2): a leased pool timeline replays
+    through tools/analyze_occupancy.py --from-events with the
+    idle-slot/lease columns present and BOTH reconciliations OK —
+    per-engine retires vs distinct rids, and donated == borrowed
+    across the deduped grants."""
+    import subprocess
+    import sys as _sys
+
+    from ppls_tpu.obs import Telemetry
+
+    ev = str(tmp_path / "pool.jsonl")
+    tel = Telemetry(events_path=ev)
+    disp = EngineDispatcher("sin_recip_scaled", telemetry=tel,
+                            lease=True, overlap_boundaries=True,
+                            **DKW)
+    disp.run(MIXED, arrival_phase=ARR)
+    tel.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [_sys.executable, "tools/analyze_occupancy.py",
+         "--from-events", ev, "--lanes", str(EKW["lanes"])],
+        capture_output=True, text=True, cwd=repo, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "per-engine decomposition" in r.stdout
+    assert "donated=" in r.stdout and "borrowed=" in r.stdout
+    assert "leased_phases=" in r.stdout
+    for line in r.stdout.splitlines():
+        if "reconciliation:" in line:
+            assert "OK" in line, line
+    assert "lease reconciliation:" in r.stdout

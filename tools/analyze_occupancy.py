@@ -78,11 +78,13 @@ def main_from_events(path: str, lanes: int = 0) -> int:
     phase_walls = []         # close.t - open.t per phase span
     open_phase = {}          # id -> (open t)
     open_engine = {}         # id -> engine label from the OPEN attrs
+    open_leased = {}         # id -> phase ran on a donated credit
     names = {}               # id -> span name
     retires = []
     sheds = []               # request_shed events (round 16)
     spinups = []             # engine_spinup events (round 21 pool)
     parks = []               # engine_park events (round 21 pool)
+    leases = []              # lease_grant events (round 22 ledger)
     checkpoints = 0
     segments = 0
     for line in text.splitlines():
@@ -103,22 +105,29 @@ def main_from_events(path: str, lanes: int = 0) -> int:
             # previous segment's bookkeeping so ids don't collide
             open_phase.clear()
             open_engine.clear()
+            open_leased.clear()
             names.clear()
         elif ev == "span_open" and isinstance(rec.get("id"), int):
             names[rec["id"]] = rec.get("name")
             if rec.get("name") == "phase":
                 open_phase[rec["id"]] = rec.get("t", 0.0)
-                # the pool's engine label rides the OPEN attrs (the
-                # close carries the device-counter deltas); remember
-                # it so the per-engine decomposition can key the row
-                eng = (rec.get("attrs") or {}).get("engine")
+                # the pool's engine label (and the round-22 leased
+                # marker) ride the OPEN attrs (the close carries the
+                # device-counter deltas); remember them so the
+                # per-engine decomposition can key the row
+                oattrs = rec.get("attrs") or {}
+                eng = oattrs.get("engine")
                 if eng:
                     open_engine[rec["id"]] = str(eng)
+                if oattrs.get("leased"):
+                    open_leased[rec["id"]] = True
         elif ev == "span_close":
             if names.get(rec.get("id")) == "phase":
                 attrs = dict(rec.get("attrs") or {})
                 attrs.setdefault("engine",
                                  open_engine.pop(rec.get("id"), None))
+                attrs.setdefault("leased",
+                                 open_leased.pop(rec.get("id"), False))
                 if not attrs.get("idle"):
                     phase_rows.append(attrs)
                 t0 = open_phase.pop(rec["id"], None)
@@ -132,6 +141,8 @@ def main_from_events(path: str, lanes: int = 0) -> int:
             spinups.append(rec.get("attrs") or {})
         elif ev == "event" and rec.get("name") == "engine_park":
             parks.append(rec.get("attrs") or {})
+        elif ev == "event" and rec.get("name") == "lease_grant":
+            leases.append(rec.get("attrs") or {})
         elif ev == "event" and rec.get("name") == "checkpoint":
             checkpoints += 1
 
@@ -188,16 +199,35 @@ def main_from_events(path: str, lanes: int = 0) -> int:
         print("=== per-engine decomposition (dispatch pool) ===")
 
         def _row():
-            return {"phases": 0, "tasks": 0, "wtasks": 0, "wsteps": 0,
-                    "retired": 0, "spinups": 0, "unparks": 0,
-                    "parks": 0, "hist": Histogram(PHASE_BUCKETS)}
+            return {"phases": 0, "leased_phases": 0, "tasks": 0,
+                    "wtasks": 0, "wsteps": 0, "retired": 0,
+                    "donated": 0, "borrowed": 0, "spinups": 0,
+                    "unparks": 0, "parks": 0,
+                    "hist": Histogram(PHASE_BUCKETS)}
 
         per = {}
         for r in phase_rows:
             row = per.setdefault(str(r.get("engine", "?")), _row())
             row["phases"] += 1
+            if r.get("leased"):
+                row["leased_phases"] += 1
             for k in ("tasks", "wtasks", "wsteps"):
                 row[k] += int(r.get(k, 0))
+        # round-22 lease ledger: grants dedup by (turn, donor,
+        # borrower) — a resumed timeline legitimately replays the
+        # post-snapshot turns' grant events (the replay IS the
+        # determinism contract) and the turn counter rides the
+        # snapshot, so the key collapses each replayed grant onto its
+        # original
+        lease_grants = list({(g.get("turn"), g.get("donor"),
+                              g.get("borrower")): g
+                             for g in leases}.values())
+        for g in lease_grants:
+            n = int(g.get("credits", 1))
+            per.setdefault(str(g.get("donor", "?")),
+                           _row())["donated"] += n
+            per.setdefault(str(g.get("borrower", "?")),
+                           _row())["borrowed"] += n
         # rid-dedup before attributing: a resumed timeline replays
         # post-snapshot retire events (same rule as the SLO block)
         for r in {x.get("rid"): x for x in retires}.values():
@@ -215,17 +245,49 @@ def main_from_events(path: str, lanes: int = 0) -> int:
                    if lanes and row["wsteps"] else "")
             life = (f" spinups={row['spinups']} parks={row['parks']} "
                     f"unparks={row['unparks']}")
+            # the round-22 idle-slot/lease column: credits this engine
+            # DONATED (its slots sat idle, the pool lent them out) vs
+            # credits it BORROWED, and how many of its phases actually
+            # ran on a borrowed credit (leased= on the span)
+            ls = (f" donated={row['donated']} "
+                  f"borrowed={row['borrowed']} "
+                  f"leased_phases={row['leased_phases']}"
+                  if lease_grants else "")
             h = row["hist"]
             lat = (f" retire p50={h.quantile(0.5)} "
                    f"p99={h.quantile(0.99)}" if h.count else "")
             print(f"  {e}: phases={row['phases']} "
                   f"tasks={row['tasks']} retired={row['retired']}"
-                  f"{eff}{lat}{life}")
+                  f"{eff}{lat}{ls}{life}")
         n_ret = len({x.get("rid") for x in retires})
         n_per = sum(r["retired"] for r in per.values())
         print(f"  reconciliation: {n_per} per-engine retires vs "
               f"{n_ret} distinct retire rids -> "
               f"{'OK' if n_per == n_ret else 'FAIL'}")
+        if lease_grants:
+            # the lease sum invariant: every donated credit reconciles
+            # against exactly one received credit (the ledger never
+            # mints or loses a credit), and no engine ran more leased
+            # phases than the credits it borrowed — so donated vs
+            # native credits reconcile against the rid-deduped retire
+            # totals above. Phase spans are NOT rid-deduped, so a
+            # resumed (multi-segment) timeline legitimately replays
+            # post-snapshot leased phases — the per-engine cap is only
+            # a hard problem on a single-segment timeline.
+            don = sum(r["donated"] for r in per.values())
+            bor = sum(r["borrowed"] for r in per.values())
+            over = [e for e, r in sorted(per.items())
+                    if r["leased_phases"] > r["borrowed"]]
+            lease_ok = don == bor and (not over or segments > 1)
+            print(f"  lease reconciliation: donated {don} == "
+                  f"borrowed {bor} across {len(lease_grants)} "
+                  f"grant(s); leased phases <= borrowed per engine "
+                  f"{'(replayed segments tolerated)' if segments > 1 else ''}"
+                  f"-> {'OK' if lease_ok else 'FAIL'}")
+            if not lease_ok:
+                problems.append(
+                    f"lease ledger failed to reconcile: donated={don} "
+                    f"borrowed={bor} over-leased={over}")
     # round-16 multi-tenant SLO decomposition: per-class tail latency
     # + per-tenant retired/failed/shed accounting, offline from the
     # same retire/request_shed events serve emitted — identical

@@ -184,6 +184,15 @@ HETERO_SHAPES = (
 # pool p99 retire latency (turns) may grow <= 25% over it
 GATE_DISPATCH_SPEEDUP_TOL = 0.25
 GATE_DISPATCH_P99_TOL = 0.25
+# Round 22: the slot-credit-leasing acceptance floor — with leasing +
+# overlapped boundaries ON, drain turns and mean retire latency on
+# the seeded stream must improve >= 1.2x over the committed round-21
+# schedule (9 turns / 1.5 mean on HETERO_SEED=31). The nolease twin
+# in the record re-measures that baseline every run, so drift in the
+# round-21 schedule itself also surfaces here.
+R21_DISPATCH_TURNS = 9
+R21_DISPATCH_MEAN_LAT = 1.5
+GATE_DISPATCH_LEASE_SPEEDUP = 1.2
 
 # gate tolerances (the "stated tolerance" of the round-11 acceptance)
 GATE_STEP_TOL = 0.5      # kernel_steps / boundaries may grow <= 1.5x
@@ -644,9 +653,13 @@ def run_hetero_dispatch_proxies() -> dict:
     the CI --gate-run measurement — the :func:`run_quick_proxies`
     ownership contract).
 
-    Drives the seeded mixed-shape stream through the round-21
+    Drives the seeded mixed-shape stream through the
     :class:`~ppls_tpu.runtime.dispatch.EngineDispatcher` (>= 3
-    distinct engine keys, zero recompiles end-to-end), then runs the
+    distinct engine keys, zero recompiles end-to-end) — since round
+    22 with slot-credit leasing + overlapped boundaries ON as the
+    headline measurement, plus the round-21 lease-OFF twin of the
+    identical stream (``*_nolease`` fields) so the lease win is
+    measured against the committed round-21 baseline — then runs the
     SERIALIZED baseline — the same requests partitioned by engine key,
     each group's engine run to completion one after another — and
     reports the schedule-counted comparison: pool turns vs summed
@@ -665,12 +678,23 @@ def run_hetero_dispatch_proxies() -> dict:
                                      r[2].get("rule", "trapezoid"),
                                      r[0])) for r in reqs})
 
+    # round-21 twin: the same stream with leasing/overlap OFF — the
+    # committed-reference schedule (9 turns / 1.5 mean on the seed)
+    disp0 = EngineDispatcher(HETERO_FAMILY, slots=HETERO_SLOTS,
+                             max_engines=HETERO_MAX_ENGINES,
+                             engine_kw=dict(HETERO_EKW))
+    res0 = disp0.run(reqs, arrival_phase=arrivals)
+    lat0 = [int(c.retire_phase) - int(c.submit_phase)
+            for c in res0.completed]
+
     disp = EngineDispatcher(HETERO_FAMILY, slots=HETERO_SLOTS,
                             max_engines=HETERO_MAX_ENGINES,
+                            lease=True, overlap_boundaries=True,
                             engine_kw=dict(HETERO_EKW))
     res = disp.run(reqs, arrival_phase=arrivals)
     lat = [int(c.retire_phase) - int(c.submit_phase)
            for c in res.completed]
+    leases = disp.lease_summary()
     summary = disp.engines_summary()
     per_engine_completed = sum(v["completed"]
                                for v in summary.values())
@@ -708,7 +732,8 @@ def run_hetero_dispatch_proxies() -> dict:
         "slots": HETERO_SLOTS,
         "engine_keys": keys,
         "n_engine_keys": len(keys),
-        "recompiles": int(disp.recompiles()),
+        "recompiles": int(disp.recompiles())
+                      + int(disp0.recompiles()),
         "completed": len(res.completed),
         "shed": len(res.shed),
         "accounting_ok": (len(res.completed) + len(res.shed)
@@ -723,6 +748,25 @@ def run_hetero_dispatch_proxies() -> dict:
         "mean_latency_turns": round(float(np.mean(lat)), 3),
         "p99_latency_turns": round(
             float(np.percentile(lat, 99)), 3),
+        # round 22: the lease-OFF twin + the lease/overlap proxies
+        "lease": True,
+        "overlap_boundaries": True,
+        "hetero_turns_nolease": int(res0.phases),
+        "mean_latency_turns_nolease": round(
+            float(np.mean(lat0)), 3),
+        "p99_latency_turns_nolease": round(
+            float(np.percentile(lat0, 99)), 3),
+        "turns_speedup_vs_nolease": round(
+            int(res0.phases) / max(int(res.phases), 1), 3),
+        "lease_donated": int(leases["donated"]),
+        "lease_received": int(leases["received"]),
+        "lease_balanced": bool(leases["balanced"]),
+        "boundaries_total": int(leases["boundaries"]),
+        "boundaries_overlapped": int(leases["overlapped"]),
+        "overlap_fraction": round(
+            float(leases["overlap_fraction"]), 3),
+        "overlap_wall_frac": round(
+            float(leases["overlap_wall_frac"]), 3),
         "serialized_mean_latency_turns": round(
             float(np.mean(ser_lat)), 3),
         "serialized_p99_latency_turns": round(
@@ -806,6 +850,46 @@ def gate_dispatch_record(cur: dict, ref: dict) -> List[str]:
             f"REGRESSION dispatch: pool p99 retire latency "
             f"{p99:.1f} turns grew >{GATE_DISPATCH_P99_TOL:.0%} "
             f"over the reference's {p99_ref:.1f}")
+    # round 22: lease/overlap proxies. Only gated once the committed
+    # reference carries them (the documented --update-ref flow); a
+    # ref WITH them and a current record WITHOUT them means the lease
+    # measurement silently fell out of the bench — fail loudly.
+    if "lease_balanced" not in rd:
+        return fails
+    if "lease_balanced" not in cd:
+        fails.append(
+            "REGRESSION dispatch: the committed reference carries "
+            "lease/overlap proxies but the current record has none "
+            "(the round-22 lease measurement fell out of the bench)")
+        return fails
+    turns = cd.get("hetero_turns")
+    if isinstance(turns, int) and turns * GATE_DISPATCH_LEASE_SPEEDUP \
+            > R21_DISPATCH_TURNS:
+        fails.append(
+            f"REGRESSION dispatch: leased drain took {turns} turns — "
+            f"not >= {GATE_DISPATCH_LEASE_SPEEDUP:.1f}x under the "
+            f"round-21 schedule's {R21_DISPATCH_TURNS} (slot-credit "
+            f"leasing stopped paying for itself)")
+    ml = cd.get("mean_latency_turns")
+    if isinstance(ml, (int, float)) \
+            and ml * GATE_DISPATCH_LEASE_SPEEDUP \
+            > R21_DISPATCH_MEAN_LAT + 1e-9:
+        fails.append(
+            f"REGRESSION dispatch: leased mean retire latency "
+            f"{ml:.3f} turns — not >= "
+            f"{GATE_DISPATCH_LEASE_SPEEDUP:.1f}x under the round-21 "
+            f"schedule's {R21_DISPATCH_MEAN_LAT}")
+    if cd.get("lease_balanced") is False:
+        fails.append(
+            "REGRESSION dispatch: lease ledger does not balance "
+            "(donated credits != received credits — grants are "
+            "being lost or double-counted)")
+    ofr = cd.get("overlap_fraction")
+    if not isinstance(ofr, (int, float)) or ofr <= 0.0:
+        fails.append(
+            f"REGRESSION dispatch: overlap_fraction={ofr!r} — no "
+            f"phase boundary overlapped another engine's in-flight "
+            f"cycle (the overlapped turn loop is not engaging)")
     return fails
 
 

@@ -561,6 +561,82 @@ else
     echo "ci: heterogeneous dispatch leg OK"
 fi
 
+# --- 5f. LEASE-ENABLED dispatch under chaos (round 22) ---
+# The same mixed-shape workload through `serve --dispatch --lease
+# --overlap-boundaries --supervise`, with the committed crash plan
+# (tools/chaos_plan_dispatch_lease.json kills the pool at the close
+# edge of turn 3 — AFTER lease grants landed at turns 1-2, so the
+# ledger is in flight across the kill). The supervisor must resume,
+# restore the lease ledger from the manifest, and drain; the summary
+# must show recompiles: 0, a BALANCED ledger (every donated credit
+# reconciles against a received one, donated >= 1 so the leg actually
+# exercised leasing), and the rid-linked timeline must validate —
+# lease grants are replayed schedule, not best-effort hints.
+step "serve --dispatch --lease --overlap-boundaries under chaos"
+LD_DIR="$(mktemp -d)"
+ld_fail=0
+cat > "$LD_DIR/reqs.jsonl" <<'EOF'
+{"theta": 1.0, "bounds": [1e-2, 1.0], "arrival_phase": 0}
+{"theta": 1.05, "bounds": [1e-2, 1.0], "eps": 1e-7, "arrival_phase": 0}
+{"theta": 1.1, "bounds": [1e-2, 1.0], "rule": "simpson", "arrival_phase": 0}
+{"theta": [1.15, 1.2], "bounds": [1e-2, 1.0], "arrival_phase": 1}
+{"theta": 1.25, "bounds": [1e-2, 1.0], "arrival_phase": 1}
+{"theta": 1.3, "bounds": [1e-2, 1.0], "eps": 1e-7, "arrival_phase": 2}
+{"theta": 1.35, "bounds": [1e-2, 1.0], "rule": "simpson", "arrival_phase": 2}
+{"theta": [1.4, 1.45], "bounds": [1e-2, 1.0], "arrival_phase": 3}
+EOF
+if timeout -k 10 600 env JAX_PLATFORMS=cpu python -m ppls_tpu serve \
+        --dispatch --max-engines 4 --lease --overlap-boundaries \
+        --supervise \
+        --requests "$LD_DIR/reqs.jsonl" \
+        --eps 1e-6 -a 1e-2 -b 1.0 --slots 4 --chunk 512 \
+        --capacity 65536 --lanes 256 --refill-slots 2 \
+        --checkpoint "$LD_DIR/ld.ckpt" --checkpoint-every 1 \
+        --watchdog 120 --events "$LD_DIR/ld.jsonl" \
+        --fault-plan @tools/chaos_plan_dispatch_lease.json \
+        > "$LD_DIR/ld.out" 2> "$LD_DIR/ld.err"; then
+    python - "$LD_DIR/ld.out" "$LD_DIR/ld.jsonl" <<'PYEOF' || ld_fail=1
+import json, sys
+lines = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+s = lines[-1]
+assert s.get("summary") and s.get("supervised"), "not supervised"
+assert s.get("dispatch") is True, "summary lacks the dispatch block"
+assert s["recompiles"] == 0, ("recompiles", s["recompiles"])
+assert s["completed"] == 8, s["completed"]
+assert s.get("attempts", 1) >= 2, "crash did not force a resume"
+L = s["leases"]
+assert L["enabled"] and L["overlap_boundaries"], L
+# the round-22 ledger invariant across kill-and-resume: every leased
+# credit reconciles (donated == received), and the leg actually
+# leased (>= 1) with at least one overlapped boundary recorded
+assert L["donated"] >= 1, ("no leases exercised", L)
+assert L["balanced"] and L["donated"] == L["received"], L
+assert L["overlapped"] >= 1 and L["overlap_fraction"] > 0.0, L
+grants = [json.loads(ln) for ln in open(sys.argv[2]) if ln.strip()]
+grants = [e for e in grants
+          if e.get("ev") == "event" and e.get("name") == "lease_grant"]
+assert grants, "no lease_grant events in the timeline"
+assert sum(g["attrs"]["credits"] for g in grants) == L["received"], \
+    (len(grants), L["received"])
+print(f"ci: lease dispatch OK (donated {L['donated']} == received, "
+      f"{L['overlapped']}/{L['boundaries']} boundaries overlapped, "
+      "recompiles 0 across crash-resume)")
+PYEOF
+else
+    echo "ci: serve --dispatch --lease chaos run FAILED"
+    ld_fail=1
+fi
+python tools/check_artifacts.py --serve "$LD_DIR/ld.out" \
+    --events "$LD_DIR/ld.jsonl" --unbalanced-ok --rid-linkage \
+    || ld_fail=1
+rm -rf "$LD_DIR"
+if [ "$ld_fail" -ne 0 ]; then
+    echo "ci: lease-enabled dispatch leg FAILED"
+    FAILURES=$((FAILURES + 1))
+else
+    echo "ci: lease-enabled dispatch leg OK"
+fi
+
 # --- 6. bench observatory: trajectory check + quick-proxy gate ---
 # tools/bench_history.py --check normalizes the committed
 # BENCH_r*/MULTICHIP_r* wrappers into one trajectory and fails on
