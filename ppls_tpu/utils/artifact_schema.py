@@ -429,6 +429,39 @@ def _scan_lines(text: str, where: str):
     return problems, found
 
 
+# --- round 23: replay dedup shared by the events analyzers ------------------
+
+def dedup_replayed(records: List[dict], key_fn) -> List[dict]:
+    """Collapse replayed duplicates out of an events stream: after a
+    kill-and-resume, the replayed turns re-emit their events with
+    IDENTICAL content (that is the determinism contract), so each
+    record collapses onto its original. First occurrence wins — file
+    order is emission order, so the original precedes its replay —
+    which also keeps the analyzers order-stable. Records whose key is
+    None are kept verbatim (no identity to collapse on).
+
+    One definition, used by both ``analyze_occupancy --from-events``
+    and ``analyze_request`` (they previously carried copies)."""
+    out: List[dict] = []
+    seen = set()
+    for r in records:
+        k = key_fn(r)
+        if k is None:
+            out.append(r)
+            continue
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(r)
+    return out
+
+
+def dedup_by_rid(records: List[dict]) -> List[dict]:
+    """Replay dedup keyed on the request id — the common case: one
+    retire/shed event per rid survives, replays collapse."""
+    return dedup_replayed(records, lambda r: r.get("rid"))
+
+
 # --- round 17: graftlint --format json documents ---------------------------
 
 def validate_graftlint_json(doc, where: str = "graftlint") -> List[str]:
@@ -449,6 +482,10 @@ def validate_graftlint_json(doc, where: str = "graftlint") -> List[str]:
         problems.append(f"{where}: missing/empty 'target'")
     if not isinstance(doc.get("deep"), bool):
         problems.append(f"{where}: 'deep' must be a bool")
+    # "runtime" arrived with the GL12-GL14 tier (round 23); older
+    # ledgers legitimately lack it, but a present field must be a bool
+    if "runtime" in doc and not isinstance(doc["runtime"], bool):
+        problems.append(f"{where}: 'runtime' must be a bool")
     vs = doc.get("violations")
     if not isinstance(vs, list):
         return problems + [f"{where}: 'violations' must be a list"]
@@ -467,6 +504,11 @@ def validate_graftlint_json(doc, where: str = "graftlint") -> List[str]:
         code = v.get("code")
         if isinstance(code, str) and not code_re.match(code):
             problems.append(f"{w}: code {code!r} is not GLxx")
+        # "tier" is optional (round-23 ledgers carry it) but a
+        # present value must be a known tier name
+        if "tier" in v and v["tier"] not in ("ast", "deep", "runtime"):
+            problems.append(f"{w}: tier {v.get('tier')!r} is not one "
+                            f"of ast/deep/runtime")
         key = v.get("key")
         if isinstance(key, str) and isinstance(code, str) \
                 and isinstance(v.get("path"), str) \

@@ -64,7 +64,9 @@ def main_from_events(path: str, lanes: int = 0) -> int:
     compile cache, no engine imports — it works on any host that can
     read the file and import the (pure-Python) obs layer."""
     from ppls_tpu.obs.registry import PHASE_BUCKETS, Histogram
-    from ppls_tpu.utils.artifact_schema import validate_events_text
+    from ppls_tpu.utils.artifact_schema import (dedup_by_rid,
+                                                dedup_replayed,
+                                                validate_events_text)
 
     with open(path, encoding="utf-8") as fh:
         text = fh.read()
@@ -219,9 +221,9 @@ def main_from_events(path: str, lanes: int = 0) -> int:
         # determinism contract) and the turn counter rides the
         # snapshot, so the key collapses each replayed grant onto its
         # original
-        lease_grants = list({(g.get("turn"), g.get("donor"),
-                              g.get("borrower")): g
-                             for g in leases}.values())
+        lease_grants = dedup_replayed(
+            leases, lambda g: (g.get("turn"), g.get("donor"),
+                               g.get("borrower")))
         for g in lease_grants:
             n = int(g.get("credits", 1))
             per.setdefault(str(g.get("donor", "?")),
@@ -230,7 +232,7 @@ def main_from_events(path: str, lanes: int = 0) -> int:
                            _row())["borrowed"] += n
         # rid-dedup before attributing: a resumed timeline replays
         # post-snapshot retire events (same rule as the SLO block)
-        for r in {x.get("rid"): x for x in retires}.values():
+        for r in dedup_by_rid(retires):
             row = per.setdefault(str(r.get("engine", "?")), _row())
             row["retired"] += 1
             row["hist"].observe(int(r.get("latency_phases", 0)))
@@ -259,7 +261,7 @@ def main_from_events(path: str, lanes: int = 0) -> int:
             print(f"  {e}: phases={row['phases']} "
                   f"tasks={row['tasks']} retired={row['retired']}"
                   f"{eff}{lat}{ls}{life}")
-        n_ret = len({x.get("rid") for x in retires})
+        n_ret = len(dedup_by_rid(retires))
         n_per = sum(r["retired"] for r in per.values())
         print(f"  reconciliation: {n_per} per-engine retires vs "
               f"{n_ret} distinct retire rids -> "
@@ -298,8 +300,8 @@ def main_from_events(path: str, lanes: int = 0) -> int:
         # legitimately replays post-snapshot retire/shed events, and
         # counting them twice would overstate every number below (the
         # same rid-dedup rule validate_serve_output_text applies)
-        retires = list({r.get("rid"): r for r in retires}.values())
-        sheds = list({s.get("rid"): s for s in sheds}.values())
+        retires = dedup_by_rid(retires)
+        sheds = dedup_by_rid(sheds)
         by_class, tenants = {}, {}
         for r in retires:
             pri = r.get("priority", 1)

@@ -51,6 +51,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # drift apart
 from ppls_tpu.utils.artifact_schema import (  # noqa: E402
     RID_TRACE_EVENTS as TRACE_EVENTS,
+    dedup_replayed,
 )
 
 
@@ -133,12 +134,16 @@ def load_trace(paths: List[str]) -> Dict[int, dict]:
                     r["token_wait_events"].add(
                         int(attrs.get("phase", -1)))
                 elif name == "request_redeal":
-                    key = (attrs.get("phase"), attrs.get("process"))
-                    if key not in [(d.get("phase"), d.get("process"))
-                                   for d in r["redeals"]]:
-                        r["redeals"].append(dict(attrs))
+                    r["redeals"].append(dict(attrs))
                 else:   # quarantine / deadline_exceeded
                     r["events"].setdefault(name, dict(attrs))
+    # replay dedup (shared helper): a resumed segment re-emits the
+    # post-snapshot redeal events; one record per (phase, process)
+    # survives, first (original) occurrence wins
+    for r in rids.values():
+        r["redeals"] = dedup_replayed(
+            r["redeals"],
+            lambda d: (d.get("phase"), d.get("process")))
     return rids
 
 

@@ -216,6 +216,28 @@ else
 fi
 rm -f "$GL_JSON"
 
+# --- 4c. graftlint runtime tier: host-side serving-stack analysis ---
+# GL12 (snapshot-surface completeness), GL13 (lock-order +
+# blocking-under-lock), GL14 (thread-shared-state) are pure AST
+# analysis — milliseconds — but carry a wall budget anyway (60 s,
+# same runaway-means-regression logic as 4b) and the same
+# schema-gated JSON ledger.
+step "graftlint runtime tier (GL12-GL14, serving stack)"
+GLR_JSON="$(mktemp /tmp/ppls_ci_graftlint_rt.XXXXXX.json)"
+rt_t0=$SECONDS
+if timeout -k 10 60 \
+        python -m tools.graftlint ppls_tpu --runtime \
+        --baseline tools/graftlint_baseline.json \
+        --format json > "$GLR_JSON" \
+        && python tools/check_artifacts.py --graftlint "$GLR_JSON"; then
+    echo "ci: graftlint runtime OK ($((SECONDS - rt_t0))s of 60s budget)"
+else
+    echo "ci: graftlint runtime tier FAILED (new serving-stack "\
+"violations, schema-invalid ledger, or wall budget exceeded)"
+    FAILURES=$((FAILURES + 1))
+fi
+rm -f "$GLR_JSON"
+
 # --- 5. serve telemetry smoke: seeded synthetic load + event log ---
 # A short `ppls-tpu serve` run on the deterministic Poisson schedule
 # (interpret-friendly sizing, same shape as tests/test_stream.py's

@@ -1,5 +1,5 @@
 """CLI: ``python -m tools.graftlint ppls_tpu [--baseline FILE]
-[--deep] [--format json] [--prune-stale]``.
+[--deep] [--runtime] [--since REF] [--format json] [--prune-stale]``.
 
 Exit status 1 iff there are NEW violations (not in the baseline).
 Grandfathered violations are enumerated (they are debt, not noise);
@@ -9,9 +9,18 @@ the baseline shrinks over time instead of fossilizing —
 
 ``--deep`` adds the semantic tier (GL07-GL10, ``deep.py``): the real
 jitted engine programs are traced on CPU (interpret mode, virtual
-8-mesh for dd) and their jaxprs walked. Staleness is scoped to the
-tiers that ran: a grandfathered deep entry is not reported stale by an
-AST-only run.
+8-mesh for dd) and their jaxprs walked. ``--runtime`` adds the
+host-runtime tier (GL12-GL14, ``runtime.py``): pure inter-procedural
+AST analysis of the serving stack (snapshot-surface completeness,
+lock-order/blocking-under-lock, thread-shared-state) — milliseconds,
+no tracing, works on any package. Staleness is scoped to the tiers
+that ran: a grandfathered deep or runtime entry is not reported stale
+by an AST-only run. A symbol flagged by two tiers under one key is
+reported ONCE (first tier wins).
+
+``--since REF`` narrows the REPORT to files changed vs the git ref
+(the lint still runs over the whole package — the rules are
+cross-module), so a pre-commit hook sees only its own files.
 
 ``--format json`` emits one machine-readable record per violation
 (schema-gated by ``tools/check_artifacts.py --graftlint``) so CI can
@@ -24,8 +33,10 @@ import argparse
 import json
 import sys
 
-from tools.graftlint.core import (load_baseline, prune_stale_entries,
-                                  run_lint, split_new_and_known,
+from tools.graftlint.core import (changed_paths_since, filter_to_changed,
+                                  load_baseline, merge_tier,
+                                  prune_stale_entries, run_lint,
+                                  split_new_and_known,
                                   violations_to_json, write_baseline)
 
 
@@ -34,7 +45,8 @@ def main(argv=None) -> int:
         prog="python -m tools.graftlint",
         description="project-specific static analysis (GL01-GL06 + "
                     "GL11; --deep adds the traced-jaxpr tier "
-                    "GL07-GL10)")
+                    "GL07-GL10; --runtime adds the host-runtime "
+                    "tier GL12-GL14)")
     ap.add_argument("target",
                     help="package directory to lint (single files are "
                          "rejected: the rules are cross-module)")
@@ -45,6 +57,15 @@ def main(argv=None) -> int:
                     help="also run the semantic tier (GL07-GL10): "
                          "trace the real jitted engine programs and "
                          "walk the captured jaxprs (ppls_tpu only)")
+    ap.add_argument("--runtime", action="store_true",
+                    help="also run the host-runtime tier (GL12-GL14): "
+                         "snapshot-surface completeness, lock-order/"
+                         "blocking-under-lock, thread-shared-state "
+                         "(pure AST, any package)")
+    ap.add_argument("--since", default=None, metavar="REF",
+                    help="report only violations in files changed vs "
+                         "the git ref (lint still runs over the whole "
+                         "package; baseline/stale semantics unchanged)")
     ap.add_argument("--format", choices=("text", "json"),
                     default="text", dest="fmt",
                     help="json = one machine-readable record per "
@@ -76,10 +97,12 @@ def main(argv=None) -> int:
                   "package", file=sys.stderr)
             return 2
         from tools.graftlint.deep import DEEP_CODES, run_deep
-        violations = sorted(
-            violations + run_deep(),
-            key=lambda v: (v.path, v.line, v.code, v.symbol))
+        violations = merge_tier(violations, run_deep())
         codes_checked += list(DEEP_CODES)
+    if args.runtime:
+        from tools.graftlint.runtime import RUNTIME_CODES, run_runtime
+        violations = merge_tier(violations, run_runtime(args.target))
+        codes_checked += list(RUNTIME_CODES)
     baseline = load_baseline(args.baseline)
 
     if args.write_baseline:
@@ -95,6 +118,17 @@ def main(argv=None) -> int:
 
     new, known, stale = split_new_and_known(violations, baseline,
                                             codes_checked)
+    if args.since:
+        # narrow the REPORT (and the exit status) to the changed
+        # files; staleness stays full-run — a stale entry is about
+        # the baseline, not about any file in this diff
+        try:
+            changed = changed_paths_since(args.since)
+        except ValueError as e:
+            print(f"graftlint: error: {e}", file=sys.stderr)
+            return 2
+        new = filter_to_changed(new, changed)
+        known = filter_to_changed(known, changed)
     if args.prune_stale:
         if not args.baseline:
             ap.error("--prune-stale requires --baseline")
@@ -110,7 +144,7 @@ def main(argv=None) -> int:
     if args.fmt == "json":
         print(json.dumps(violations_to_json(
             args.target, new, known, stale, baseline,
-            deep=args.deep), indent=1))
+            deep=args.deep, runtime=args.runtime), indent=1))
         return 1 if new else 0
 
     if known and not args.quiet:
@@ -134,9 +168,11 @@ def main(argv=None) -> int:
               "reviewed, deliberate exception — add them to the "
               "baseline with a reason)")
         return 1
+    tiers_note = "".join(
+        f", {t} tier clean" for t, on in
+        (("deep", args.deep), ("runtime", args.runtime)) if on)
     print(f"graftlint: OK ({len(violations)} total, "
-          f"{len(known)} grandfathered, 0 new"
-          f"{', deep tier clean' if args.deep else ''})")
+          f"{len(known)} grandfathered, 0 new{tiers_note})")
     return 0
 
 

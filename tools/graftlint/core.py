@@ -164,6 +164,77 @@ def run_lint(target: str, rules=None) -> List[Violation]:
     return out
 
 
+# --- tiers ------------------------------------------------------------------
+
+# code -> tier, kept in core (the rules/deep/runtime modules all
+# import core, so the authoritative map lives below them; the tier
+# modules' CODE tuples are pinned against this map by tests)
+_TIER_OF_CODE: Dict[str, str] = {
+    **{c: "ast" for c in ("GL01", "GL02", "GL03", "GL04", "GL05",
+                          "GL06", "GL11")},
+    **{c: "deep" for c in ("GL07", "GL08", "GL09", "GL10")},
+    **{c: "runtime" for c in ("GL12", "GL13", "GL14")},
+}
+
+
+def tier_of(code: str) -> str:
+    """Which tier owns a rule code ("ast" | "deep" | "runtime").
+    Unknown codes map to "ast" — a new rule starts life in the always-
+    on tier unless it registers here."""
+    return _TIER_OF_CODE.get(code, "ast")
+
+
+def merge_tier(violations: List[Violation],
+               extra: Iterable[Violation]) -> List[Violation]:
+    """Append another tier's findings, DEDUPING by key: a symbol
+    flagged by two tiers (the keys are line-free, so one site can
+    satisfy two rules' patterns) must appear once in the combined
+    report — the first tier to flag it wins, later tiers add only
+    genuinely new keys. Returns the re-sorted combined list."""
+    seen = {v.key for v in violations}
+    merged = list(violations)
+    for v in extra:
+        if v.key in seen:
+            continue
+        seen.add(v.key)
+        merged.append(v)
+    merged.sort(key=lambda v: (v.path, v.line, v.code, v.symbol))
+    return merged
+
+
+# --- --since (changed-only reporting) ---------------------------------------
+
+def changed_paths_since(ref: str, cwd: str = ".") -> set:
+    """Repo-relative posix paths changed vs ``ref``: committed,
+    staged, and worktree changes (``git diff --name-only``) plus
+    untracked files — the pre-commit working set."""
+    import subprocess
+    paths: set = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        res = subprocess.run(cmd, cwd=cwd, capture_output=True,
+                             text=True)
+        if res.returncode != 0:
+            raise ValueError(
+                f"--since: {' '.join(cmd)} failed: "
+                f"{res.stderr.strip() or 'unknown git error'}")
+        paths |= {line.strip().replace(os.sep, "/")
+                  for line in res.stdout.splitlines() if line.strip()}
+    return paths
+
+
+def filter_to_changed(violations: List[Violation],
+                      changed: Iterable[str]) -> List[Violation]:
+    """Keep only violations in the changed-file set. The LINT still
+    runs over the whole package (the rules are cross-module — a
+    partial parse would false-clean, per :func:`load_package`); only
+    the REPORT narrows, so ``--since`` keeps full-run semantics for
+    baseline and staleness while a pre-commit hook sees just the
+    files it is committing."""
+    changed = set(changed)
+    return [v for v in violations if v.path in changed]
+
+
 # --- baseline ---------------------------------------------------------------
 
 def load_baseline(path: Optional[str]) -> Dict[str, str]:
@@ -210,6 +281,7 @@ def write_baseline(path: str, violations: Iterable[Violation],
             continue
         seen.add(v.key)
         entries.append({"key": v.key,
+                        "tier": tier_of(v.code),
                         "reason": reasons.get(v.key, ""),
                         "message": v.message})
     if codes_checked is not None:
@@ -272,15 +344,16 @@ def prune_stale_entries(path: str, stale: Iterable[str]) -> int:
 
 def violations_to_json(target: str, new: List[Violation],
                        known: List[Violation], stale: List[str],
-                       baseline: Dict[str, str], deep: bool) -> Dict:
+                       baseline: Dict[str, str], deep: bool,
+                       runtime: bool = False) -> Dict:
     """The ``--format json`` document: one record per violation,
     machine-readable for CI annotations (schema:
     ``ppls_tpu.utils.artifact_schema.validate_graftlint_json``, gated
     by ``tools/check_artifacts.py --graftlint``)."""
     def rec(v: Violation, grandfathered: bool) -> Dict:
-        d = {"key": v.key, "code": v.code, "path": v.path,
-             "line": v.line, "symbol": v.symbol, "message": v.message,
-             "grandfathered": grandfathered}
+        d = {"key": v.key, "code": v.code, "tier": tier_of(v.code),
+             "path": v.path, "line": v.line, "symbol": v.symbol,
+             "message": v.message, "grandfathered": grandfathered}
         if grandfathered:
             d["reason"] = baseline.get(v.key, "")
         return d
@@ -289,6 +362,7 @@ def violations_to_json(target: str, new: List[Violation],
         "schema": "graftlint-v1",
         "target": target,
         "deep": bool(deep),
+        "runtime": bool(runtime),
         "violations": ([rec(v, False) for v in new]
                        + [rec(v, True) for v in known]),
         "stale": list(stale),

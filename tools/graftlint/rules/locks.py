@@ -62,6 +62,23 @@ GL11_LOCK_MAP = {
             "spawned INSIDE stream.py must declare its shared attrs "
             "here (and take a lock) or fail review."),
     },
+    "runtime/checkpoint.py": {
+        "locks": ("_cv", "_WRITER_LOCK"),
+        "guarded": ("_q", "_busy", "_err", "_closed"),
+        "unlocked_ok": ("__init__", "_raise_pending"),
+        "reason": (
+            "CheckpointWriter's queue, worker-busy flag, parked "
+            "error, and shutdown latch are shared between the serve "
+            "loop (submit/flush/close) and the background writer "
+            "thread (_run); every touch sits inside a with self._cv "
+            "block — the condition doubles as the mutex — and the "
+            "module-level singleton is published under _WRITER_LOCK. "
+            "_raise_pending is exempt because both its callers "
+            "(submit, flush) invoke it while already holding _cv; "
+            "GL11's lexical scan cannot see a caller-held lock, and "
+            "splitting the take-and-swap into the callers would "
+            "duplicate the error-rethrow dance at both sites."),
+    },
 }
 
 

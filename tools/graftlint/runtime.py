@@ -1,0 +1,1069 @@
+"""graftlint RUNTIME tier (``--runtime``): GL12-GL14, inter-procedural
+AST analysis of the host-side serving stack.
+
+The AST tier (GL01-GL06, GL11) pins the compiled-engine invariants and
+the deep tier (GL07-GL10) pins the jaxpr-level ones; this third tier
+pins the HOST runtime's two standing contracts, which rounds 16-22
+each violated at least once before a reviewer caught it by hand:
+
+* every decision replays bit-identically across kill-and-resume, so
+  every piece of mutable host state must ride the snapshot (the
+  round-18 spillover counters restarting at zero, the round-22 lease
+  ledger only persisting because a reviewer noticed) — **GL12**;
+* the serve loop, the ingest/metrics handler threads, and the
+  background checkpoint writer share state only through declared
+  locks, and nothing blocks while holding one (the round-19
+  EngineHandle deadlock: a wedged attempt thread held the handle lock
+  inside ``eng.step()`` and every supervised retry then blocked on
+  ``with handle.lock():``, burning the whole retry budget) — **GL13**
+  and **GL14**.
+
+Like the deep tier this module is pure analysis — no jax import, no
+tracing — so ``--runtime`` costs milliseconds and runs on any host.
+All three rules emit the standard line-free ``CODE:path:symbol`` keys
+and honor pragmas/baseline/``--prune-stale``/``--format json``
+through the shared :mod:`tools.graftlint.core` plumbing
+(:func:`run_runtime` is literally ``run_lint`` with this tier's rule
+tuple).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint.core import LintModule, Violation, run_lint
+from tools.graftlint.rules._ast import (_build_call_index, _called_names,
+                                        _dotted, _resolve_callee,
+                                        _string_surface, iter_functions)
+from tools.graftlint.rules.locks import GL11_LOCK_MAP
+
+# ---------------------------------------------------------------------------
+# GL12 — snapshot-surface completeness for host state
+# ---------------------------------------------------------------------------
+
+# The DECLARED state-class map. Like GL11_LOCK_MAP this is a reviewed
+# declaration, not a baseline: listing a class here asserts "instances
+# of this class carry state that must survive kill-and-resume", and
+# every ``ephemeral`` entry names an attribute that is DELIBERATELY
+# not persisted, with the reason a reviewer can check (tests pin that
+# reasons exist and are substantive). ``aliases`` bridge attribute
+# spellings to their on-disk snapshot keys (``_slot_req`` is
+# serialized as ``resident``); kept deliberately tiny so a rename that
+# breaks one is FELT.
+#
+# The ISSUE-named surfaces and where they live: the per-tenant token
+# buckets are StreamEngine._tokens/_token_waits (persisted as
+# "tokens"/"token_waits"), and the round-22 lease ledger is
+# EngineDispatcher._lease_given/_lease_recv (persisted as
+# "given"/"recv" inside the "lease" block) plus the coordinator's
+# ClusterStreamEngine.ledger — all covered by the entries below
+# rather than by separate classes.
+GL12_STATE_CLASSES: Dict[str, Dict[str, Dict]] = {
+    "runtime/stream.py": {
+        "StreamEngine": {
+            "why": ("the single-engine serving core: every mutable "
+                    "attr is replay state unless declared ephemeral"),
+            "aliases": {
+                "_slot_req": ("resident",),
+                "_records": ("resident",),
+            },
+            "ephemeral": {
+                "_phase_rows_window": (
+                    "bounded rolling window feeding the ONLINE "
+                    "adapter's observe(); the adapter's own values/"
+                    "streaks ride the snapshot (adapt block) and the "
+                    "window refills within one cadence interval after "
+                    "resume — persisting it would only replay stale "
+                    "observations into a resized run"),
+                "_admit_window": (
+                    "derived at engine build from the admit_window "
+                    "kwarg (identity-checked at resume) and the "
+                    "store slack; _build_dd_store shrinks it to a "
+                    "device multiple deterministically, so the same "
+                    "inputs re-derive the same value on resume"),
+                "_dd_aw": (
+                    "derived per-device admit width computed by "
+                    "_build_dd_store from identity-checked config; "
+                    "rebuilt on both boot and resume"),
+                "_dd_run": (
+                    "the compiled dd-walker executable built by "
+                    "_build_dd_store; compiled artifacts are rebuilt "
+                    "on resume (the persistent compile cache makes "
+                    "that cheap), never serialized"),
+                "_dd_store": (
+                    "the dd-walker's device store layout built by "
+                    "_build_dd_store; the device STATE it holds is "
+                    "what rides the snapshot (bag cols), the layout "
+                    "is re-derived from identity-checked config"),
+                "_dd_n_dev": (
+                    "device count captured by _build_dd_store; a "
+                    "resume may legitimately run on a different "
+                    "device count (resize-resume), so persisting it "
+                    "would be wrong, not just redundant"),
+                "_dd_admit": (
+                    "per-phase admission staging handed from _admit "
+                    "to the same phase's _step and reset to None; "
+                    "never alive at a phase boundary, and snapshots "
+                    "are cut only at phase boundaries"),
+                "_flight": (
+                    "ChipFlightRecorder handle writing to the "
+                    "append-only events file; a resumed process "
+                    "opens a fresh recorder against the same file"),
+                "_chip_phase_rec": (
+                    "per-phase chip-attribution staging consumed by "
+                    "the same phase's boundary publish and reset to "
+                    "None; never alive at a snapshot cut"),
+                "_last_fam_live": (
+                    "host-side copy of device fam_live fetched at "
+                    "each phase boundary; the authoritative state is "
+                    "the device bag, which rides the snapshot, and "
+                    "the first post-resume phase boundary re-fetches "
+                    "it before any result() consumer reads it"),
+                "_last_fam_last": (
+                    "host-side copy of device fam_last, same "
+                    "boundary-refetch contract as _last_fam_live"),
+            },
+        },
+    },
+    "runtime/dispatch.py": {
+        "EngineDispatcher": {
+            "why": ("the multi-engine pool: routing, park/lease "
+                    "bookkeeping, and cut manifests are all replay "
+                    "state unless declared ephemeral"),
+            "aliases": {
+                "_lease_given": ("given",),
+                "_lease_recv": ("recv",),
+                "_parked": ("engines",),
+                "_wrappers": ("engines",),
+            },
+            "ephemeral": {
+                "_grid_spans": (
+                    "open telemetry span handles for the in-flight "
+                    "phase; spans are re-opened by the next phase "
+                    "after resume and the events file is append-only, "
+                    "so persisting live handles would be meaningless"),
+                "_pool_dir": (
+                    "derived from the checkpoint path argument at "
+                    "construction on BOTH first boot and resume; "
+                    "persisting it would pin a snapshot to an "
+                    "absolute path and break relocated restores"),
+                "_cache_entries_seen": (
+                    "compile-cache telemetry watermark (counts NEW "
+                    "persistent-cache entries this process observed); "
+                    "a resumed process legitimately restarts the "
+                    "watermark at the cache's current size — it "
+                    "meters compilation work done, not replay state"),
+            },
+        },
+    },
+    "runtime/cluster.py": {
+        "ClusterStreamEngine": {
+            "why": ("the multi-process coordinator: the request "
+                    "ledger, spillover queue, and rr cursor are the "
+                    "determinism contract across kill-and-resume"),
+            "aliases": {
+                # the worker manifest rides the checkpoint identity
+                # as the "cluster" block (_identity builds it from
+                # manifest.identity(); resume verifies against it)
+                "manifest": ("cluster",),
+            },
+            "ephemeral": {
+                "_workers": (
+                    "live WorkerHandle subprocesses; resume respawns "
+                    "workers from the manifest (identity) and "
+                    "re-deals in-flight requests from the ledger, so "
+                    "process handles are rebuilt, never restored"),
+                "_flight": (
+                    "per-worker in-flight request map, derived state: "
+                    "resume re-deals every non-retired ledger entry "
+                    "(phases_after_recovery covers the replayed "
+                    "turns), so the flight map is reconstructed from "
+                    "the persisted ledger"),
+                "_closed": (
+                    "process-lifecycle latch (close() idempotency); "
+                    "a resumed coordinator is by definition open"),
+                "_phases_after_recovery": (
+                    "bench/telemetry counter of post-recovery turns "
+                    "in THIS process lifetime, reported on the "
+                    "summary line; counting across resumes would "
+                    "double-report recovery work already summarized "
+                    "by the previous segment"),
+                "_rid_spans": (
+                    "open request-span telemetry handles; the resume "
+                    "path re-opens spans for restored live rids "
+                    "(restored=True attr) into the append-only "
+                    "events file, so live handles are rebuilt"),
+                "redeal_walls": (
+                    "bench telemetry (wall seconds spent re-dealing "
+                    "after worker loss) reported on the summary line "
+                    "of the process that did the re-dealing; not "
+                    "replay state — a resumed run re-deals afresh "
+                    "and its own wall cost starts at zero"),
+            },
+        },
+    },
+    "backends/spillover.py": {
+        "SpilloverExecutor": {
+            "why": ("the CPU spillover lane's counters feed the "
+                    "round-18 gap this rule generalizes: totals "
+                    "restarting at zero under-reported spilled work "
+                    "after resume"),
+            "aliases": {
+                # persisted BY THE OWNING ENGINE's snapshot (stream/
+                # cluster totals blocks), spelled with the spill_
+                # prefix there:
+                "requests_total": ("spill_requests_total",),
+                "tasks_total": ("spill_tasks_total",),
+            },
+            "ephemeral": {
+                "wall_total": (
+                    "wall-clock seconds of spillover compute in THIS "
+                    "process, reported on the summary line; wall "
+                    "time is not replayable state (a resumed run's "
+                    "own wall cost starts at zero by definition)"),
+            },
+        },
+    },
+    "runtime/guard.py": {
+        "GracefulShutdown": {
+            "why": ("shutdown intent must not be lost across the "
+                    "drain: the engine snapshot (pending queue) is "
+                    "the persisted half, these attrs are the "
+                    "process-local half"),
+            "aliases": {},
+            "ephemeral": {
+                "signal_name": (
+                    "which signal triggered THIS process's drain, "
+                    "reported on the summary line; the durable "
+                    "consequence (the final snapshot with the full "
+                    "pending queue) is what resume restores"),
+                "_installed": (
+                    "process-local handler-installation latch for "
+                    "__exit__ symmetry; a fresh process re-installs "
+                    "handlers on __enter__"),
+                "_old": (
+                    "the previous process's signal handlers, restored "
+                    "on __exit__; meaningless outside this process"),
+            },
+        },
+        "Supervisor": {
+            "why": ("retry/backoff bookkeeping: the budget must not "
+                    "silently reset mid-lineage"),
+            "aliases": {},
+            "ephemeral": {
+                "run_fn": (
+                    "caller-provided callable, rebound during "
+                    "in-process resize-resume recovery (the resume "
+                    "closure over the survivors); callables cannot "
+                    "ride a snapshot — a restarted process passes a "
+                    "fresh run_fn built from ITS resume path"),
+                "attempts": (
+                    "per-process attempt counter vs max_attempts: "
+                    "the retry budget is DELIBERATELY per process "
+                    "lineage (an operator-initiated restart gets a "
+                    "fresh budget; in-process supervised retries "
+                    "share one) — documented in the Supervisor "
+                    "docstring and pinned by the retry-budget tests"),
+                "recoveries": (
+                    "(kind, action) history kept for tests and the "
+                    "summary line of this process's attempts; the "
+                    "durable record is the telemetry events file"),
+            },
+        },
+    },
+    "runtime/tune.py": {
+        "OnlineAdapter": {
+            "why": ("the --adapt knob state: values/streaks ride the "
+                    "engine snapshot's adapt block (round 18), so a "
+                    "resumed run continues the SAME walk instead of "
+                    "re-warming from defaults"),
+            "aliases": {},
+            "ephemeral": {},
+        },
+    },
+    "obs/slo.py": {
+        "SloEvaluator": {
+            "why": ("burn-rate alerting state: the evaluator is "
+                    "re-based after registry replay at resume"),
+            "aliases": {},
+            "ephemeral": {
+                "_burning": (
+                    "per-SLO edge-trigger memory (was this key "
+                    "burning at the last evaluation?) used only to "
+                    "fire burn events on the False->True edge; after "
+                    "resume the registry replay re-bases rates via "
+                    "seed_base() and the next evaluation re-derives "
+                    "the edge state within one window"),
+                "_last_phase": (
+                    "evaluation cursor re-seeded by seed_base() "
+                    "after the resume path replays the registry "
+                    "counters; persisting it separately could "
+                    "contradict the replayed registry"),
+                "_last_burning": (
+                    "the previous evaluation's burning set, used "
+                    "only for edge-triggered alert events; re-seeded "
+                    "with _last_phase by seed_base() at resume"),
+            },
+        },
+    },
+    "obs/federation.py": {
+        "FederatedMetrics": {
+            "why": ("the cluster coordinator's merge state: the "
+                    "federated registry and its per-process delta "
+                    "bases must reset TOGETHER or counters double- "
+                    "or under-count after a coordinator restart"),
+            "aliases": {},
+            "ephemeral": {
+                "_prev": (
+                    "per-process delta base (last cumulative dump) "
+                    "paired with the coordinator's in-memory "
+                    "federated registry: both reset together at "
+                    "coordinator restart, so the next worker dump is "
+                    "correctly folded in FULL (the fresh-restart "
+                    "clamp); persisting _prev without the registry "
+                    "would subtract an old base from a fresh "
+                    "registry and under-count every counter"),
+            },
+        },
+    },
+    "runtime/checkpoint.py": {
+        "CheckpointWriter": {
+            "why": ("the background snapshot writer must never hold "
+                    "durable state of its own: every queued job is "
+                    "flushed before any resume/peek read (the "
+                    "flush-before-read contract), so all four attrs "
+                    "are in-process coordination only"),
+            "aliases": {},
+            "ephemeral": {
+                "_q": ("pending write jobs; flush() drains the queue "
+                       "before every snapshot READ, so no job ever "
+                       "needs to survive the process"),
+                "_busy": ("worker-liveness flag for flush()'s wait "
+                          "predicate; in-process coordination only"),
+                "_err": ("parked write error re-raised at the next "
+                         "submit/flush call site; a process that "
+                         "dies with a parked error already failed "
+                         "loudly at the write site under PPLS_CHAOS "
+                         "and fails the next flush otherwise"),
+                "_closed": ("shutdown latch for the worker loop; a "
+                            "fresh process starts a fresh writer"),
+            },
+        },
+    },
+}
+
+# Function/method names whose string constants + kwarg names form a
+# class's persistence surface (GL01's _SNAPSHOT_NAME_RE, widened with
+# state/restore/payload for the host classes: OnlineAdapter.state()/
+# restore() and the dispatcher's payload builders).
+_GL12_SURFACE_RE = re.compile(
+    r"identity|checkpoint|snapshot|resume|restore|state|payload",
+    re.IGNORECASE)
+# restore-side functions additionally contribute the attribute names
+# they ASSIGN (``disp._cut_files = ...`` mentions no string key, but
+# it IS the restore of that attr)
+_GL12_RESTORE_RE = re.compile(r"resume|restore|load", re.IGNORECASE)
+
+# in-place container mutators: ``self.X.append(...)`` mutates X just
+# as surely as ``self.X = ...``
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "sort",
+})
+
+
+def _iter_shallow(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk a subtree WITHOUT descending into nested function/class
+    definitions or lambdas: code inside a nested def does not execute
+    where it is written (it runs when called, under whatever locks
+    hold THERE), so lexical lock-region scans must not attribute it
+    to the enclosing function."""
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(c)
+
+
+def _unwrap_subscripts(node: ast.AST) -> ast.AST:
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` / ``self.X[...]`` -> ``X``; None otherwise."""
+    node = _unwrap_subscripts(node)
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _flatten_targets(node: ast.AST) -> Iterator[ast.AST]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _flatten_targets(e)
+    else:
+        yield node
+
+
+def _mutated_self_attrs(fn: ast.FunctionDef) -> Dict[str, int]:
+    """``self.<attr>`` mutation sites in ``fn``: assignments (plain,
+    augmented, annotated, tuple-unpacked, subscript stores) and
+    in-place container mutator calls. -> {attr: first line}."""
+    out: Dict[str, int] = {}
+
+    def note(attr: Optional[str], line: int) -> None:
+        if attr is not None and attr not in out:
+            out[attr] = line
+
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                for tt in _flatten_targets(t):
+                    note(_self_attr(tt), n.lineno)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            note(_self_attr(n.target), n.lineno)
+        elif isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATORS:
+            note(_self_attr(n.func.value), n.lineno)
+    return out
+
+
+def _class_defs(mod: LintModule) -> Dict[str, ast.ClassDef]:
+    """Every class in the module (nested ones included — the ingest
+    and metrics servers define their HTTP handlers inside methods)."""
+    return {n.name: n for n in ast.walk(mod.tree)
+            if isinstance(n, ast.ClassDef)}
+
+
+def _methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {f.name: f for f in cls.body
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _restored_attr_names(fn: ast.FunctionDef) -> Set[str]:
+    """Attribute names a restore-side function rebuilds: stores
+    through ANY object (``disp._cut_files = {...}``,
+    ``eng._slot_req[slot] = req``) and in-place mutator calls
+    (``eng._free.remove(slot)``)."""
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        elif isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATORS:
+            targets = [n.func.value]
+        for t in targets:
+            for tt in _flatten_targets(t):
+                tt = _unwrap_subscripts(tt)
+                if isinstance(tt, ast.Attribute):
+                    out.add(tt.attr)
+    return out
+
+
+def rule_gl12(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL12: every runtime-mutated attribute of a declared state class
+    must appear on that class's snapshot/resume surface (string keys +
+    kwarg names, GL01-style, plus restore-side attribute stores) or in
+    the class's ephemeral allowlist with a reviewed reason.
+
+    This generalizes GL01 (carry fields vs the checkpoint identity)
+    to the host classes, the way rounds 16-22 needed it: the
+    spillover counters (round 18) and the lease ledger (round 22)
+    were both ``self.<attr>`` mutations whose spelling never reached
+    any snapshot payload until a reviewer noticed. ``__init__`` is
+    exempt — construction-time assignment is shape, not runtime
+    mutation; what must ride the snapshot is state the RUN changes."""
+    # the global persistence surface: runtime/checkpoint.py mentions
+    # the generic payload keys (identity/totals/meta...) every
+    # engine-side snapshot flows through (GL01 precedent)
+    global_surface: Set[str] = set()
+    # package-wide surface, consulted ONLY for declared alias targets:
+    # some classes are persisted by ANOTHER module's snapshot (the
+    # spillover totals ride the owning engine's totals block as
+    # "spill_requests_total"), so an explicit reviewed alias may
+    # resolve anywhere in the package's snapshot code — but a plain
+    # attr spelling must still be covered class-locally, or the rule
+    # would accept any string coincidence anywhere in the package.
+    pkg_alias_surface: Set[str] = set()
+    for mod in modules:
+        if mod.path.endswith("runtime/checkpoint.py"):
+            global_surface |= _string_surface(mod.tree)
+        for n in ast.walk(mod.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _GL12_SURFACE_RE.search(n.name):
+                pkg_alias_surface |= _string_surface(n)
+
+    for mod in modules:
+        decl = None
+        for suffix, d in GL12_STATE_CLASSES.items():
+            if mod.path.endswith(suffix):
+                decl = d
+                break
+        if decl is None:
+            continue
+        classes = _class_defs(mod)
+        module_funcs = dict(iter_functions(mod.tree))
+        for cls_name, spec in decl.items():
+            cls = classes.get(cls_name)
+            if cls is None:
+                continue
+            methods = _methods(cls)
+            # --- the class's persistence surface -------------------
+            surface = set(global_surface)
+            restore_assigned: Set[str] = set()
+            contributing: List[ast.FunctionDef] = []
+            for name, fn in methods.items():
+                if _GL12_SURFACE_RE.search(name):
+                    contributing.append(fn)
+            for qn, fn in module_funcs.items():
+                if _GL12_SURFACE_RE.search(qn) \
+                        and not qn.startswith(tuple(
+                            f"{c}." for c in classes)):
+                    contributing.append(fn)
+            # one hop: module-level helpers the surface functions call
+            one_hop: Set[str] = set()
+            for fn in contributing:
+                one_hop |= _called_names(fn)
+            for qn, fn in module_funcs.items():
+                short = qn.split(".")[-1]
+                if (qn in one_hop or short in one_hop) \
+                        and fn not in contributing:
+                    contributing.append(fn)
+            for fn in contributing:
+                surface |= _string_surface(fn)
+                if _GL12_RESTORE_RE.search(fn.name):
+                    restore_assigned |= _restored_attr_names(fn)
+            surface |= restore_assigned
+            surface |= {s.lstrip("_") for s in restore_assigned}
+            # --- runtime mutation sites ----------------------------
+            aliases: Dict[str, Tuple[str, ...]] = spec.get("aliases", {})
+            ephemeral: Dict[str, str] = spec.get("ephemeral", {})
+            mutated: Dict[str, int] = {}
+            for name, fn in methods.items():
+                if name == "__init__":
+                    continue
+                for attr, line in _mutated_self_attrs(fn).items():
+                    mutated.setdefault(attr, line)
+            for attr in sorted(mutated):
+                if attr in ephemeral:
+                    continue
+                names = {attr, attr.lstrip("_")}
+                alias_names = set(aliases.get(attr, ()))
+                if (names | alias_names) & surface:
+                    continue
+                if alias_names & pkg_alias_surface:
+                    continue
+                names |= alias_names
+                yield Violation(
+                    code="GL12", path=mod.path, line=mutated[attr],
+                    symbol=f"{cls_name}.{attr}",
+                    message=(
+                        f"{cls_name}.{attr} is mutated at runtime but "
+                        f"absent from the class's snapshot/resume "
+                        f"surface: no snapshot/restore code mentions "
+                        f"{sorted(names)}, so a kill-and-resume "
+                        f"silently resets it (the round-18 spillover-"
+                        f"counter gap). Persist it, add a spelling "
+                        f"alias, or declare it ephemeral in "
+                        f"GL12_STATE_CLASSES with the reason it need "
+                        f"not survive."))
+
+
+# ---------------------------------------------------------------------------
+# GL13 — lock-order + blocking-under-lock
+# ---------------------------------------------------------------------------
+
+# The declared lock vocabulary, per module: spelling -> logical lock
+# identity. Spelling-based like GL11 (``with self._lock`` /
+# ``with handle.lock():`` — the accessor counts), with the module
+# scoping resolving the ambiguity of common names like ``_lock``.
+# Every module that defines or acquires a serving-stack lock is
+# listed; the serve loop's ``with handle.lock():`` in __main__.py maps
+# to the SAME logical lock as ingest.py's ``self._lock``, which is
+# what lets the lock-order graph see a cross-module cycle.
+GL13_LOCK_DECLS: Dict[str, Dict[str, str]] = {
+    "runtime/ingest.py": {"_lock": "EngineHandle._lock",
+                          "lock": "EngineHandle._lock"},
+    "__main__.py": {"lock": "EngineHandle._lock"},
+    "runtime/checkpoint.py": {"_cv": "CheckpointWriter._cv",
+                              "_WRITER_LOCK": "checkpoint._WRITER_LOCK"},
+    "obs/registry.py": {"_lock": "MetricsRegistry._lock"},
+    "obs/telemetry.py": {"_compile_lock": "telemetry._compile_lock",
+                         "_default_lock": "telemetry._default_lock"},
+    "runtime/faults.py": {"_lock": "faults._lock"},
+}
+
+# Declared engine-RPC call names: ``eng.step()`` is a full device
+# phase (the round-19 hang wedged exactly here), ``readline()`` on a
+# worker pipe is the coordinator's blocking RPC read. Reviewed
+# additions only — each carries its reason.
+GL13_RPC_CALLS: Dict[str, str] = {
+    "step": ("a StreamEngine/dispatcher step() is a whole device "
+             "phase (possibly hung hardware — the round-19 deadlock "
+             "was an injected hang inside step() under the handle "
+             "lock)"),
+    "readline": ("a blocking pipe read from a cluster worker "
+                 "subprocess; a dead worker never answers"),
+}
+
+
+def _lock_of_with(item: ast.withitem,
+                  decls: Dict[str, str]) -> Optional[str]:
+    """Logical lock id acquired by a with-item, per the module's
+    declared spellings (``self._lock``, ``handle.lock()``, a bare
+    ``_lock`` global)."""
+    for n in ast.walk(item.context_expr):
+        if isinstance(n, ast.Attribute) and n.attr in decls:
+            return decls[n.attr]
+        if isinstance(n, ast.Name) and n.id in decls:
+            return decls[n.id]
+    return None
+
+
+def _blocking_name(call: ast.Call) -> Optional[str]:
+    """Name of the blocking operation a call performs, or None.
+
+    Heuristics tuned to stay quiet on the safe spellings: ``.get()``
+    with positional args is ``dict.get``; ``.join(x)`` with args is
+    ``str.join``/``os.path.join``/``Thread.join(timeout)``; any
+    ``timeout=`` kwarg bounds the wait and is accepted."""
+    has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        a = f.attr
+        if a in ("accept", "recv", "recvfrom", "serve_forever"):
+            return a
+        if a in ("wait", "communicate") and not call.args \
+                and not has_timeout:
+            return a
+        if a in ("join", "get") and not call.args and not has_timeout:
+            return a
+        if a in GL13_RPC_CALLS:
+            return a
+    if _dotted(f) == "time.sleep":
+        return "time.sleep"
+    return None
+
+
+def _all_defs(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    """name -> node for EVERY def in the module, nested ones included
+    (the serve loop is a closure; handler methods live in nested
+    classes). First definition wins on name collisions."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(n.name, n)
+    return out
+
+
+def _class_method_index(mod: LintModule
+                        ) -> Dict[str, Dict[str, ast.FunctionDef]]:
+    return {name: _methods(cls)
+            for name, cls in _class_defs(mod).items()}
+
+
+class _CallGraph:
+    """Extended intra-package call resolution shared by GL13/GL14:
+    the GL03/GL06 resolver (imports, module attrs, functools.partial)
+    plus ``self.method()`` edges, unique-in-module method-name edges,
+    and Thread/HTTPServer handler targets (GL14 entry discovery)."""
+
+    def __init__(self, modules: List[LintModule]):
+        self.modules = modules
+        self.by_key = {m.modkey: m for m in modules}
+        self.index = _build_call_index(modules)
+        self.defs = {m.modkey: _all_defs(m.tree) for m in modules}
+        self.classes = {m.modkey: _class_method_index(m)
+                        for m in modules}
+        # method name -> owning classes, per module (for the
+        # unique-name fallback)
+        self.owners: Dict[str, Dict[str, List[str]]] = {}
+        for m in modules:
+            d: Dict[str, List[str]] = {}
+            for cname, ms in self.classes[m.modkey].items():
+                for mname in ms:
+                    d.setdefault(mname, []).append(cname)
+            self.owners[m.modkey] = d
+
+    def lookup(self, modkey: str, qual: str
+               ) -> Optional[ast.FunctionDef]:
+        if "." in qual:
+            cname, mname = qual.split(".", 1)
+            got = self.classes.get(modkey, {}).get(cname, {}) \
+                .get(mname)
+            if got is not None:
+                return got
+        return (self.index.get(modkey, {}).get(qual)
+                or self.defs.get(modkey, {}).get(qual))
+
+    def callees(self, modkey: str, region: ast.AST,
+                self_cls: Optional[str], shallow: bool = False
+                ) -> List[Tuple[str, str]]:
+        """(modkey, qualname) of every resolvable callee in the
+        region. Thread targets are NOT followed here — a spawned
+        thread does not run under the spawner's locks (GL14 handles
+        thread entries separately). ``shallow`` skips nested defs
+        (lock-region scans: a closure's body runs when called, not
+        where defined)."""
+        mod = self.by_key[modkey]
+        out: List[Tuple[str, str]] = []
+        for n in (_iter_shallow(region) if shallow
+                  else ast.walk(region)):
+            if not isinstance(n, ast.Call):
+                continue
+            r = _resolve_callee(mod, n, self.index)
+            if r is not None:
+                out.append(r)
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute):
+                if isinstance(f.value, ast.Name) \
+                        and f.value.id == "self" and self_cls \
+                        and f.attr in self.classes[modkey].get(
+                            self_cls, {}):
+                    out.append((modkey, f"{self_cls}.{f.attr}"))
+                    continue
+                own = self.owners[modkey].get(f.attr, [])
+                if len(own) == 1:
+                    out.append((modkey, f"{own[0]}.{f.attr}"))
+        return out
+
+    def thread_entries(self, modkey: str) -> List[Tuple[str, str]]:
+        """Thread-entry functions DEFINED in the module:
+        ``threading.Thread(target=...)`` targets and ``do_*`` methods
+        of ``BaseHTTPRequestHandler`` subclasses (nested classes
+        included — both servers define their handler inline)."""
+        mod = self.by_key[modkey]
+        out: List[Tuple[str, str]] = []
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Call) \
+                    and _dotted(n.func).split(".")[-1] == "Thread":
+                for kw in n.keywords:
+                    if kw.arg != "target":
+                        continue
+                    t = kw.value
+                    if isinstance(t, ast.Name) \
+                            and t.id in self.defs[modkey]:
+                        out.append((modkey, t.id))
+                    elif isinstance(t, ast.Attribute):
+                        own = self.owners[modkey].get(t.attr, [])
+                        if len(own) == 1:
+                            out.append((modkey,
+                                        f"{own[0]}.{t.attr}"))
+            elif isinstance(n, ast.ClassDef) and any(
+                    "BaseHTTPRequestHandler" in _dotted(b)
+                    or _dotted(b).endswith("Handler")
+                    for b in n.bases):
+                for mname in _methods(n):
+                    if mname.startswith("do_"):
+                        out.append((modkey, f"{n.name}.{mname}"))
+        return out
+
+
+def _enclosing_functions(tree: ast.Module
+                         ) -> List[Tuple[str, Optional[str],
+                                         ast.FunctionDef]]:
+    """(display qualname, enclosing class or None, node) for every
+    def, nested ones included."""
+    out: List[Tuple[str, Optional[str], ast.FunctionDef]] = []
+
+    def walk(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                out.append((qn, cls, child))
+                walk(child, f"{qn}.", cls)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{child.name}.", child.name)
+            else:
+                walk(child, prefix, cls)
+
+    walk(tree, "", None)
+    return out
+
+
+def rule_gl13(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL13: lock-acquisition cycles, and blocking operations
+    reachable while a declared lock is held.
+
+    From every ``with <declared lock>:`` site, the body and every
+    intra-package function it (transitively) calls are scanned for
+    (a) blocking operations — socket accept/recv, untimed ``wait``/
+    ``join``/``get``/``communicate``, ``time.sleep``, declared
+    engine-RPC names like ``step`` — and (b) acquisitions of OTHER
+    declared locks, which become edges of the lock-order graph; any
+    cycle in that graph flags. A ``cv.wait()`` ON the held condition
+    is exempt (the idiom releases the lock while waiting). This is
+    the round-19 deadlock shape as a rule: ``eng.step()`` under the
+    handle lock wedged one attempt, and every retry then blocked
+    forever on ``with handle.lock():``."""
+    graph = _CallGraph(modules)
+    decls_by_mod: Dict[str, Dict[str, str]] = {}
+    for mod in modules:
+        for suffix, d in GL13_LOCK_DECLS.items():
+            if mod.path.endswith(suffix):
+                decls_by_mod[mod.modkey] = d
+                break
+    if not decls_by_mod:
+        return
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    acq_site: Dict[str, Tuple[str, int]] = {}
+    reported: Set[Tuple[str, str, str]] = set()
+    out: List[Violation] = []
+
+    def wait_on_held(call: ast.Call, lock_id: str,
+                     decls: Dict[str, str]) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "wait"):
+            return False
+        for n in ast.walk(f.value):
+            spelled = (n.attr if isinstance(n, ast.Attribute)
+                       else n.id if isinstance(n, ast.Name) else None)
+            if spelled is not None \
+                    and decls.get(spelled) == lock_id:
+                return True
+        return False
+
+    def scan(modkey: str, qual: str, self_cls: Optional[str],
+             region: ast.AST, lock_id: str, origin_decls,
+             visited: Set[Tuple[str, str]], depth: int) -> None:
+        mod = graph.by_key[modkey]
+        decls = dict(decls_by_mod.get(modkey, {}))
+        decls.update({k: v for k, v in origin_decls.items()
+                      if k not in decls})
+        # (a) blocking operations, lexically in this region (nested
+        # defs excluded — they run when called, and calls are edges)
+        for n in _iter_shallow(region):
+            if isinstance(n, ast.Call):
+                op = _blocking_name(n)
+                if op is not None \
+                        and not wait_on_held(n, lock_id, decls):
+                    key = (lock_id, f"{qual}:{op}", mod.path)
+                    if key not in reported:
+                        reported.add(key)
+                        out.append(Violation(
+                            code="GL13", path=mod.path,
+                            line=n.lineno, symbol=f"{qual}:{op}",
+                            message=(
+                                f"{qual} performs the blocking "
+                                f"operation {op!r} while "
+                                f"{lock_id} is held: a hang here "
+                                f"wedges every other thread on the "
+                                f"lock (the round-19 EngineHandle "
+                                f"deadlock burned the whole retry "
+                                f"budget this way). Move the "
+                                f"blocking call outside the lock, "
+                                f"bound it with a timeout, or "
+                                f"allowlist with the reason the "
+                                f"hold is safe.")))
+            # (b) nested acquisitions -> lock-order edges
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    other = _lock_of_with(item, decls)
+                    if other is not None and other != lock_id:
+                        edges.setdefault((lock_id, other),
+                                         (mod.path, n.lineno, qual))
+        # (c) transitive callees run under the lock too
+        if depth >= 8:
+            return
+        for ck, cq in graph.callees(modkey, region, self_cls,
+                                    shallow=True):
+            if (ck, cq) in visited:
+                continue
+            visited.add((ck, cq))
+            fn = graph.lookup(ck, cq)
+            if fn is None:
+                continue
+            c_cls = cq.split(".", 1)[0] if "." in cq else None
+            scan(ck, cq, c_cls, fn, lock_id, origin_decls,
+                 visited, depth + 1)
+
+    for mod in modules:
+        decls = decls_by_mod.get(mod.modkey)
+        if decls is None:
+            continue
+        for qual, cls, fn in _enclosing_functions(mod.tree):
+            for n in _iter_shallow(fn):
+                if not isinstance(n, (ast.With, ast.AsyncWith)):
+                    continue
+                for item in n.items:
+                    lock_id = _lock_of_with(item, decls)
+                    if lock_id is None:
+                        continue
+                    acq_site.setdefault(lock_id,
+                                        (mod.path, n.lineno))
+                    body = ast.Module(body=list(n.body),
+                                      type_ignores=[])
+                    scan(mod.modkey, qual, cls, body, lock_id,
+                         decls, {(mod.modkey, qual)}, 0)
+
+    # cycle detection over the acquisition graph
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+        for nxt in adj.get(node, ()):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                # canonical rotation: start at the min element
+                core = cyc[:-1]
+                i = core.index(min(core))
+                rot = tuple(core[i:] + core[:i])
+                seen_cycles.add(rot)
+            else:
+                on_stack.add(nxt)
+                dfs(nxt, stack + [nxt], on_stack)
+                on_stack.discard(nxt)
+
+    for start in sorted(adj):
+        dfs(start, [start], {start})
+    for rot in sorted(seen_cycles):
+        first = rot[0]
+        path, line = acq_site.get(first, ("", 0))
+        chain = "->".join(rot + (rot[0],))
+        out.append(Violation(
+            code="GL13", path=path, line=line,
+            symbol=f"cycle:{chain}",
+            message=(
+                f"lock-acquisition cycle {chain}: two threads "
+                f"taking these locks in opposite orders deadlock. "
+                f"Impose a single acquisition order (or collapse "
+                f"to one lock).")))
+    yield from out
+
+
+# ---------------------------------------------------------------------------
+# GL14 — thread-shared-state audit
+# ---------------------------------------------------------------------------
+
+# Attributes that are shared across threads BY DESIGN without a lock:
+# immutable-after-publication or atomic (a threading.Event, a single
+# reference assignment read once). Reviewed declarations with reasons,
+# like every other allowlist in this package.
+GL14_SHARED_OK: Dict[str, Dict[str, str]] = {
+    "runtime/guard.py": {
+        "_flag": ("threading.Event is internally locked; set() from "
+                  "the signal handler and is_set() from the serve "
+                  "loop are the documented atomic pair"),
+    },
+}
+
+
+def rule_gl14(modules: List[LintModule]) -> Iterator[Violation]:
+    """GL14: an attribute written at runtime and touched both from a
+    thread-entry function (Thread target, HTTP handler ``do_*``,
+    the checkpoint-writer worker) and from main-side code must be in
+    the module's GL11 guarded set or a declared immutable/atomic
+    allowlist.
+
+    GL11 enforces the lexical with-lock discipline on attrs ALREADY
+    declared shared; this rule finds the attrs that SHOULD be
+    declared: state a new thread quietly started sharing (the PR-10
+    ingest race began exactly this way — ``_eng`` was cross-thread
+    long before any lock map said so)."""
+    graph = _CallGraph(modules)
+    for mod in modules:
+        entries = graph.thread_entries(mod.modkey)
+        if not entries:
+            continue
+        # thread-reachable closure (package-wide BFS)
+        thread_reach: Set[Tuple[str, str]] = set()
+        queue = list(entries)
+        while queue:
+            key = queue.pop()
+            if key in thread_reach:
+                continue
+            thread_reach.add(key)
+            mk, qn = key
+            fn = graph.lookup(mk, qn)
+            if fn is None:
+                continue
+            cls = qn.split(".", 1)[0] if "." in qn else None
+            for c in graph.callees(mk, fn, cls):
+                if c not in thread_reach:
+                    queue.append(c)
+        local_thread = {qn for mk, qn in thread_reach
+                        if mk == mod.modkey}
+        # guarded/allowlisted attrs for this module
+        guarded: Set[str] = set()
+        unlocked_ok: Set[str] = set()
+        for suffix, e in GL11_LOCK_MAP.items():
+            if mod.path.endswith(suffix):
+                guarded |= set(e["guarded"])
+                unlocked_ok |= set(e.get("unlocked_ok", ()))
+        shared_ok: Dict[str, str] = {}
+        for suffix, d in GL14_SHARED_OK.items():
+            if mod.path.endswith(suffix):
+                shared_ok = d
+                break
+        # per-class attr touch/write maps
+        for cls_name, cls in _class_defs(mod).items():
+            touches: Dict[str, Set[str]] = {}
+            writes: Dict[str, int] = {}
+            for mname, fn in _methods(cls).items():
+                qual = f"{cls_name}.{mname}"
+                for n in ast.walk(fn):
+                    a = _self_attr(n) if isinstance(
+                        n, (ast.Attribute, ast.Subscript)) else None
+                    if a is not None:
+                        touches.setdefault(a, set()).add(qual)
+                if mname == "__init__" or mname in unlocked_ok:
+                    continue
+                for attr, line in _mutated_self_attrs(fn).items():
+                    writes.setdefault(attr, line)
+            for attr in sorted(writes):
+                users = touches.get(attr, set())
+                t_side = {q for q in users if q in local_thread}
+                m_side = users - t_side
+                if not t_side or not m_side:
+                    continue
+                if attr in guarded or attr in shared_ok:
+                    continue
+                yield Violation(
+                    code="GL14", path=mod.path, line=writes[attr],
+                    symbol=f"{cls_name}.{attr}",
+                    message=(
+                        f"{cls_name}.{attr} is written at runtime and "
+                        f"touched from both a thread entry "
+                        f"({', '.join(sorted(t_side))}) and main-side "
+                        f"code ({', '.join(sorted(m_side))}) but is "
+                        f"neither in the module's GL11 guarded set "
+                        f"nor declared immutable/atomic in "
+                        f"GL14_SHARED_OK: this is un-declared "
+                        f"cross-thread mutable state (the PR-10 race "
+                        f"started exactly like this). Guard it with "
+                        f"the module's lock (and add it to "
+                        f"GL11_LOCK_MAP), or declare why it is safe "
+                        f"bare."))
+
+
+# ---------------------------------------------------------------------------
+
+RUNTIME_RULES = (rule_gl12, rule_gl13, rule_gl14)
+RUNTIME_CODES = ("GL12", "GL13", "GL14")
+
+
+def run_runtime(target: str) -> List[Violation]:
+    """The ``--runtime`` tier entry: the three host-runtime rules over
+    the target package, with the shared pragma handling (run_lint
+    applies ``# graftlint: GLxx`` suppression and sorting)."""
+    return run_lint(target, rules=RUNTIME_RULES)
